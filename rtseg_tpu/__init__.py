@@ -1,0 +1,22 @@
+"""rtseg_tpu — TPU-native realtime semantic segmentation framework.
+
+A ground-up JAX/XLA/Flax re-design of the capability surface of
+acai66/realtime-semantic-segmentation-pytorch (reference at /root/reference):
+36 realtime segmentation architectures, OHEM/aux/detail/KD losses, EMA,
+Cityscapes + custom datasets, checkpoint/resume, and a data-parallel
+(optionally spatially-partitioned) sharded train step over a TPU mesh.
+
+Layout:
+  config/    typed SegConfig + CLI overlay
+  ops/       functional ops: align-corners resize, pool/unpool, shuffles
+  nn/        Flax module vocabulary (ConvBNAct family, activations, PPM, ...)
+  models/    36-arch model zoo + registry + backbones
+  losses/    OHEM-CE / CE / Dice / Detail / KD, all static-shape under jit
+  data/      host-side pipeline: transforms, datasets, device-sharded loader
+  train/     TrainState, jit'd train/eval steps, trainer loop, checkpointing
+  parallel/  mesh construction, sharding rules, multi-host init
+  utils/     metrics (on-device mIoU), colormap, logging, seeding
+  tools/     speed benchmark, parameter counter
+"""
+
+__version__ = '0.1.0'

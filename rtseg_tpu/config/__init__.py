@@ -1,0 +1,5 @@
+from .base import SegConfig
+from .parser import get_parser, load_parser, MODEL_CHOICES, DECODER_CHOICES
+
+__all__ = ['SegConfig', 'get_parser', 'load_parser', 'MODEL_CHOICES',
+           'DECODER_CHOICES']

@@ -1,0 +1,206 @@
+"""Typed configuration for rtseg_tpu.
+
+Mirrors the capability surface of the reference's flat config object
+(reference: configs/base_config.py:2-109) but as an explicit dataclass with a
+single derived-field resolution step (`resolve`) instead of scattered runtime
+mutation of a god-object (see reference core/base_trainer.py:20,
+utils/parallel.py:22-29, utils/scheduler.py:7-10).
+
+Naming bugs of the reference are intentionally fixed here:
+  - `dataroot` vs `data_root` (base_config.py:5 vs cityscapes.py:104) -> `data_root`
+  - `logger_name`, `train_size`, `test_size`, `reduction` used-but-undefined
+    (utils/utils.py:33, datasets/custom.py:45,58, core/loss.py:63) -> defined.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any, Optional, Sequence
+
+
+@dataclass
+class SegConfig:
+    # ----- Dataset (base_config.py:3-7) -----
+    dataset: Optional[str] = None          # 'cityscapes' | 'custom' | 'synthetic'
+    data_root: Optional[str] = None
+    num_class: int = -1
+    ignore_index: int = 255
+
+    # ----- Model (base_config.py:9-13) -----
+    model: Optional[str] = None
+    encoder: Optional[str] = None          # for model == 'smp' generic enc-dec
+    decoder: Optional[str] = None
+    encoder_weights: Optional[str] = 'imagenet'
+
+    # ----- Detail head, STDC (base_config.py:15-20) -----
+    use_detail_head: bool = False
+    detail_thrs: float = 0.1
+    detail_loss_coef: float = 1.0
+    dice_loss_coef: float = 1.0
+    bce_loss_coef: float = 1.0
+
+    # ----- Training (base_config.py:22-27) -----
+    total_epoch: int = 200
+    base_lr: float = 0.01
+    train_bs: int = 16                     # per device
+    use_aux: bool = False
+    aux_coef: Optional[Sequence[float]] = None
+
+    # ----- Validation (base_config.py:29-32) -----
+    val_bs: int = 16
+    begin_val_epoch: int = 0
+    val_interval: int = 1
+
+    # ----- Testing / prediction (base_config.py:34-41) -----
+    is_testing: bool = False
+    test_bs: int = 16
+    test_data_folder: Optional[str] = None
+    colormap: str = 'cityscapes'
+    save_mask: bool = True
+    blend_prediction: bool = True
+    blend_alpha: float = 0.3
+
+    # ----- Loss (base_config.py:43-46) -----
+    loss_type: str = 'ohem'                # 'ce' | 'ohem'
+    class_weights: Optional[Sequence[float]] = None
+    ohem_thrs: float = 0.7
+    reduction: str = 'mean'                # defined here; latent bug in core/loss.py:63
+
+    # ----- Scheduler (base_config.py:48-50) -----
+    lr_policy: str = 'cos_warmup'          # 'cos_warmup' | 'linear' | 'step'
+    warmup_epochs: int = 3
+    step_size: int = 10000                 # for 'step'
+    step_gamma: float = 0.1
+
+    # ----- Optimizer (base_config.py:52-55) -----
+    optimizer_type: str = 'sgd'            # 'sgd' | 'adam' | 'adamw'
+    momentum: float = 0.9
+    weight_decay: float = 1e-4
+
+    # ----- Monitoring (base_config.py:57-62) -----
+    save_ckpt: bool = True
+    save_dir: str = 'save'
+    use_tb: bool = True
+    tb_log_dir: Optional[str] = None
+    ckpt_name: Optional[str] = None
+    logger_name: str = 'seg_trainer'
+
+    # ----- Training setting (base_config.py:64-71) -----
+    amp_training: bool = False             # on TPU: bf16 compute, no GradScaler
+    resume_training: bool = True
+    load_ckpt: bool = True
+    load_ckpt_path: Optional[str] = None
+    base_workers: int = 8
+    random_seed: int = 1
+    use_ema: bool = False
+
+    # ----- Augmentation (base_config.py:73-83) -----
+    crop_size: int = 512
+    crop_h: Optional[int] = None
+    crop_w: Optional[int] = None
+    scale: float = 1.0
+    randscale: Any = 0.0                   # float or (lo, hi) tuple
+    brightness: float = 0.0
+    contrast: float = 0.0
+    saturation: float = 0.0
+    h_flip: float = 0.0
+    v_flip: float = 0.0
+    # custom-dataset square resize (datasets/custom.py:45,58)
+    train_size: Optional[int] = None
+    test_size: Optional[int] = None
+
+    # ----- Parallelism (replaces base_config.py:85-86 DDP block) -----
+    sync_bn: bool = True                   # cross-replica BN stats via pmean
+    mesh_shape: Optional[Sequence[int]] = None   # e.g. (8,) data; (4, 2) data x spatial
+    mesh_axes: Sequence[str] = ('data',)
+    spatial_partition: int = 1             # >1: shard H across 'spatial' axis
+    multihost: bool = False                # call jax.distributed.initialize()
+    coordinator_address: Optional[str] = None
+    process_id: Optional[int] = None
+    num_processes: Optional[int] = None
+
+    # ----- Knowledge distillation (base_config.py:88-96) -----
+    kd_training: bool = False
+    teacher_ckpt: str = ''
+    teacher_model: str = 'smp'
+    teacher_encoder: Optional[str] = None
+    teacher_decoder: Optional[str] = None
+    kd_loss_type: str = 'kl_div'           # 'kl_div' | 'mse'
+    kd_loss_coefficient: float = 1.0
+    kd_temperature: float = 4.0
+
+    # ----- Numerics (TPU-native additions) -----
+    compute_dtype: str = 'bfloat16'        # activations/matmul dtype under jit
+    param_dtype: str = 'float32'
+
+    # ----- Derived fields (filled by resolve(); never set by hand) -----
+    train_num: int = 0
+    val_num: int = 0
+    iters_per_epoch: int = 0
+    total_itrs: int = 0
+    lr: float = 0.0
+    num_workers: int = 0
+    gpu_num: int = 1                       # device count (kept for parity of meaning)
+
+    _resolved: bool = False
+
+    # -------------------------------------------------------------- resolve
+    def resolve(self, num_devices: Optional[int] = None) -> "SegConfig":
+        """Explicit derived-field resolution.
+
+        Replaces reference init_dependent_config (base_config.py:98-109) plus the
+        runtime mutations scattered through utils/optimizer.py:9-16 and
+        utils/scheduler.py:6-10.
+        """
+        if self.load_ckpt_path is None and not self.is_testing:
+            self.load_ckpt_path = f'{self.save_dir}/last.ckpt'
+        if self.tb_log_dir is None:
+            self.tb_log_dir = f'{self.save_dir}/tb_logs/'
+        if self.crop_h is None:
+            self.crop_h = self.crop_size
+        if self.crop_w is None:
+            self.crop_w = self.crop_size
+
+        if num_devices is not None:
+            self.gpu_num = num_devices
+        # linear LR scaling by device count (utils/optimizer.py:9-16)
+        if self.optimizer_type == 'sgd':
+            self.lr = self.base_lr * self.gpu_num
+        elif self.optimizer_type in ('adam', 'adamw'):
+            self.lr = 0.001 * self.gpu_num
+        else:
+            raise NotImplementedError(
+                f'Unsupported optimizer type: {self.optimizer_type}')
+        self.num_workers = self.base_workers * self.gpu_num
+        self._resolved = True
+        return self
+
+    def resolve_schedule(self, train_num: int) -> "SegConfig":
+        """Schedule math of utils/scheduler.py:6-10: per-iteration stepping with
+        total steps = ceil(train_num / bs / devices) * epochs."""
+        import math
+        self.train_num = train_num
+        self.iters_per_epoch = max(
+            1, math.ceil(train_num / self.train_bs / self.gpu_num))
+        self.total_itrs = int(self.total_epoch * self.iters_per_epoch)
+        return self
+
+    # ---------------------------------------------------------------- misc
+    def replace(self, **kw) -> "SegConfig":
+        return dataclasses.replace(self, **kw)
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d.pop('_resolved', None)
+        return d
+
+    def save(self, path: str) -> None:
+        with open(path, 'w') as f:
+            json.dump(self.to_dict(), f, indent=4, default=str)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "SegConfig":
+        names = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in names})

@@ -1,0 +1,41 @@
+from .losses import (bce_with_logits, cross_entropy, detail_loss, dice_loss,
+                     kd_loss, laplacian_pyramid, ohem_cross_entropy)
+
+
+def get_loss_fn(config):
+    """Loss factory matching reference core/loss.py:55-71."""
+    import jax.numpy as jnp
+    weights = None if config.class_weights is None else \
+        jnp.asarray(config.class_weights, jnp.float32)
+    if config.loss_type == 'ce':
+        def fn(logits, labels):
+            return cross_entropy(logits, labels, config.ignore_index,
+                                 weights, config.reduction)
+    elif config.loss_type == 'ohem':
+        def fn(logits, labels):
+            return ohem_cross_entropy(logits, labels, config.ohem_thrs,
+                                      ignore_index=config.ignore_index)
+    else:
+        raise NotImplementedError(f'Unsupported loss type: {config.loss_type}')
+    return fn
+
+
+def get_detail_loss_fn(config):
+    """Matches reference core/loss.py:74-77."""
+    def fn(logits, targets):
+        return detail_loss(logits, targets, config.dice_loss_coef,
+                           config.bce_loss_coef)
+    return fn
+
+
+def get_kd_loss_fn(config):
+    """Matches reference core/loss.py:80-87."""
+    def fn(student_logits, teacher_logits):
+        return kd_loss(student_logits, teacher_logits, config.kd_loss_type,
+                       config.kd_temperature)
+    return fn
+
+
+__all__ = ['bce_with_logits', 'cross_entropy', 'detail_loss', 'dice_loss',
+           'kd_loss', 'laplacian_pyramid', 'ohem_cross_entropy',
+           'get_loss_fn', 'get_detail_loss_fn', 'get_kd_loss_fn']

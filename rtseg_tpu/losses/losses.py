@@ -1,0 +1,155 @@
+"""Segmentation losses, all static-shape and jit-safe.
+
+Re-designs of reference core/loss.py:6-87:
+
+  * OHEM cross-entropy (OhemCELoss, core/loss.py:6-20): the torch version
+    builds a dynamic-length tensor (`loss[loss > thresh]` / topk fallback).
+    Under XLA everything must be static-shape, so the same selection rule —
+    "keep pixels with loss > -log(thresh), but at least n_valid/16 of the
+    hardest" — is expressed as a mask: sort losses descending once, a pixel is
+    kept iff (loss > thresh) OR (its rank < n_min). The mean over kept pixels
+    is a masked sum / count. Identical semantics, fixed shapes, one sort.
+
+  * Dice / Detail loss (core/loss.py:23-52): dice over flattened per-image
+    maps + BCE-with-logits, weighted sum.
+
+  * KD loss (kd_loss_fn, core/loss.py:80-87): KL(teacher||student) with
+    temperature^2 scaling (batchmean), or MSE on raw logits.
+
+Inputs are NHWC logits (B, H, W, C) and integer labels (B, H, W).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _log_softmax(logits):
+    return jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+
+
+def cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray,
+                  ignore_index: int = 255,
+                  class_weights: Optional[jnp.ndarray] = None,
+                  reduction: str = 'mean') -> jnp.ndarray:
+    """Per-pixel CE with ignore_index semantics of torch nn.CrossEntropyLoss."""
+    num_class = logits.shape[-1]
+    valid = labels != ignore_index
+    safe = jnp.where(valid, labels, 0).astype(jnp.int32)
+    logp = _log_softmax(logits)
+    nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    if class_weights is not None:
+        w = jnp.asarray(class_weights, jnp.float32)[safe]
+    else:
+        w = jnp.ones_like(nll)
+    nll = jnp.where(valid, nll * w, 0.0)
+    if reduction == 'none':
+        return nll
+    if reduction == 'sum':
+        return nll.sum()
+    # torch mean reduction divides by the summed weight of non-ignored targets
+    denom = jnp.maximum(jnp.where(valid, w, 0.0).sum(), 1e-8)
+    return nll.sum() / denom
+
+
+def ohem_cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray,
+                       thresh: float = 0.7, n_min_divisor: int = 16,
+                       ignore_index: int = 255) -> jnp.ndarray:
+    """Online hard example mining CE (reference core/loss.py:6-20).
+
+    thresh is a probability; pixels with CE loss above -log(thresh) are hard.
+    At least n_valid/n_min_divisor hardest pixels are always kept.
+    """
+    loss_thresh = -jnp.log(jnp.asarray(thresh, jnp.float32))
+    valid = (labels != ignore_index).reshape(-1)
+    pix = cross_entropy(logits, labels, ignore_index,
+                        reduction='none').reshape(-1)
+    n_valid = valid.sum()
+    n_min = n_valid // n_min_divisor
+
+    # rank via one descending sort; invalid pixels carry loss 0 so they sort
+    # last and are additionally masked out of both branches.
+    order = jnp.argsort(-pix)
+    rank = jnp.empty_like(order).at[order].set(jnp.arange(pix.shape[0]))
+    keep = valid & ((pix > loss_thresh) | (rank < n_min))
+    cnt = jnp.maximum(keep.sum(), 1)
+    return jnp.where(keep, pix, 0.0).sum() / cnt
+
+
+def dice_loss(logits: jnp.ndarray, targets: jnp.ndarray,
+              smooth: float = 1.0) -> jnp.ndarray:
+    """Dice per-sample, averaged over the batch (reference DiceLoss,
+    core/loss.py:23-35). NOTE: the reference computes dice on *raw logits*,
+    not sigmoid probabilities — reproduced faithfully here since the detail
+    head was trained/benchmarked with that behavior."""
+    b = logits.shape[0]
+    p = logits.astype(jnp.float32).reshape(b, -1)
+    t = targets.astype(jnp.float32).reshape(b, -1)
+    inter = (p * t).sum(axis=1)
+    per = 1.0 - (2.0 * inter + smooth) / (p.sum(axis=1) + t.sum(axis=1) + smooth)
+    return per.mean()
+
+
+def bce_with_logits(logits: jnp.ndarray, targets: jnp.ndarray) -> jnp.ndarray:
+    x = logits.astype(jnp.float32)
+    t = targets.astype(jnp.float32)
+    return jnp.mean(jnp.maximum(x, 0) - x * t + jnp.log1p(jnp.exp(-jnp.abs(x))))
+
+
+def detail_loss(logits: jnp.ndarray, targets: jnp.ndarray,
+                dice_coef: float = 1.0, bce_coef: float = 1.0) -> jnp.ndarray:
+    """STDC detail head loss: dice + BCE (reference DetailLoss core/loss.py:38-52)."""
+    return (dice_coef * dice_loss(logits, targets)
+            + bce_coef * bce_with_logits(logits, targets))
+
+
+def kd_loss(student_logits: jnp.ndarray, teacher_logits: jnp.ndarray,
+            kd_type: str = 'kl_div', temperature: float = 4.0) -> jnp.ndarray:
+    """Distillation loss (reference kd_loss_fn core/loss.py:80-87).
+
+    kl_div: T^2 * mean(softmax(t/T) * (log softmax(t/T) - log_softmax(s/T))).
+    The mean is over *all elements including the class axis* — torch
+    F.kl_div's default 'mean' reduction, which the reference relies on
+    (core/loss.py:82-83) — i.e. batchmean / num_class.
+    mse: plain MSE on logits.
+    """
+    if kd_type == 'mse':
+        return jnp.mean((student_logits.astype(jnp.float32)
+                         - teacher_logits.astype(jnp.float32)) ** 2)
+    T = temperature
+    s = jax.nn.log_softmax(student_logits.astype(jnp.float32) / T, axis=-1)
+    t = jax.nn.softmax(teacher_logits.astype(jnp.float32) / T, axis=-1)
+    pointwise = t * (jnp.log(jnp.clip(t, 1e-12)) - s)
+    return (T * T) * jnp.mean(pointwise)
+
+
+def laplacian_pyramid(masks: jnp.ndarray) -> jnp.ndarray:
+    """Fixed-kernel Laplacian pyramid of the label map — step 1 of the STDC
+    detail-head ground truth (reference LaplacianConv, models/stdc.py:131-147).
+
+    Convs the float mask with a fixed 3x3 Laplacian at strides {1,2,4},
+    nearest-upsamples the strided outputs back, and stacks 3 channels.
+    Step 2 lives in the train step: the *model's own* 1x1 `detail_conv`
+    collapses these to one channel (stop-gradient) which is then hard-
+    thresholded at config.detail_thrs (reference core/seg_trainer.py:74-81).
+
+    masks: (B, H, W) int -> (B, H, W, 3) float.
+    """
+    from ..ops import resize_nearest
+    x = masks.astype(jnp.float32)[..., None]                  # B,H,W,1
+    k = jnp.array([[-1., -1., -1.], [-1., 8., -1.], [-1., -1., -1.]],
+                  jnp.float32).reshape(3, 3, 1, 1)
+    h, w = x.shape[1], x.shape[2]
+    chans = []
+    for stride in (1, 2, 4):
+        y = jax.lax.conv_general_dilated(
+            x, k, window_strides=(stride, stride), padding=((1, 1), (1, 1)),
+            dimension_numbers=('NHWC', 'HWIO', 'NHWC'))
+        if stride > 1:
+            y = resize_nearest(y, (h, w))
+        chans.append(y)
+    return jnp.concatenate(chans, axis=-1)

@@ -1,0 +1,307 @@
+"""Flax module vocabulary for the model zoo.
+
+This is the TPU-native re-design of reference models/modules.py:1-166 — the op
+set that all 36 architectures are built from. Differences by design:
+
+  * NHWC layout (TPU-preferred; channels on the 128-lane axis).
+  * BatchNorm carries an optional collective `axis_name` so cross-replica
+    (sync) BN is part of the module, not a post-hoc wrapper conversion
+    (reference utils/parallel.py:36-37).
+  * Convs compute in bf16 (configurable) with fp32 params/BN statistics —
+    replaces torch AMP autocast (reference core/seg_trainer.py:46).
+  * `train` is an explicit call argument (functional, jit-stable) instead of
+    module state.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..ops import adaptive_avg_pool, resize_bilinear
+
+Size2 = Union[int, Tuple[int, int]]
+
+# Module-level default collective axis for sync-BN. Set once by the trainer
+# before building the train step; None => per-replica statistics.
+_BN_AXIS: dict = {'name': None}
+
+
+def set_bn_axis(name: Optional[str]) -> None:
+    _BN_AXIS['name'] = name
+
+
+def get_bn_axis() -> Optional[str]:
+    return _BN_AXIS['name']
+
+
+def _pair(v: Size2) -> Tuple[int, int]:
+    return (v, v) if isinstance(v, int) else (int(v[0]), int(v[1]))
+
+
+# ------------------------------------------------------------------ activation
+
+class PReLU(nn.Module):
+    """torch-compatible PReLU: one learned negative slope (init 0.25)."""
+    @nn.compact
+    def __call__(self, x):
+        a = self.param('alpha', lambda k: jnp.full((1,), 0.25, jnp.float32))
+        return jnp.where(x >= 0, x, a.astype(x.dtype) * x)
+
+
+def _glu(x):
+    a, b = jnp.split(x, 2, axis=-1)
+    return a * jax.nn.sigmoid(b)
+
+
+# 16-entry hub mirroring reference models/modules.py:114-122.
+ACTIVATIONS: dict = {
+    'relu': jax.nn.relu,
+    'relu6': lambda x: jnp.clip(x, 0, 6),
+    'leakyrelu': lambda x: jax.nn.leaky_relu(x, 0.01),
+    'prelu': 'prelu',                      # parameterized; handled in Activation
+    'celu': jax.nn.celu,
+    'elu': jax.nn.elu,
+    'hardswish': jax.nn.hard_swish,
+    'hardtanh': lambda x: jnp.clip(x, -1, 1),
+    'gelu': lambda x: jax.nn.gelu(x, approximate=False),
+    'glu': _glu,
+    'selu': jax.nn.selu,
+    'silu': jax.nn.silu,
+    'sigmoid': jax.nn.sigmoid,
+    'softmax': lambda x: jax.nn.softmax(x, axis=-1),
+    'tanh': jnp.tanh,
+    'none': lambda x: x,
+}
+
+
+class Activation(nn.Module):
+    """Name-dispatched activation (reference models/modules.py:111-131)."""
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x):
+        act = self.act_type.lower()
+        if act not in ACTIVATIONS:
+            raise NotImplementedError(f'Unsupported activation type: {act}')
+        if act == 'prelu':
+            return PReLU(name='prelu')(x)
+        return ACTIVATIONS[act](x)
+
+
+# ------------------------------------------------------------------------- BN
+
+class BatchNorm(nn.Module):
+    """BatchNorm2d with optional cross-replica statistics.
+
+    When `get_bn_axis()` names a mapped mesh axis (the trainer sets 'data'
+    when config.sync_bn), batch statistics are averaged across replicas via
+    lax.pmean inside the collective context — the TPU-native version of
+    nn.SyncBatchNorm.convert_sync_batchnorm (reference utils/parallel.py:36-37).
+    """
+    momentum: float = 0.9            # flax convention: ema = m*ema + (1-m)*new
+    epsilon: float = 1e-5
+    use_scale: bool = True
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        return nn.BatchNorm(
+            use_running_average=not train,
+            momentum=self.momentum,
+            epsilon=self.epsilon,
+            use_scale=self.use_scale,
+            use_bias=self.use_bias,
+            dtype=x.dtype,
+            param_dtype=jnp.float32,
+            axis_name=get_bn_axis() if train else None,
+            name='bn')(x)
+
+
+# ------------------------------------------------------------------ conv cores
+
+class Conv(nn.Module):
+    """Conv2d wrapper: torch-style symmetric padding from (kernel, dilation),
+    grouped/dilated/asymmetric kernels, NHWC, fp32 params."""
+    out_channels: int
+    kernel_size: Size2 = 3
+    stride: Size2 = 1
+    dilation: Size2 = 1
+    groups: int = 1
+    use_bias: bool = False
+    padding: Optional[Any] = None        # None => torch 'same-ish' from kernel
+
+    @nn.compact
+    def __call__(self, x):
+        kh, kw = _pair(self.kernel_size)
+        dh, dw = _pair(self.dilation)
+        if self.padding is None:
+            pad = ((kh - 1) // 2 * dh, (kw - 1) // 2 * dw)
+            padding = ((pad[0], pad[0]), (pad[1], pad[1]))
+        elif isinstance(self.padding, int):
+            padding = ((self.padding, self.padding),
+                       (self.padding, self.padding))
+        else:
+            padding = self.padding
+        return nn.Conv(
+            features=self.out_channels,
+            kernel_size=(kh, kw),
+            strides=_pair(self.stride),
+            kernel_dilation=(dh, dw),
+            feature_group_count=self.groups,
+            use_bias=self.use_bias,
+            padding=padding,
+            dtype=x.dtype,
+            param_dtype=jnp.float32,
+            name='conv')(x)
+
+
+def conv3x3(out_channels, stride=1, bias=False, name=None):
+    return Conv(out_channels, 3, stride, use_bias=bias, name=name)
+
+
+def conv1x1(out_channels, stride=1, bias=False, name=None):
+    return Conv(out_channels, 1, stride, use_bias=bias, name=name)
+
+
+class ConvBNAct(nn.Module):
+    """Conv -> BN -> Activation (reference models/modules.py:73-85)."""
+    out_channels: int
+    kernel_size: Size2 = 3
+    stride: Size2 = 1
+    dilation: Size2 = 1
+    groups: int = 1
+    bias: bool = False
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = Conv(self.out_channels, self.kernel_size, self.stride,
+                 self.dilation, self.groups, self.bias)(x)
+        x = BatchNorm()(x, train)
+        return Activation(self.act_type)(x)
+
+
+class DWConvBNAct(nn.Module):
+    """Depth-wise conv -> BN -> act (reference models/modules.py:46-59).
+    out_channels must be a multiple of the input channel count."""
+    out_channels: int
+    kernel_size: Size2 = 3
+    stride: Size2 = 1
+    dilation: Size2 = 1
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        groups = x.shape[-1]
+        x = Conv(self.out_channels, self.kernel_size, self.stride,
+                 self.dilation, groups, use_bias=False)(x)
+        x = BatchNorm()(x, train)
+        return Activation(self.act_type)(x)
+
+
+class PWConvBNAct(nn.Module):
+    """Point-wise conv -> BN -> act (reference models/modules.py:63-69;
+    note bias defaults True there)."""
+    out_channels: int
+    act_type: str = 'relu'
+    bias: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = Conv(self.out_channels, 1, use_bias=self.bias)(x)
+        x = BatchNorm()(x, train)
+        return Activation(self.act_type)(x)
+
+
+class DSConvBNAct(nn.Module):
+    """Depth-wise separable conv (reference models/modules.py:36-41)."""
+    out_channels: int
+    kernel_size: Size2 = 3
+    stride: Size2 = 1
+    dilation: Size2 = 1
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = DWConvBNAct(x.shape[-1], self.kernel_size, self.stride,
+                        self.dilation, self.act_type)(x, train)
+        return PWConvBNAct(self.out_channels, self.act_type)(x, train)
+
+
+class DeConvBNAct(nn.Module):
+    """Transposed conv -> BN -> act (reference models/modules.py:89-108).
+
+    Matches torch ConvTranspose2d geometry: kernel 2*scale-1, stride=scale,
+    padding=(k-1)//2, output_padding=scale-1 => exact scale× upsampling.
+    """
+    out_channels: int
+    scale_factor: int = 2
+    kernel_size: Optional[int] = None
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        scale = self.scale_factor
+        k = self.kernel_size if self.kernel_size is not None else 2 * scale - 1
+        pad = (k - 1) // 2
+        out_pad = scale - 1
+        # torch output size: (H-1)*s - 2p + k + out_pad = H*s for defaults.
+        # lax.conv_transpose padding spec: amount of padding on the *output*
+        # grid: lo = k - 1 - p, hi = k - 1 - p + out_pad.
+        lo = k - 1 - pad
+        hi = k - 1 - pad + out_pad
+        x = nn.ConvTranspose(
+            features=self.out_channels,
+            kernel_size=(k, k),
+            strides=(scale, scale),
+            padding=((lo, hi), (lo, hi)),
+            use_bias=True,
+            dtype=x.dtype,
+            param_dtype=jnp.float32,
+            transpose_kernel=True,
+            name='deconv')(x)
+        x = BatchNorm()(x, train)
+        return Activation(self.act_type)(x)
+
+
+# ------------------------------------------------------------- composite heads
+
+class PyramidPoolingModule(nn.Module):
+    """PSPNet-style PPM (reference models/modules.py:134-158): 4 stages of
+    adaptive-avg-pool to (1,2,4,6) + bare 1x1 conv, bilinear upsample
+    (align_corners), concat with the input, fuse with a 1x1 PWConvBNAct."""
+    out_channels: int
+    act_type: str = 'relu'
+    bias: bool = False
+    pool_sizes: Sequence[int] = (1, 2, 4, 6)
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h, w = x.shape[1], x.shape[2]
+        hid = max(1, x.shape[-1] // 4)
+        feats = [x]
+        for i, ps in enumerate(self.pool_sizes):
+            y = adaptive_avg_pool(x, ps)
+            y = Conv(hid, 1, use_bias=False, name=f'stage{i + 1}')(y)
+            y = resize_bilinear(y, (h, w), align_corners=True)
+            feats.append(y)
+        x = jnp.concatenate(feats, axis=-1)
+        return PWConvBNAct(self.out_channels, act_type=self.act_type,
+                           bias=self.bias)(x, train)
+
+
+class SegHead(nn.Module):
+    """3x3 ConvBNAct -> bias-free 1x1 conv to classes
+    (reference models/modules.py:161-166; hid default 128)."""
+    num_class: int
+    act_type: str = 'relu'
+    hid_channels: int = 128
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = ConvBNAct(self.hid_channels, 3, act_type=self.act_type)(x, train)
+        return Conv(self.num_class, 1, use_bias=False)(x)

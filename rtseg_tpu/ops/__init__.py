@@ -1,0 +1,12 @@
+from .resize import (resize_bilinear, resize_nearest, pixel_shuffle,
+                     scale_resize)
+from .pool import (max_pool, avg_pool, max_pool_argmax_2x2, max_unpool_2x2,
+                   adaptive_avg_pool, adaptive_max_pool, global_avg_pool)
+from .shuffle import channel_shuffle, channel_split
+
+__all__ = [
+    'resize_bilinear', 'resize_nearest', 'pixel_shuffle', 'scale_resize',
+    'max_pool', 'avg_pool', 'max_pool_argmax_2x2', 'max_unpool_2x2',
+    'adaptive_avg_pool', 'adaptive_max_pool', 'global_avg_pool',
+    'channel_shuffle', 'channel_split',
+]

@@ -1,0 +1,139 @@
+"""Pooling ops (NHWC), including argmax-pooling + unpooling.
+
+The reference needs MaxPool2d(return_indices=True) + MaxUnpool2d for ENet
+(reference models/enet.py:131,139) and SegNet (models/segnet.py:54,65); JAX has
+no native unpool, so pooling here *captures* the within-window argmax with
+static shapes and unpooling scatters values back via a one-hot multiply — both
+compile to dense reshapes/selects that the TPU vector unit handles well.
+
+Adaptive pooling (PyramidPoolingModule, DAPPM, SE blocks) is implemented with
+torch's exact window math — start=floor(i*H/out), end=ceil((i+1)*H/out) — as a
+static unrolled loop over the (tiny) output grid.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Size2 = Union[int, Tuple[int, int]]
+
+
+def _pair(v: Size2) -> Tuple[int, int]:
+    return (v, v) if isinstance(v, int) else (int(v[0]), int(v[1]))
+
+
+# ------------------------------------------------------------------ plain pools
+
+def max_pool(x: jnp.ndarray, window: Size2, stride: Optional[Size2] = None,
+             padding: Size2 = 0) -> jnp.ndarray:
+    kh, kw = _pair(window)
+    sh, sw = _pair(stride if stride is not None else window)
+    ph, pw = _pair(padding)
+    neg = jnp.finfo(x.dtype).min if jnp.issubdtype(x.dtype, jnp.floating) \
+        else jnp.iinfo(x.dtype).min
+    return lax.reduce_window(
+        x, neg, lax.max, (1, kh, kw, 1), (1, sh, sw, 1),
+        ((0, 0), (ph, ph), (pw, pw), (0, 0)))
+
+
+def avg_pool(x: jnp.ndarray, window: Size2, stride: Optional[Size2] = None,
+             padding: Size2 = 0, count_include_pad: bool = True) -> jnp.ndarray:
+    kh, kw = _pair(window)
+    sh, sw = _pair(stride if stride is not None else window)
+    ph, pw = _pair(padding)
+    dtype = x.dtype
+    s = lax.reduce_window(
+        x.astype(jnp.float32), 0.0, lax.add, (1, kh, kw, 1), (1, sh, sw, 1),
+        ((0, 0), (ph, ph), (pw, pw), (0, 0)))
+    if count_include_pad or (ph == 0 and pw == 0):
+        out = s / float(kh * kw)
+    else:
+        ones = jnp.ones(x.shape[:3] + (1,), jnp.float32)
+        cnt = lax.reduce_window(
+            ones, 0.0, lax.add, (1, kh, kw, 1), (1, sh, sw, 1),
+            ((0, 0), (ph, ph), (pw, pw), (0, 0)))
+        out = s / cnt
+    return out.astype(dtype)
+
+
+# -------------------------------------------------------- argmax pool / unpool
+
+def max_pool_argmax_2x2(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """2x2/stride-2 max pool returning (values, within-window argmax in [0,4)).
+
+    The ENet/SegNet encoders only ever pool 2x2 stride 2, so the general
+    return_indices contract collapses to this static-shape special case.
+    Odd trailing rows/cols are truncated (torch floor-mode behavior).
+    """
+    n, h, w, c = x.shape
+    h2, w2 = h // 2, w // 2
+    xw = x[:, :h2 * 2, :w2 * 2, :].reshape(n, h2, 2, w2, 2, c)
+    xw = xw.transpose(0, 1, 3, 2, 4, 5).reshape(n, h2, w2, 4, c)
+    idx = jnp.argmax(xw, axis=3).astype(jnp.int32)          # (n,h2,w2,c)
+    vals = jnp.max(xw, axis=3)
+    return vals, idx
+
+
+def max_unpool_2x2(x: jnp.ndarray, idx: jnp.ndarray,
+                   out_hw: Optional[Tuple[int, int]] = None) -> jnp.ndarray:
+    """Inverse of max_pool_argmax_2x2: scatter each value to its argmax slot.
+
+    Implemented as one-hot * value (dense, static) instead of scatter — far
+    friendlier to XLA/TPU than gather/scatter with dynamic indices.
+    """
+    n, h2, w2, c = x.shape
+    onehot = jax.nn.one_hot(idx, 4, dtype=x.dtype)          # (n,h2,w2,c,4)
+    win = onehot * x[..., None]                             # value in argmax slot
+    win = win.transpose(0, 1, 2, 4, 3).reshape(n, h2, w2, 2, 2, c)
+    out = win.transpose(0, 1, 3, 2, 4, 5).reshape(n, h2 * 2, w2 * 2, c)
+    if out_hw is not None and out_hw != (h2 * 2, w2 * 2):
+        oh, ow = out_hw
+        out = jnp.pad(out, ((0, 0), (0, oh - h2 * 2), (0, ow - w2 * 2), (0, 0)))
+    return out
+
+
+# ----------------------------------------------------------- adaptive pooling
+
+def _adaptive_windows(in_size: int, out_size: int):
+    # torch adaptive pooling window math
+    starts = [(i * in_size) // out_size for i in range(out_size)]
+    ends = [-(-((i + 1) * in_size) // out_size) for i in range(out_size)]
+    return starts, ends
+
+
+def adaptive_avg_pool(x: jnp.ndarray, output_size: Size2) -> jnp.ndarray:
+    oh, ow = _pair(output_size)
+    n, h, w, c = x.shape
+    if h % oh == 0 and w % ow == 0:       # uniform windows: one fused reshape
+        return x.reshape(n, oh, h // oh, ow, w // ow, c).mean(axis=(2, 4))
+    hs, he = _adaptive_windows(h, oh)
+    ws, we = _adaptive_windows(w, ow)
+    rows = []
+    for i in range(oh):
+        band = x[:, hs[i]:he[i], :, :]
+        cells = [band[:, :, ws[j]:we[j], :].mean(axis=(1, 2)) for j in range(ow)]
+        rows.append(jnp.stack(cells, axis=1))
+    return jnp.stack(rows, axis=1)
+
+
+def adaptive_max_pool(x: jnp.ndarray, output_size: Size2) -> jnp.ndarray:
+    oh, ow = _pair(output_size)
+    n, h, w, c = x.shape
+    if h % oh == 0 and w % ow == 0:
+        return x.reshape(n, oh, h // oh, ow, w // ow, c).max(axis=(2, 4))
+    hs, he = _adaptive_windows(h, oh)
+    ws, we = _adaptive_windows(w, ow)
+    rows = []
+    for i in range(oh):
+        band = x[:, hs[i]:he[i], :, :]
+        cells = [band[:, :, ws[j]:we[j], :].max(axis=(1, 2)) for j in range(ow)]
+        rows.append(jnp.stack(cells, axis=1))
+    return jnp.stack(rows, axis=1)
+
+
+def global_avg_pool(x: jnp.ndarray, keepdims: bool = True) -> jnp.ndarray:
+    return x.mean(axis=(1, 2), keepdims=keepdims)

@@ -1,0 +1,101 @@
+"""Resize ops with PyTorch `F.interpolate` semantics, XLA-friendly.
+
+The reference zoo uses `F.interpolate(..., mode='bilinear', align_corners=True)`
+throughout (e.g. reference models/modules.py:153-156) and `nn.PixelShuffle`
+(models/farseenet.py:57-60,80-83). `jax.image.resize` implements half-pixel
+sampling only, so align-corners bilinear is built here from static gathers +
+lerps: everything is shape-static and fuses into a handful of XLA gathers.
+
+All ops are NHWC.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple, Union
+
+import jax.numpy as jnp
+
+Size2 = Union[int, Tuple[int, int], Sequence[int]]
+
+
+def _pair(size: Size2) -> Tuple[int, int]:
+    if isinstance(size, int):
+        return size, size
+    return int(size[0]), int(size[1])
+
+
+def _linear_weights(in_size: int, out_size: int, align_corners: bool):
+    """Source indices (lo, hi) and hi-weight for 1-D linear interpolation."""
+    out = jnp.arange(out_size, dtype=jnp.float32)
+    if align_corners:
+        src = out * ((in_size - 1) / max(out_size - 1, 1)) if out_size > 1 \
+            else jnp.zeros_like(out)
+    else:
+        src = jnp.clip((out + 0.5) * (in_size / out_size) - 0.5, 0.0, None)
+    lo = jnp.clip(jnp.floor(src).astype(jnp.int32), 0, in_size - 1)
+    hi = jnp.clip(lo + 1, 0, in_size - 1)
+    w = (src - lo.astype(jnp.float32)).astype(jnp.float32)
+    return lo, hi, w
+
+
+def resize_bilinear(x: jnp.ndarray, size: Size2, align_corners: bool = True
+                    ) -> jnp.ndarray:
+    """Bilinear resize of NHWC `x` to `size` = (H, W).
+
+    Matches torch F.interpolate(mode='bilinear') for both align_corners
+    settings; the zoo always uses align_corners=True.
+    """
+    out_h, out_w = _pair(size)
+    n, h, w, c = x.shape
+    if (h, w) == (out_h, out_w):
+        return x
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+
+    lo_h, hi_h, wh = _linear_weights(h, out_h, align_corners)
+    lo_w, hi_w, ww = _linear_weights(w, out_w, align_corners)
+
+    top = jnp.take(xf, lo_h, axis=1)
+    bot = jnp.take(xf, hi_h, axis=1)
+    rows = top + (bot - top) * wh[None, :, None, None]
+    left = jnp.take(rows, lo_w, axis=2)
+    right = jnp.take(rows, hi_w, axis=2)
+    out = left + (right - left) * ww[None, None, :, None]
+    return out.astype(dtype)
+
+
+def resize_nearest(x: jnp.ndarray, size: Size2) -> jnp.ndarray:
+    """Nearest resize of NHWC `x`, matching torch F.interpolate(mode='nearest')
+    index math: src = floor(dst * in / out)."""
+    out_h, out_w = _pair(size)
+    n, h, w, c = x.shape
+    if (h, w) == (out_h, out_w):
+        return x
+    idx_h = jnp.clip((jnp.arange(out_h) * h // out_h), 0, h - 1)
+    idx_w = jnp.clip((jnp.arange(out_w) * w // out_w), 0, w - 1)
+    return jnp.take(jnp.take(x, idx_h, axis=1), idx_w, axis=2)
+
+
+def pixel_shuffle(x: jnp.ndarray, upscale_factor: int) -> jnp.ndarray:
+    """NHWC equivalent of torch nn.PixelShuffle (farseenet.py:60,83).
+
+    Channel index c*r^2 + r1*r + r2 of the input maps to output channel c at
+    spatial offset (r1, r2) — same ordering as torch's NCHW op, so ported
+    weights produce identical outputs.
+    """
+    r = upscale_factor
+    n, h, w, crr = x.shape
+    c = crr // (r * r)
+    x = x.reshape(n, h, w, c, r, r)
+    x = x.transpose(0, 1, 4, 2, 5, 3)       # n, h, r1, w, r2, c
+    return x.reshape(n, h * r, w * r, c)
+
+
+def scale_resize(x: jnp.ndarray, scale_factor: float, mode: str = 'bilinear',
+                 align_corners: bool = True) -> jnp.ndarray:
+    """F.interpolate(scale_factor=...) — output size floor(in * scale)."""
+    n, h, w, c = x.shape
+    size = (int(h * scale_factor), int(w * scale_factor))
+    if mode == 'bilinear':
+        return resize_bilinear(x, size, align_corners)
+    return resize_nearest(x, size)

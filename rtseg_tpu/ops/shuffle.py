@@ -1,0 +1,25 @@
+"""Channel reordering ops (NHWC).
+
+channel_shuffle matches reference models/modules.py:18-32 (ShuffleNet-style
+group transpose) so that split/shuffle architectures (LEDNet SSnbt units,
+Lite-HRNet shuffle blocks) reproduce the same channel permutation.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def channel_shuffle(x: jnp.ndarray, groups: int = 2) -> jnp.ndarray:
+    """Transpose channels across `groups`: channel g*cpg + i -> i*groups + g."""
+    n, h, w, c = x.shape
+    cpg = c // groups
+    x = x.reshape(n, h, w, groups, cpg)
+    x = x.swapaxes(3, 4)
+    return x.reshape(n, h, w, c)
+
+
+def channel_split(x: jnp.ndarray, num: int = 2):
+    """Even channel split along the feature axis (torch.chunk semantics for
+    divisible channel counts, which is all the zoo uses)."""
+    return jnp.split(x, num, axis=-1)

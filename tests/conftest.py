@@ -1,0 +1,30 @@
+"""Test harness: force an 8-device virtual CPU platform BEFORE jax imports.
+
+This is the distributed-without-a-cluster strategy from SURVEY.md §4: shard_map
+train steps, gradient psum, cross-replica BN, and host-sharded input are all
+exercised on a fake 8-device mesh in CI with no TPU attached.
+"""
+
+import os
+
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+flags = os.environ.get('XLA_FLAGS', '')
+if '--xla_force_host_platform_device_count' not in flags:
+    os.environ['XLA_FLAGS'] = (
+        flags + ' --xla_force_host_platform_device_count=8').strip()
+
+import jax  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope='session')
+def devices():
+    return jax.devices()
+
+
+@pytest.fixture(scope='session')
+def mesh8():
+    from jax.sharding import Mesh
+    import numpy as np
+    return Mesh(np.array(jax.devices()[:8]).reshape(8), ('data',))

@@ -1,0 +1,122 @@
+"""Numeric tests for rtseg_tpu.losses vs torch reference semantics
+(reference core/loss.py:6-87, reimplemented in torch here for golden values)."""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+import jax.numpy as jnp
+
+from rtseg_tpu import losses
+
+
+def _logits_labels(b=2, h=8, w=8, c=5, ignore_frac=0.2, seed=0):
+    rng = np.random.RandomState(seed)
+    logits = rng.randn(b, h, w, c).astype(np.float32) * 3
+    labels = rng.randint(0, c, size=(b, h, w)).astype(np.int32)
+    mask = rng.rand(b, h, w) < ignore_frac
+    labels[mask] = 255
+    return logits, labels
+
+
+def test_cross_entropy_matches_torch():
+    logits, labels = _logits_labels()
+    got = float(losses.cross_entropy(jnp.asarray(logits), jnp.asarray(labels)))
+    t = F.cross_entropy(torch.from_numpy(logits).permute(0, 3, 1, 2),
+                        torch.from_numpy(labels).long(), ignore_index=255)
+    np.testing.assert_allclose(got, t.item(), rtol=1e-5)
+
+
+def test_cross_entropy_weighted_matches_torch():
+    logits, labels = _logits_labels(c=4)
+    w = np.array([0.5, 2.0, 1.0, 3.0], np.float32)
+    got = float(losses.cross_entropy(jnp.asarray(logits), jnp.asarray(labels),
+                                     class_weights=jnp.asarray(w)))
+    t = F.cross_entropy(torch.from_numpy(logits).permute(0, 3, 1, 2),
+                        torch.from_numpy(labels).long(), ignore_index=255,
+                        weight=torch.from_numpy(w))
+    np.testing.assert_allclose(got, t.item(), rtol=1e-5)
+
+
+def _torch_ohem(logits, labels, thresh=0.7, ignore_index=255):
+    # reference OhemCELoss forward (core/loss.py:13-20), CPU
+    th = -torch.log(torch.tensor(thresh, dtype=torch.float))
+    lt = torch.from_numpy(logits).permute(0, 3, 1, 2)
+    lb = torch.from_numpy(labels).long()
+    n_min = lb[lb != ignore_index].numel() // 16
+    loss = F.cross_entropy(lt, lb, ignore_index=ignore_index,
+                           reduction='none').view(-1)
+    loss_hard = loss[loss > th]
+    if loss_hard.numel() < n_min:
+        loss_hard, _ = loss.topk(n_min)
+    return loss_hard.mean().item()
+
+
+@pytest.mark.parametrize('scale,thresh', [(3.0, 0.7), (0.01, 0.7), (3.0, 0.05)])
+def test_ohem_matches_torch(scale, thresh):
+    # scale=0.01 -> uniformly easy pixels -> exercises the topk(n_min) branch
+    logits, labels = _logits_labels(seed=3)
+    logits = logits * (scale / 3.0)
+    got = float(losses.ohem_cross_entropy(jnp.asarray(logits),
+                                          jnp.asarray(labels), thresh))
+    want = _torch_ohem(logits, labels, thresh)
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+def test_dice_matches_reference_raw_logit_behavior():
+    rng = np.random.RandomState(0)
+    logits = rng.randn(3, 1, 6, 6).astype(np.float32)
+    targets = (rng.rand(3, 1, 6, 6) > 0.5).astype(np.float32)
+    lt = torch.flatten(torch.from_numpy(logits), 1)
+    tt = torch.flatten(torch.from_numpy(targets), 1)
+    inter = torch.sum(lt * tt, dim=1)
+    want = torch.mean(1 - (2 * inter + 1) / (lt.sum(1) + tt.sum(1) + 1)).item()
+    got = float(losses.dice_loss(jnp.asarray(logits), jnp.asarray(targets)))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_detail_loss_matches_torch():
+    rng = np.random.RandomState(1)
+    logits = rng.randn(2, 8, 8, 1).astype(np.float32)
+    targets = (rng.rand(2, 8, 8, 1) > 0.7).astype(np.float32)
+    got = float(losses.detail_loss(jnp.asarray(logits), jnp.asarray(targets),
+                                   dice_coef=1.0, bce_coef=2.0))
+    lt, tt = torch.from_numpy(logits), torch.from_numpy(targets)
+    l2, t2 = torch.flatten(lt, 1), torch.flatten(tt, 1)
+    inter = torch.sum(l2 * t2, dim=1)
+    dice = torch.mean(1 - (2 * inter + 1) / (l2.sum(1) + t2.sum(1) + 1))
+    bce = F.binary_cross_entropy_with_logits(lt, tt)
+    np.testing.assert_allclose(got, (dice + 2.0 * bce).item(), rtol=1e-5)
+
+
+@pytest.mark.parametrize('kd_type', ['kl_div', 'mse'])
+def test_kd_matches_torch(kd_type):
+    rng = np.random.RandomState(2)
+    s = rng.randn(2, 4, 4, 6).astype(np.float32)
+    t = rng.randn(2, 4, 4, 6).astype(np.float32)
+    got = float(losses.kd_loss(jnp.asarray(s), jnp.asarray(t), kd_type, 4.0))
+    st = torch.from_numpy(s).permute(0, 3, 1, 2)
+    tt = torch.from_numpy(t).permute(0, 3, 1, 2)
+    if kd_type == 'kl_div':
+        want = (F.kl_div(F.log_softmax(st / 4.0, dim=1),
+                         F.softmax(tt / 4.0, dim=1)) * 16).item()
+    else:
+        want = F.mse_loss(st, tt).item()
+    np.testing.assert_allclose(got, want, rtol=2e-3)
+
+
+def test_laplacian_pyramid_matches_torch():
+    rng = np.random.RandomState(4)
+    masks = rng.randint(0, 19, size=(2, 16, 16)).astype(np.int32)
+    got = np.asarray(losses.laplacian_pyramid(jnp.asarray(masks)))
+
+    k = torch.tensor([[[[-1., -1., -1.], [-1., 8., -1.], [-1., -1., -1.]]]])
+    lbl = torch.from_numpy(masks).float().unsqueeze(1)
+    l1 = F.conv2d(lbl, k, stride=1, padding=1)
+    l2 = F.conv2d(lbl, k, stride=2, padding=1)
+    l4 = F.conv2d(lbl, k, stride=4, padding=1)
+    l2 = F.interpolate(l2, (16, 16), mode='nearest')
+    l4 = F.interpolate(l4, (16, 16), mode='nearest')
+    want = torch.cat([l1, l2, l4], dim=1).permute(0, 2, 3, 1).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-4)

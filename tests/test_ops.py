@@ -1,0 +1,104 @@
+"""Golden-value tests for rtseg_tpu.ops vs torch (CPU) semantics."""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+import jax.numpy as jnp
+
+from rtseg_tpu import ops
+
+
+def _rand(*shape, seed=0):
+    rng = np.random.RandomState(seed)
+    return rng.randn(*shape).astype(np.float32)
+
+
+@pytest.mark.parametrize('align', [True, False])
+@pytest.mark.parametrize('out_hw', [(8, 8), (13, 7), (32, 64), (3, 3)])
+def test_resize_bilinear_matches_torch(align, out_hw):
+    x = _rand(2, 10, 14, 3)
+    got = np.asarray(ops.resize_bilinear(jnp.asarray(x), out_hw, align))
+    t = F.interpolate(torch.from_numpy(x).permute(0, 3, 1, 2), size=out_hw,
+                      mode='bilinear', align_corners=align)
+    want = t.permute(0, 2, 3, 1).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+@pytest.mark.parametrize('out_hw', [(8, 8), (20, 28), (5, 9)])
+def test_resize_nearest_matches_torch(out_hw):
+    x = _rand(1, 10, 14, 4)
+    got = np.asarray(ops.resize_nearest(jnp.asarray(x), out_hw))
+    t = F.interpolate(torch.from_numpy(x).permute(0, 3, 1, 2), size=out_hw,
+                      mode='nearest')
+    want = t.permute(0, 2, 3, 1).numpy()
+    np.testing.assert_allclose(got, want)
+
+
+@pytest.mark.parametrize('r', [2, 3])
+def test_pixel_shuffle_matches_torch(r):
+    x = _rand(2, 4, 5, 6 * r * r)
+    got = np.asarray(ops.pixel_shuffle(jnp.asarray(x), r))
+    t = F.pixel_shuffle(torch.from_numpy(x).permute(0, 3, 1, 2), r)
+    want = t.permute(0, 2, 3, 1).numpy()
+    np.testing.assert_allclose(got, want)
+
+
+def test_channel_shuffle_matches_torch_impl():
+    x = _rand(2, 3, 3, 8)
+    got = np.asarray(ops.channel_shuffle(jnp.asarray(x), 2))
+    xt = torch.from_numpy(x).permute(0, 3, 1, 2)
+    n, c, h, w = xt.shape
+    want = (xt.view(n, 2, c // 2, h, w).transpose(1, 2).contiguous()
+            .view(n, c, h, w).permute(0, 2, 3, 1).numpy())
+    np.testing.assert_allclose(got, want)
+
+
+@pytest.mark.parametrize('k,s,p', [(2, 2, 0), (3, 2, 1), (3, 1, 1)])
+def test_max_pool_matches_torch(k, s, p):
+    x = _rand(2, 12, 16, 5)
+    got = np.asarray(ops.max_pool(jnp.asarray(x), k, s, p))
+    t = F.max_pool2d(torch.from_numpy(x).permute(0, 3, 1, 2), k, s, p)
+    want = t.permute(0, 2, 3, 1).numpy()
+    np.testing.assert_allclose(got, want)
+
+
+@pytest.mark.parametrize('k,s,p', [(2, 2, 0), (3, 2, 1)])
+def test_avg_pool_matches_torch(k, s, p):
+    x = _rand(2, 12, 16, 5)
+    got = np.asarray(ops.avg_pool(jnp.asarray(x), k, s, p))
+    t = F.avg_pool2d(torch.from_numpy(x).permute(0, 3, 1, 2), k, s, p)
+    want = t.permute(0, 2, 3, 1).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_max_pool_unpool_roundtrip_matches_torch():
+    x = _rand(2, 8, 8, 4)
+    vals, idx = ops.max_pool_argmax_2x2(jnp.asarray(x))
+    un = np.asarray(ops.max_unpool_2x2(vals, idx))
+
+    xt = torch.from_numpy(x).permute(0, 3, 1, 2)
+    tv, ti = F.max_pool2d(xt, 2, 2, return_indices=True)
+    tu = F.max_unpool2d(tv, ti, 2, 2).permute(0, 2, 3, 1).numpy()
+    np.testing.assert_allclose(np.asarray(vals),
+                               tv.permute(0, 2, 3, 1).numpy())
+    np.testing.assert_allclose(un, tu)
+
+
+@pytest.mark.parametrize('out', [(1, 1), (2, 2), (3, 6), (5, 7)])
+def test_adaptive_avg_pool_matches_torch(out):
+    x = _rand(2, 12, 14, 3)
+    got = np.asarray(ops.adaptive_avg_pool(jnp.asarray(x), out))
+    t = F.adaptive_avg_pool2d(torch.from_numpy(x).permute(0, 3, 1, 2), out)
+    want = t.permute(0, 2, 3, 1).numpy()
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+@pytest.mark.parametrize('out', [(1, 1), (3, 6)])
+def test_adaptive_max_pool_matches_torch(out):
+    x = _rand(2, 12, 14, 3)
+    got = np.asarray(ops.adaptive_max_pool(jnp.asarray(x), out))
+    t = F.adaptive_max_pool2d(torch.from_numpy(x).permute(0, 3, 1, 2), out)
+    want = t.permute(0, 2, 3, 1).numpy()
+    np.testing.assert_allclose(got, want)
