@@ -1,0 +1,5 @@
+from .registry import (AUX_MODELS, DETAIL_HEAD_MODELS, MODEL_REGISTRY,
+                       get_model, get_teacher_model, model_class)
+
+__all__ = ['AUX_MODELS', 'DETAIL_HEAD_MODELS', 'MODEL_REGISTRY', 'get_model',
+           'get_teacher_model', 'model_class']

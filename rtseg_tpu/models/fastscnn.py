@@ -1,0 +1,100 @@
+"""Fast-SCNN (arXiv:1902.04502), TPU-native Flax build.
+
+Behavior parity with reference models/fastscnn.py:16-124: learning-to-
+downsample (3 stride-2 stages), MobileNetV2-style inverted-residual global
+branch + PPM, feature fusion at 1/8 resolution, DS-conv classifier, bilinear
+upsample (align_corners) to input size. NHWC, bf16-friendly.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from flax import linen as nn
+
+from ..nn import (Activation, BatchNorm, Conv, ConvBNAct, DSConvBNAct,
+                  DWConvBNAct, PWConvBNAct, PyramidPoolingModule)
+from ..ops import resize_bilinear
+
+
+class InvertedResidual(nn.Module):
+    out_channels: int
+    stride: int
+    expand_ratio: int = 6
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        hid = int(round(x.shape[-1] * self.expand_ratio))
+        use_res = self.stride == 1 and x.shape[-1] == self.out_channels
+        y = PWConvBNAct(hid, act_type=self.act_type)(x, train)
+        y = DWConvBNAct(hid, 3, self.stride, act_type=self.act_type)(y, train)
+        y = ConvBNAct(self.out_channels, 1, act_type='none')(y, train)
+        return x + y if use_res else y
+
+
+class LearningToDownsample(nn.Module):
+    out_channels: int = 64
+    hid_channels: Sequence[int] = (32, 48)
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        x = ConvBNAct(self.hid_channels[0], 3, 2, act_type=self.act_type)(x, train)
+        x = DSConvBNAct(self.hid_channels[1], 3, 2, act_type=self.act_type)(x, train)
+        return DSConvBNAct(self.out_channels, 3, 2, act_type=self.act_type)(x, train)
+
+
+class GlobalFeatureExtractor(nn.Module):
+    out_channels: int = 128
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        for t, c, n, s in ((6, 64, 3, 2), (6, 96, 2, 2), (6, 128, 3, 1)):
+            for i in range(n):
+                x = InvertedResidual(c, s if i == 0 else 1, t,
+                                     self.act_type)(x, train)
+        return PyramidPoolingModule(self.out_channels, act_type=self.act_type,
+                                    bias=True)(x, train)
+
+
+class FeatureFusionModule(nn.Module):
+    out_channels: int = 128
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, higher_res, lower_res, train=False):
+        size = higher_res.shape[1:3]
+        hi = Conv(self.out_channels, 1, name='higher_res_conv')(higher_res)
+        lo = resize_bilinear(lower_res, size, align_corners=True)
+        lo = DWConvBNAct(lo.shape[-1], 3, 1, act_type=self.act_type)(lo, train)
+        lo = Conv(self.out_channels, 1, name='lower_res_conv')(lo)
+        x = BatchNorm()(hi + lo, train)
+        return Activation(self.act_type)(x)
+
+
+class Classifier(nn.Module):
+    num_class: int
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        c = x.shape[-1]
+        x = DSConvBNAct(c, 3, 1, act_type=self.act_type)(x, train)
+        x = DSConvBNAct(c, 3, 1, act_type=self.act_type)(x, train)
+        return PWConvBNAct(self.num_class, act_type=self.act_type)(x, train)
+
+
+class FastSCNN(nn.Module):
+    num_class: int = 1
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        size = x.shape[1:3]
+        higher = LearningToDownsample(64, act_type=self.act_type)(x, train)
+        lower = GlobalFeatureExtractor(128, act_type=self.act_type)(higher, train)
+        x = FeatureFusionModule(128, act_type=self.act_type)(higher, lower, train)
+        x = Classifier(self.num_class, self.act_type)(x, train)
+        return resize_bilinear(x, size, align_corners=True)

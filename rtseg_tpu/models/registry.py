@@ -1,0 +1,103 @@
+"""Model registry — the public model API (reference models/__init__.py:47-99).
+
+`get_model(config)` dispatches on config.model:
+  * 'smp'            -> generic encoder-decoder hub (reference smp bridge,
+                        models/__init__.py:42-44,66-81)
+  * aux models       -> constructed with use_aux
+  * detail models    -> constructed with use_detail_head/use_aux (STDC)
+  * everything else  -> plain (num_class,) constructor; aux/detail raise.
+
+Imports are lazy so `import rtseg_tpu.models` stays fast and partial zoos
+remain usable while architectures land.
+"""
+
+from __future__ import annotations
+
+import importlib
+
+# name -> (submodule, class)
+MODEL_REGISTRY = {
+    'adscnet': ('adscnet', 'ADSCNet'),
+    'aglnet': ('aglnet', 'AGLNet'),
+    'bisenetv1': ('bisenetv1', 'BiSeNetv1'),
+    'bisenetv2': ('bisenetv2', 'BiSeNetv2'),
+    'canet': ('canet', 'CANet'),
+    'cfpnet': ('cfpnet', 'CFPNet'),
+    'cgnet': ('cgnet', 'CGNet'),
+    'contextnet': ('contextnet', 'ContextNet'),
+    'dabnet': ('dabnet', 'DABNet'),
+    'ddrnet': ('ddrnet', 'DDRNet'),
+    'dfanet': ('dfanet', 'DFANet'),
+    'edanet': ('edanet', 'EDANet'),
+    'enet': ('enet', 'ENet'),
+    'erfnet': ('erfnet', 'ERFNet'),
+    'esnet': ('esnet', 'ESNet'),
+    'espnet': ('espnet', 'ESPNet'),
+    'espnetv2': ('espnetv2', 'ESPNetv2'),
+    'farseenet': ('farseenet', 'FarSeeNet'),
+    'fastscnn': ('fastscnn', 'FastSCNN'),
+    'fddwnet': ('fddwnet', 'FDDWNet'),
+    'fpenet': ('fpenet', 'FPENet'),
+    'fssnet': ('fssnet', 'FSSNet'),
+    'icnet': ('icnet', 'ICNet'),
+    'lednet': ('lednet', 'LEDNet'),
+    'linknet': ('linknet', 'LinkNet'),
+    'lite_hrnet': ('lite_hrnet', 'LiteHRNet'),
+    'liteseg': ('liteseg', 'LiteSeg'),
+    'mininet': ('mininet', 'MiniNet'),
+    'mininetv2': ('mininetv2', 'MiniNetv2'),
+    'ppliteseg': ('pp_liteseg', 'PPLiteSeg'),
+    'regseg': ('regseg', 'RegSeg'),
+    'segnet': ('segnet', 'SegNet'),
+    'shelfnet': ('shelfnet', 'ShelfNet'),
+    'sqnet': ('sqnet', 'SQNet'),
+    'stdc': ('stdc', 'STDC'),
+    'swiftnet': ('swiftnet', 'SwiftNet'),
+}
+
+AUX_MODELS = ['bisenetv2', 'ddrnet', 'icnet']
+DETAIL_HEAD_MODELS = ['stdc']
+
+
+def model_class(name: str):
+    if name not in MODEL_REGISTRY:
+        raise NotImplementedError(f'Unsupported model type: {name}')
+    submodule, cls = MODEL_REGISTRY[name]
+    mod = importlib.import_module(f'.{submodule}', package=__package__)
+    return getattr(mod, cls)
+
+
+def get_model(config):
+    """Build the (uninitialized) Flax module for config.model."""
+    name = config.model
+    if name == 'smp':
+        from .smp import build_smp_model
+        return build_smp_model(config.encoder, config.decoder,
+                               config.num_class,
+                               encoder_weights=config.encoder_weights)
+    cls = model_class(name)
+    if name in AUX_MODELS:
+        return cls(num_class=config.num_class, use_aux=config.use_aux)
+    if name in DETAIL_HEAD_MODELS:
+        return cls(num_class=config.num_class,
+                   use_detail_head=config.use_detail_head,
+                   use_aux=config.use_aux)
+    if config.use_aux:
+        raise ValueError(f'Model {name} does not support auxiliary heads.')
+    if config.use_detail_head:
+        raise ValueError(f'Model {name} does not support detail heads.')
+    return cls(num_class=config.num_class)
+
+
+def get_teacher_model(config):
+    """Frozen teacher for KD (reference models/__init__.py:102-122): a generic
+    encoder-decoder whose params are loaded from config.teacher_ckpt by the
+    trainer (checkpoint loading is the trainer's job in this framework)."""
+    if not config.kd_training:
+        return None
+    from .smp import build_smp_model, SMP_DECODERS
+    if config.teacher_decoder not in SMP_DECODERS:
+        raise ValueError(
+            f'Unsupported teacher decoder type: {config.teacher_decoder}')
+    return build_smp_model(config.teacher_encoder, config.teacher_decoder,
+                           config.num_class, encoder_weights=None)

@@ -1,0 +1,59 @@
+"""Optimizer + LR schedule factories (reference utils/optimizer.py:4-21 and
+utils/scheduler.py:5-26), built on optax.
+
+The reference steps its scheduler per *iteration* (core/seg_trainer.py:111);
+optax schedules are naturally per-update so the semantics carry over directly.
+total_itrs math must match ceil(train_num / bs / devices) * epochs
+(utils/scheduler.py:6-10) — computed in SegConfig.resolve_schedule.
+"""
+
+from __future__ import annotations
+
+import optax
+
+
+def get_lr_schedule(config) -> optax.Schedule:
+    assert config.total_itrs > 0, 'call config.resolve_schedule() first'
+    if config.lr_policy == 'cos_warmup':
+        # torch OneCycleLR defaults: div_factor=25, final_div_factor=1e4
+        return optax.cosine_onecycle_schedule(
+            transition_steps=config.total_itrs,
+            peak_value=config.lr,
+            pct_start=config.warmup_epochs / config.total_epoch,
+            div_factor=25.0,
+            final_div_factor=1e4)
+    if config.lr_policy == 'linear':
+        # torch OneCycleLR(anneal_strategy='linear', pct_start=0): straight
+        # linear decay from peak to peak/ (div*final_div)
+        return optax.linear_onecycle_schedule(
+            transition_steps=config.total_itrs,
+            peak_value=config.lr,
+            pct_start=0.0,
+            pct_final=1.0,
+            div_factor=25.0,
+            final_div_factor=1e4)
+    if config.lr_policy == 'step':
+        return optax.exponential_decay(
+            init_value=config.lr,
+            transition_steps=config.step_size,
+            decay_rate=config.step_gamma,
+            staircase=True)
+    raise NotImplementedError(
+        f'Unsupported scheduler type: {config.lr_policy}')
+
+
+def get_optimizer(config) -> optax.GradientTransformation:
+    schedule = get_lr_schedule(config)
+    if config.optimizer_type == 'sgd':
+        # torch SGD(momentum, weight_decay): wd added to the raw gradient
+        # before the momentum buffer -> add_decayed_weights first.
+        return optax.chain(
+            optax.add_decayed_weights(config.weight_decay),
+            optax.trace(decay=config.momentum),
+            optax.scale_by_learning_rate(schedule))
+    if config.optimizer_type == 'adam':
+        return optax.adam(schedule)
+    if config.optimizer_type == 'adamw':
+        return optax.adamw(schedule)
+    raise NotImplementedError(
+        f'Unsupported optimizer type: {config.optimizer_type}')

@@ -1,0 +1,49 @@
+"""Functional train state.
+
+One pytree carries everything the reference keeps as mutable trainer objects:
+model params + BN statistics (reference model state_dict), optax state
+(optimizer + per-iteration LR schedule position), and the EMA shadow copy
+(reference ModelEmaV2, utils/model_ema.py:16-40 — note the EMA tracks the
+*entire* state_dict, i.e. both params and BN stats, reproduced here).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class TrainState:
+    step: jnp.ndarray                 # int32 scalar, == reference train_itrs
+    params: Any
+    batch_stats: Any
+    opt_state: Any
+    ema_params: Any
+    ema_batch_stats: Any
+
+
+def create_train_state(model, optimizer, rng, sample_input) -> TrainState:
+    variables = model.init(rng, sample_input, False)   # (x, train=False)
+    params = variables['params']
+    batch_stats = variables.get('batch_stats', {})
+    opt_state = optimizer.init(params)
+    return TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        batch_stats=batch_stats,
+        opt_state=opt_state,
+        ema_params=jax.tree.map(jnp.copy, params),
+        ema_batch_stats=jax.tree.map(jnp.copy, batch_stats),
+    )
+
+
+def ema_update(new_tree, ema_tree, decay):
+    """Reference ramp EMA (utils/model_ema.py:35-38):
+    ema = decay * ema + (1 - decay) * new."""
+    return jax.tree.map(
+        lambda e, m: decay * e.astype(jnp.float32)
+        + (1.0 - decay) * m.astype(jnp.float32), ema_tree, new_tree)

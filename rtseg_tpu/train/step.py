@@ -1,0 +1,194 @@
+"""Jit'd train / eval steps over a device mesh.
+
+The TPU-native collapse of the reference's per-iteration work
+(core/seg_trainer.py:38-121): forward (plain / aux-head / detail-head
+branches), loss, optional KD term, backward, gradient allreduce, optimizer +
+per-iteration LR schedule, and EMA update — all inside ONE compiled program
+per step, run under `shard_map` with the batch sharded over the mesh's 'data'
+(and optionally 'spatial') axes. What DDP does with NCCL bucket hooks
+(utils/parallel.py:38) is here a single `lax.pmean` on the gradient tree that
+XLA schedules onto ICI, overlapping with the backward pass.
+
+bf16 policy replaces AMP GradScaler (base_trainer.py:30): inputs are cast to
+config.compute_dtype for the forward; params, optimizer state and the loss
+stay fp32, so no loss scaling is needed on TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..losses import (get_detail_loss_fn, get_kd_loss_fn, get_loss_fn,
+                      laplacian_pyramid)
+from ..nn import set_bn_axis
+from ..ops import resize_bilinear, resize_nearest
+from ..parallel import batch_spec
+from ..utils.metrics import confusion_matrix
+from .state import TrainState, ema_update
+
+
+def _shard_map(fn, mesh, in_specs, out_specs):
+    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
+
+
+def _mesh_axes(mesh: Mesh) -> Tuple[str, ...]:
+    return tuple(mesh.axis_names)
+
+
+def build_train_step(config, model, optimizer, mesh: Mesh,
+                     teacher_model=None, teacher_variables=None) -> Callable:
+    """Returns step(state, images, masks) -> (state, metrics_dict).
+
+    images: [global_B, H, W, 3] fp32/bf16, masks: [global_B, H, W] int32,
+    both sharded over the mesh batch axes; state is replicated.
+    """
+    loss_fn = get_loss_fn(config)
+    detail_loss_fn = get_detail_loss_fn(config)
+    kd_fn = get_kd_loss_fn(config)
+    axes = _mesh_axes(mesh)
+    compute_dtype = jnp.dtype(config.compute_dtype)
+    total_itrs = max(int(config.total_itrs), 1)
+    aux_coef = config.aux_coef
+
+    # cross-replica BN statistics (reference SyncBatchNorm conversion,
+    # utils/parallel.py:36-37) — collective baked into the BN modules.
+    set_bn_axis(axes if config.sync_bn else None)
+
+    def forward_loss(params, batch_stats, images, masks):
+        variables = {'params': params, 'batch_stats': batch_stats}
+        x = images.astype(compute_dtype)
+        out, mutated = model.apply(variables, x, True,
+                                   mutable=['batch_stats'])
+        metrics = {}
+        if config.use_aux:
+            preds, preds_aux = out
+            loss = loss_fn(preds, masks)
+            coefs = aux_coef if aux_coef is not None \
+                else (1.0,) * len(preds_aux)
+            if len(coefs) != len(preds_aux):
+                raise ValueError(
+                    'Auxiliary loss coefficient length does not match.')
+            # per-head nearest-resized masks (core/seg_trainer.py:53-65)
+            m4 = masks[..., None].astype(jnp.float32)
+            for coef, pa in zip(coefs, preds_aux):
+                ms = resize_nearest(m4, pa.shape[1:3])[..., 0]
+                loss = loss + coef * loss_fn(pa, ms.astype(jnp.int32))
+        elif config.use_detail_head:
+            preds, preds_detail = out
+            loss = loss_fn(preds, masks)
+            # detail GT: fixed Laplacian pyramid -> model's own 1x1
+            # detail_conv (stop-grad) -> hard threshold
+            # (core/seg_trainer.py:73-82)
+            pyr = laplacian_pyramid(masks)
+            dgt = model.apply(
+                {'params': jax.lax.stop_gradient(params)}, pyr,
+                method='detail_targets')
+            dgt = (dgt > config.detail_thrs).astype(jnp.float32)
+            pd = resize_bilinear(preds_detail, dgt.shape[1:3],
+                                 align_corners=True)
+            loss_detail = detail_loss_fn(pd.astype(jnp.float32), dgt)
+            metrics['loss_detail'] = loss_detail
+            loss = loss + config.detail_loss_coef * loss_detail
+        else:
+            preds = out
+            loss = loss_fn(preds, masks)
+
+        if config.kd_training:
+            t_out = teacher_model.apply(teacher_variables, x, False)
+            t_out = jax.lax.stop_gradient(t_out)
+            loss_kd = kd_fn(preds, t_out)
+            metrics['loss_kd'] = loss_kd
+            loss = loss + config.kd_loss_coefficient * loss_kd
+
+        return loss, (mutated.get('batch_stats', batch_stats), metrics)
+
+    def step(state: TrainState, images, masks):
+        grad_fn = jax.value_and_grad(forward_loss, has_aux=True)
+        (loss, (new_bs, metrics)), grads = grad_fn(
+            state.params, state.batch_stats, images, masks)
+
+        # the one collective DDP hides in backward hooks:
+        grads = lax.pmean(grads, axes)
+        loss = lax.pmean(loss, axes)
+        metrics = lax.pmean(metrics, axes)
+        if not config.sync_bn:
+            # keep replicated state identical across shards even with
+            # per-replica normalization statistics
+            new_bs = lax.pmean(new_bs, axes)
+
+        updates, new_opt = optimizer.update(grads, state.opt_state,
+                                            state.params)
+        new_params = jax.tree.map(
+            lambda p, u: (p.astype(jnp.float32) + u).astype(p.dtype),
+            state.params, updates)
+
+        new_step = state.step + 1
+        # EMA ramp decay (utils/model_ema.py:35-40); use_ema=False degrades
+        # to a plain mirror, which validation still flows through
+        # (core/seg_trainer.py:130)
+        if config.use_ema:
+            decay = jnp.clip(new_step.astype(jnp.float32) / total_itrs,
+                             0.0, 1.0)
+            new_ema_p = ema_update(new_params, state.ema_params, decay)
+            new_ema_bs = ema_update(new_bs, state.ema_batch_stats, decay)
+        else:
+            new_ema_p = jax.tree.map(lambda x: x, new_params)
+            new_ema_bs = jax.tree.map(lambda x: x, new_bs)
+
+        metrics = dict(metrics)
+        metrics['loss'] = loss
+        new_state = TrainState(step=new_step, params=new_params,
+                               batch_stats=new_bs, opt_state=new_opt,
+                               ema_params=new_ema_p,
+                               ema_batch_stats=new_ema_bs)
+        return new_state, metrics
+
+    bspec = batch_spec(mesh)
+    sharded = _shard_map(step, mesh,
+                         in_specs=(P(), bspec, bspec),
+                         out_specs=(P(), P()))
+    return jax.jit(sharded, donate_argnums=(0,))
+
+
+def build_eval_step(config, model, mesh: Mesh, use_ema: bool = True
+                    ) -> Callable:
+    """Returns eval_step(state, images, masks) -> (C, C) confusion matrix,
+    psum'd over the mesh (replaces torchmetrics' internal sync,
+    core/seg_trainer.py:131-137). Runs the EMA weights, like the reference
+    validate (core/seg_trainer.py:130)."""
+    axes = _mesh_axes(mesh)
+    compute_dtype = jnp.dtype(config.compute_dtype)
+
+    def step(state: TrainState, images, masks):
+        params = state.ema_params if use_ema else state.params
+        bs = state.ema_batch_stats if use_ema else state.batch_stats
+        out = model.apply({'params': params, 'batch_stats': bs},
+                          images.astype(compute_dtype), False)
+        preds = jnp.argmax(out, axis=-1)
+        cm = confusion_matrix(preds, masks, config.num_class,
+                              config.ignore_index)
+        return lax.psum(cm, axes)
+
+    bspec = batch_spec(mesh)
+    sharded = _shard_map(step, mesh, in_specs=(P(), bspec, bspec),
+                         out_specs=P())
+    return jax.jit(sharded)
+
+
+def build_predict_step(config, model, mesh: Optional[Mesh] = None) -> Callable:
+    """argmax inference step (reference predict, core/seg_trainer.py:170-172)."""
+    compute_dtype = jnp.dtype(config.compute_dtype)
+
+    @jax.jit
+    def step(variables, images):
+        out = model.apply(variables, images.astype(compute_dtype), False)
+        return jnp.argmax(out, axis=-1).astype(jnp.int32)
+
+    return step

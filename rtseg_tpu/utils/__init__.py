@@ -1,0 +1,8 @@
+from .metrics import confusion_matrix, iou_from_cm, miou_from_cm
+from .colormap import get_colormap, CITYSCAPES_COLORMAP
+from .misc import (TBWriter, get_logger, log_config, mkdir, save_config,
+                   set_seed)
+
+__all__ = ['confusion_matrix', 'iou_from_cm', 'miou_from_cm', 'get_colormap',
+           'CITYSCAPES_COLORMAP', 'TBWriter', 'get_logger', 'log_config',
+           'mkdir', 'save_config', 'set_seed']

@@ -1,0 +1,76 @@
+"""Logging / seeding / io helpers (reference utils/utils.py:1-56)."""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import random
+import sys
+
+import numpy as np
+
+
+def mkdir(path: str) -> None:
+    os.makedirs(path, exist_ok=True)
+
+
+def set_seed(seed: int) -> None:
+    """Host-side seeding (reference utils/utils.py:10-14). Device-side
+    randomness in JAX flows through explicit PRNG keys derived from this."""
+    random.seed(seed)
+    np.random.seed(seed)
+
+
+def get_logger(config, main_rank: bool) -> logging.Logger:
+    """stderr + rotating-file logger (reference utils/utils.py:26-37),
+    stdlib-based (loguru is not in the TPU image)."""
+    logger = logging.getLogger(config.logger_name)
+    logger.setLevel(logging.INFO if main_rank else logging.ERROR)
+    if logger.handlers:
+        return logger
+    fmt = logging.Formatter(
+        '%(asctime)s | %(levelname)s | %(message)s', '%Y-%m-%d %H:%M:%S')
+    sh = logging.StreamHandler(sys.stderr)
+    sh.setFormatter(fmt)
+    logger.addHandler(sh)
+    if main_rank:
+        mkdir(config.save_dir)
+        fh = logging.FileHandler(
+            os.path.join(config.save_dir, f'{config.logger_name}.log'))
+        fh.setFormatter(fmt)
+        logger.addHandler(fh)
+    return logger
+
+
+def save_config(config) -> None:
+    """Dump resolved config json (reference utils/utils.py:40-43)."""
+    mkdir(config.save_dir)
+    config.save(os.path.join(config.save_dir, 'config.json'))
+
+
+def log_config(config, logger) -> None:
+    msg = json.dumps(config.to_dict(), indent=2, default=str)
+    logger.info(f'Config:\n{msg}')
+
+
+class TBWriter:
+    """Thin TensorBoard scalar writer; no-op when disabled or unavailable."""
+
+    def __init__(self, config, main_rank: bool):
+        self._w = None
+        if config.use_tb and main_rank:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+                mkdir(config.tb_log_dir)
+                self._w = SummaryWriter(config.tb_log_dir)
+            except Exception:
+                self._w = None
+
+    def add_scalar(self, tag, value, step):
+        if self._w is not None:
+            self._w.add_scalar(tag, float(value), int(step))
+
+    def close(self):
+        if self._w is not None:
+            self._w.close()

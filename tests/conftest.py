@@ -1,19 +1,30 @@
-"""Test harness: force an 8-device virtual CPU platform BEFORE jax imports.
+"""Test harness: force an 8-device virtual CPU platform.
 
 This is the distributed-without-a-cluster strategy from SURVEY.md §4: shard_map
 train steps, gradient psum, cross-replica BN, and host-sharded input are all
 exercised on a fake 8-device mesh in CI with no TPU attached.
+
+The axon sitecustomize (TPU tunnel) overrides JAX_PLATFORMS via jax.config at
+interpreter start, so env vars alone don't stick — we counter-override the
+config before any backend initializes. Set RTSEG_TEST_PLATFORM to keep the
+default platform (e.g. to run tests on a real chip).
 """
 
 import os
 
-os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+platform = os.environ.get('RTSEG_TEST_PLATFORM', 'cpu')
 flags = os.environ.get('XLA_FLAGS', '')
 if '--xla_force_host_platform_device_count' not in flags:
     os.environ['XLA_FLAGS'] = (
         flags + ' --xla_force_host_platform_device_count=8').strip()
 
 import jax  # noqa: E402
+
+if platform:
+    try:
+        jax.config.update('jax_platforms', platform)
+    except Exception:
+        pass
 
 import pytest  # noqa: E402
 
@@ -27,4 +38,7 @@ def devices():
 def mesh8():
     from jax.sharding import Mesh
     import numpy as np
-    return Mesh(np.array(jax.devices()[:8]).reshape(8), ('data',))
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip('needs 8 (virtual) devices')
+    return Mesh(np.array(devs[:8]).reshape(8), ('data',))

@@ -1,0 +1,90 @@
+"""End-to-end train/eval step tests on a virtual 8-device mesh (CPU)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from rtseg_tpu.config import SegConfig
+from rtseg_tpu.models import get_model
+from rtseg_tpu.parallel import make_mesh
+from rtseg_tpu.train.optim import get_optimizer
+from rtseg_tpu.train.state import create_train_state
+from rtseg_tpu.train.step import build_eval_step, build_train_step
+
+
+def _cfg(**kw):
+    cfg = SegConfig(dataset='synthetic', model='fastscnn', num_class=6,
+                    train_bs=1, total_epoch=2, sync_bn=True,
+                    compute_dtype='float32', save_dir='/tmp/rtseg_test',
+                    **kw)
+    cfg.resolve(num_devices=8)
+    cfg.resolve_schedule(train_num=16)
+    return cfg
+
+
+def _batch(b=8, h=32, w=64, c=6, seed=0):
+    rng = np.random.RandomState(seed)
+    images = rng.rand(b, h, w, 3).astype(np.float32)
+    masks = rng.randint(0, c, (b, h, w)).astype(np.int32)
+    masks[0, :4] = 255  # some ignored pixels
+    return jnp.asarray(images), jnp.asarray(masks)
+
+
+def test_train_step_runs_and_updates(mesh8):
+    cfg = _cfg()
+    model = get_model(cfg)
+    opt = get_optimizer(cfg)
+    state = create_train_state(model, opt, jax.random.PRNGKey(0),
+                               jnp.zeros((1, 32, 64, 3), jnp.float32))
+    step = build_train_step(cfg, model, opt, mesh8)
+    images, masks = _batch()
+    p0 = jax.tree.map(np.asarray, state.params)
+    state, metrics = step(state, images, masks)
+    state, metrics = step(state, images, masks)
+    assert int(state.step) == 2
+    assert np.isfinite(float(metrics['loss']))
+    # params actually moved
+    moved = jax.tree.leaves(jax.tree.map(
+        lambda a, b: float(np.abs(np.asarray(a) - b).max()),
+        state.params, p0))
+    assert max(moved) > 0
+
+    # with use_ema=False, the EMA mirror tracks params exactly
+    # (utils/model_ema.py:40 semantics)
+    diff = jax.tree.leaves(jax.tree.map(
+        lambda a, b: float(np.abs(np.asarray(a) - np.asarray(b)).max()),
+        state.params, state.ema_params))
+    assert max(diff) == 0
+
+
+def test_eval_step_confusion_matrix(mesh8):
+    cfg = _cfg()
+    model = get_model(cfg)
+    opt = get_optimizer(cfg)
+    state = create_train_state(model, opt, jax.random.PRNGKey(0),
+                               jnp.zeros((1, 32, 64, 3), jnp.float32))
+    eval_step = build_eval_step(cfg, model, mesh8)
+    images, masks = _batch()
+    cm = np.asarray(eval_step(state, images, masks))
+    assert cm.shape == (6, 6)
+    n_valid = int((np.asarray(masks) != 255).sum())
+    assert cm.sum() == n_valid
+
+
+def test_sync_bn_stats_identical_across_replicas(mesh8):
+    """Per-shard inputs differ; with sync_bn the resulting running stats are
+    the global-batch stats (single source of truth, replicated)."""
+    cfg = _cfg()
+    model = get_model(cfg)
+    opt = get_optimizer(cfg)
+    state = create_train_state(model, opt, jax.random.PRNGKey(0),
+                               jnp.zeros((1, 32, 64, 3), jnp.float32))
+    step = build_train_step(cfg, model, opt, mesh8)
+    images, masks = _batch(seed=7)
+    state, _ = step(state, images, masks)
+    # all leaves finite and replicated (no per-device divergence observable
+    # from the host: fully-replicated output implies identical shards)
+    for leaf in jax.tree.leaves(state.batch_stats):
+        assert np.isfinite(np.asarray(leaf)).all()
