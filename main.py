@@ -1,0 +1,20 @@
+"""Entry point (reference main.py:1-21): build config, resolve, optional CLI
+overlay, construct SegTrainer, dispatch predict vs run."""
+
+import sys
+
+from rtseg_tpu.config import SegConfig, load_parser
+from rtseg_tpu.train import SegTrainer
+
+if __name__ == '__main__':
+    config = SegConfig(dataset='cityscapes', data_root='data/cityscapes',
+                       num_class=19, model='bisenetv2')
+    if len(sys.argv) > 1:
+        config = load_parser(config)
+    config.resolve()
+
+    trainer = SegTrainer(config)
+    if config.is_testing:
+        trainer.predict()
+    else:
+        trainer.run()

@@ -1,0 +1,59 @@
+"""Dataset hub + loader factories (reference datasets/__init__.py:5-65)."""
+
+from __future__ import annotations
+
+import jax
+
+from .cityscapes import Cityscapes
+from .custom import Custom
+from .loader import ShardedLoader
+from .synthetic import Synthetic
+from .test_folder import TestFolder
+
+dataset_hub = {
+    'cityscapes': Cityscapes,
+    'custom': Custom,
+    'synthetic': Synthetic,
+}
+
+
+def get_dataset(config):
+    if config.dataset not in dataset_hub:
+        raise NotImplementedError('Unsupported dataset!')
+    cls = dataset_hub[config.dataset]
+    return cls(config, mode='train'), cls(config, mode='val')
+
+
+def get_loader(config):
+    """Build train/val ShardedLoaders; fills config.train_num / val_num and
+    schedule math (reference datasets/__init__.py:21-49 + scheduler seams)."""
+    train_ds, val_ds = get_dataset(config)
+    config.train_num = int(len(train_ds) // config.train_bs * config.train_bs)
+    config.val_num = len(val_ds)
+    config.resolve_schedule(config.train_num)
+
+    pc = jax.process_count()
+    pi = jax.process_index()
+    global_train = config.train_bs * config.gpu_num
+    global_val = config.val_bs * config.gpu_num
+    train_loader = ShardedLoader(
+        train_ds, global_train, seed=config.random_seed, shuffle=True,
+        drop_last=True, ignore_index=config.ignore_index,
+        process_index=pi, process_count=pc)
+    val_loader = ShardedLoader(
+        val_ds, global_val, seed=config.random_seed, shuffle=False,
+        drop_last=False, ignore_index=config.ignore_index,
+        process_index=pi, process_count=pc)
+    return train_loader, val_loader
+
+
+def get_test_loader(config):
+    """(reference datasets/__init__.py:52-65); returns the dataset itself —
+    prediction iterates sample-by-sample with per-image sizes."""
+    ds = TestFolder(config)
+    config.test_num = len(ds)
+    return ds
+
+
+__all__ = ['Cityscapes', 'Custom', 'Synthetic', 'TestFolder', 'ShardedLoader',
+           'dataset_hub', 'get_dataset', 'get_loader', 'get_test_loader']

@@ -1,0 +1,59 @@
+"""Custom dataset (reference datasets/custom.py:12-84).
+
+Layout described by <data_root>/data.yaml:
+    path: <root>
+    names: {0: ..., 1: ...}
+with images under <root>/<mode>/imgs and masks under <root>/<mode>/masks.
+Square-resize via config.train_size / test_size, identity normalization.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import yaml
+from PIL import Image
+
+from .transforms import EvalTransform, TrainTransform
+
+
+class Custom:
+    def __init__(self, config, mode: str = 'train'):
+        data_root = os.path.expanduser(config.data_root)
+        yaml_path = os.path.join(data_root, 'data.yaml')
+        if not os.path.exists(yaml_path):
+            raise FileNotFoundError(f'{yaml_path} not exists.')
+        with open(yaml_path, 'r', encoding='utf-8') as f:
+            ds_cfg = yaml.safe_load(f)
+        data_root = ds_cfg['path']
+        self.names = ds_cfg.get('names', {})
+
+        img_dir = os.path.join(data_root, mode, 'imgs')
+        msk_dir = os.path.join(data_root, mode, 'masks')
+        if not os.path.isdir(img_dir):
+            raise RuntimeError(f'Image directory: {img_dir} does not exist.')
+        if not os.path.isdir(msk_dir):
+            raise RuntimeError(f'Mask directory: {msk_dir} does not exist.')
+
+        if mode == 'train':
+            self.transform = TrainTransform(config, identity_norm=True,
+                                            square_size=config.train_size)
+        else:
+            self.transform = EvalTransform(config, identity_norm=True,
+                                           square_size=config.test_size)
+
+        self.images, self.masks = [], []
+        for fn in sorted(os.listdir(img_dir)):
+            base = os.path.splitext(fn)[0]
+            self.images.append(os.path.join(img_dir, fn))
+            self.masks.append(os.path.join(msk_dir, base + '.png'))
+
+    def __len__(self):
+        return len(self.images)
+
+    def get(self, index: int, rng: np.random.Generator):
+        image = np.asarray(Image.open(self.images[index]).convert('RGB'))
+        mask = np.asarray(Image.open(self.masks[index]).convert('L'))
+        image, mask = self.transform(image, mask, rng)
+        return image, mask.astype(np.int32)

@@ -1,0 +1,111 @@
+"""Host-sharded, prefetching batch loader.
+
+Replaces torch DataLoader + DistributedSampler (reference
+datasets/__init__.py:21-65, utils/parallel.py:51-53) with a TPU-shaped input
+pipeline:
+
+  * global batch = per-device bs x total devices; each *process* materializes
+    only its slice of the batch (multi-host: dataset indices are sharded by
+    jax.process_index()).
+  * per-epoch reshuffle is a seeded permutation of (seed, epoch) — same
+    determinism contract as sampler.set_epoch.
+  * train batches drop the ragged tail (reference truncates train_num to a
+    multiple of the batch, datasets/__init__.py:25 + drop_last=True);
+    val batches pad the tail by repeating the last sample with labels forced
+    to ignore_index so the confusion matrix is unaffected.
+  * a background thread prefetches the next batch while the device computes
+    (the DataLoader-worker role; ThreadPool because the host work is
+    cv2/numpy which releases the GIL).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+
+class ShardedLoader:
+    def __init__(self, dataset, global_batch: int, seed: int = 0,
+                 shuffle: bool = True, drop_last: bool = True,
+                 ignore_index: int = 255, pad_labels: bool = True,
+                 process_index: int = 0, process_count: int = 1,
+                 prefetch: int = 2):
+        self.dataset = dataset
+        self.global_batch = global_batch
+        self.local_batch = global_batch // process_count
+        assert global_batch % process_count == 0
+        self.seed = seed
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.ignore_index = ignore_index
+        self.pad_labels = pad_labels
+        self.process_index = process_index
+        self.process_count = process_count
+        self.prefetch = prefetch
+        self.epoch = 0
+
+    def __len__(self):
+        n = len(self.dataset)
+        if self.drop_last:
+            return n // self.global_batch
+        return -(-n // self.global_batch)
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def _epoch_indices(self) -> np.ndarray:
+        n = len(self.dataset)
+        if self.shuffle:
+            rng = np.random.default_rng((self.seed, self.epoch))
+            return rng.permutation(n)
+        return np.arange(n)
+
+    def _make_batch(self, idxs: np.ndarray, rng: np.random.Generator):
+        n_real = len(idxs)
+        samples = [self.dataset.get(int(i), rng) for i in idxs]
+        images = np.stack([s[0] for s in samples])
+        masks = np.stack([s[1] for s in samples])
+        want = self.local_batch
+        if n_real < want:                       # ragged val tail: pad+ignore
+            reps = want - n_real
+            images = np.concatenate(
+                [images, np.repeat(images[-1:], reps, axis=0)])
+            pad_masks = np.full((reps,) + masks.shape[1:], self.ignore_index,
+                                masks.dtype)
+            masks = np.concatenate([masks, pad_masks])
+        return images, masks
+
+    def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        indices = self._epoch_indices()
+        n = len(indices)
+        nb = len(self)
+        rng = np.random.default_rng(
+            (self.seed, self.epoch, self.process_index))
+
+        def producer(q: queue.Queue):
+            try:
+                for b in range(nb):
+                    start = b * self.global_batch
+                    batch_idx = indices[start:start + self.global_batch]
+                    # this process's contiguous slice of the global batch
+                    lo = self.process_index * self.local_batch
+                    hi = lo + self.local_batch
+                    local_idx = batch_idx[lo:hi]
+                    q.put(self._make_batch(local_idx, rng))
+                q.put(None)
+            except BaseException as e:          # surface worker errors
+                q.put(e)
+
+        q: queue.Queue = queue.Queue(maxsize=self.prefetch)
+        t = threading.Thread(target=producer, args=(q,), daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is None:
+                break
+            if isinstance(item, BaseException):
+                raise item
+            yield item

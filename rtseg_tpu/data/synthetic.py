@@ -1,0 +1,27 @@
+"""Deterministic synthetic dataset for smoke tests and benchmarks
+(BASELINE config[0] 'FastSCNN CPU smoke' uses synthetic data; the reference
+has no equivalent — it always reads Cityscapes from disk)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class Synthetic:
+    def __init__(self, config, mode: str = 'train', length: int = 64):
+        self.h = config.crop_h
+        self.w = config.crop_w
+        self.num_class = max(config.num_class, 2)
+        self.length = length
+        self.mode = mode
+
+    def __len__(self):
+        return self.length
+
+    def get(self, index: int, rng: np.random.Generator = None):
+        # content depends only on index -> reproducible across runs/hosts
+        local = np.random.default_rng(index)
+        image = local.random((self.h, self.w, 3), np.float32)
+        mask = local.integers(0, self.num_class,
+                              (self.h, self.w)).astype(np.int32)
+        return image, mask

@@ -1,0 +1,33 @@
+"""Inference-time folder dataset (reference datasets/test_dataset.py:10-40):
+a flat directory of images -> (raw image, normalized tensor, filename)."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+from PIL import Image
+
+from .transforms import EvalTransform
+
+
+class TestFolder:
+    def __init__(self, config):
+        folder = os.path.expanduser(config.test_data_folder)
+        if not os.path.isdir(folder):
+            raise RuntimeError(
+                f'Test image directory: {folder} does not exist.')
+        self.transform = EvalTransform(config)
+        self.images = []
+        self.img_names = []
+        for fn in sorted(os.listdir(folder)):
+            self.images.append(os.path.join(folder, fn))
+            self.img_names.append(fn)
+
+    def __len__(self):
+        return len(self.images)
+
+    def get(self, index: int, rng=None):
+        image = np.asarray(Image.open(self.images[index]).convert('RGB'))
+        aug = self.transform(image, None, rng)
+        return image, aug, self.img_names[index]

@@ -1,0 +1,202 @@
+"""Host-side augmentation pipeline, numpy/cv2-native.
+
+Re-implements the reference's albumentations stacks (albumentations is not in
+the TPU image) with the same sampling semantics:
+
+  train (cityscapes, datasets/cityscapes.py:114-124):
+    Scale -> RandomScale -> PadIfNeeded(114, mask 0) -> RandomCrop ->
+    ColorJitter -> HorizontalFlip(p) -> Normalize(ImageNet)
+  val: Scale -> Normalize (datasets/cityscapes.py:126-131)
+  custom adds ResizeToSquare (utils/transforms.py:36-68) and identity
+  normalization (datasets/custom.py:52,60).
+
+Randomness flows through an explicit np.random.Generator so epochs are
+reproducible from (seed, epoch) like the reference's DistributedSampler
+set_epoch reshuffle (utils/parallel.py:51-53).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import cv2
+import numpy as np
+
+IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
+IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
+
+
+def scale(image, mask, factor: float):
+    """transforms.Scale: resize by a fixed factor (bilinear img / nearest mask)."""
+    if factor == 1.0:
+        return image, mask
+    h, w = image.shape[:2]
+    nh, nw = int(h * factor), int(w * factor)
+    image = cv2.resize(image, (nw, nh), interpolation=cv2.INTER_LINEAR)
+    if mask is not None:
+        mask = cv2.resize(mask, (nw, nh), interpolation=cv2.INTER_NEAREST)
+    return image, mask
+
+
+def random_scale(image, mask, scale_limit, rng: np.random.Generator):
+    """AT.RandomScale: factor ~ U(1+lo, 1+hi); scalar limit -> (-l, +l)."""
+    if np.isscalar(scale_limit):
+        lo, hi = -float(scale_limit), float(scale_limit)
+    else:
+        lo, hi = float(scale_limit[0]), float(scale_limit[1])
+    if lo == 0.0 and hi == 0.0:
+        return image, mask
+    factor = 1.0 + rng.uniform(lo, hi)
+    return scale(image, mask, factor)
+
+
+def pad_if_needed(image, mask, min_h: int, min_w: int,
+                  value=(114, 114, 114), mask_value=0):
+    """AT.PadIfNeeded: center-pad to at least (min_h, min_w)."""
+    h, w = image.shape[:2]
+    if h >= min_h and w >= min_w:
+        return image, mask
+    pt = max(0, (min_h - h) // 2)
+    pb = max(0, min_h - h - pt)
+    pl = max(0, (min_w - w) // 2)
+    pr = max(0, min_w - w - pl)
+    image = cv2.copyMakeBorder(image, pt, pb, pl, pr, cv2.BORDER_CONSTANT,
+                               value=value)
+    if mask is not None:
+        mask = cv2.copyMakeBorder(mask, pt, pb, pl, pr, cv2.BORDER_CONSTANT,
+                                  value=mask_value)
+    return image, mask
+
+
+def random_crop(image, mask, crop_h: int, crop_w: int,
+                rng: np.random.Generator):
+    h, w = image.shape[:2]
+    top = int(rng.integers(0, h - crop_h + 1)) if h > crop_h else 0
+    left = int(rng.integers(0, w - crop_w + 1)) if w > crop_w else 0
+    image = image[top:top + crop_h, left:left + crop_w]
+    if mask is not None:
+        mask = mask[top:top + crop_h, left:left + crop_w]
+    return image, mask
+
+
+def color_jitter(image, brightness: float, contrast: float, saturation: float,
+                 rng: np.random.Generator):
+    """ColorJitter with uniformly-sampled factors in [max(0,1-x), 1+x],
+    applied in randomized order (albumentations/torchvision behavior)."""
+    if brightness == 0 and contrast == 0 and saturation == 0:
+        return image
+    img = image.astype(np.float32)
+
+    def _bright(im):
+        if brightness == 0:
+            return im
+        f = rng.uniform(max(0, 1 - brightness), 1 + brightness)
+        return im * f
+
+    def _contrast(im):
+        if contrast == 0:
+            return im
+        f = rng.uniform(max(0, 1 - contrast), 1 + contrast)
+        mean = cv2.cvtColor(im.astype(np.float32), cv2.COLOR_RGB2GRAY).mean()
+        return im * f + mean * (1 - f)
+
+    def _sat(im):
+        if saturation == 0:
+            return im
+        f = rng.uniform(max(0, 1 - saturation), 1 + saturation)
+        gray = cv2.cvtColor(im.astype(np.float32), cv2.COLOR_RGB2GRAY)
+        return im * f + gray[..., None] * (1 - f)
+
+    fns = [_bright, _contrast, _sat]
+    order = rng.permutation(3)
+    for i in order:
+        img = fns[i](img)
+    return np.clip(img, 0, 255)
+
+
+def horizontal_flip(image, mask, p: float, rng: np.random.Generator):
+    if p > 0 and rng.random() < p:
+        image = image[:, ::-1]
+        if mask is not None:
+            mask = mask[:, ::-1]
+    return image, mask
+
+
+def vertical_flip(image, mask, p: float, rng: np.random.Generator):
+    if p > 0 and rng.random() < p:
+        image = image[::-1]
+        if mask is not None:
+            mask = mask[::-1]
+    return image, mask
+
+
+def normalize(image, mean=IMAGENET_MEAN, std=IMAGENET_STD):
+    """AT.Normalize: (img/255 - mean) / std, float32 HWC."""
+    img = image.astype(np.float32) / 255.0
+    return (img - mean) / std
+
+
+def resize_to_square(image, mask, size: int):
+    """utils/transforms.py:36-68: zero-pad to square then resize to (size, size)."""
+    h, w = image.shape[:2]
+    m = max(h, w)
+    hp, vp = (m - w) // 2, (m - h) // 2
+    image = np.pad(image, ((vp, vp), (hp, hp), (0, 0)), constant_values=0)
+    if mask is not None:
+        mask = np.pad(mask, ((vp, vp), (hp, hp)), constant_values=0)
+    image = cv2.resize(image, (size, size), interpolation=cv2.INTER_LINEAR)
+    if mask is not None:
+        mask = cv2.resize(mask, (size, size), interpolation=cv2.INTER_NEAREST)
+    return image, mask
+
+
+class TrainTransform:
+    """The reference train-time stack; `identity_norm` selects the custom
+    dataset's Normalize(mean=0, std=1) variant."""
+
+    def __init__(self, config, identity_norm: bool = False,
+                 square_size: Optional[int] = None):
+        self.config = config
+        self.identity_norm = identity_norm
+        self.square_size = square_size
+
+    def __call__(self, image, mask, rng: np.random.Generator):
+        c = self.config
+        if self.square_size:
+            image, mask = resize_to_square(image, mask, self.square_size)
+        image, mask = scale(image, mask, c.scale)
+        image, mask = random_scale(image, mask, c.randscale, rng)
+        image, mask = pad_if_needed(image, mask, c.crop_h, c.crop_w)
+        image, mask = random_crop(image, mask, c.crop_h, c.crop_w, rng)
+        image = color_jitter(image, c.brightness, c.contrast, c.saturation, rng)
+        image, mask = horizontal_flip(image, mask, c.h_flip, rng)
+        image, mask = vertical_flip(image, mask, c.v_flip, rng)
+        if self.identity_norm:
+            image = image.astype(np.float32) / 255.0
+        else:
+            image = normalize(image)
+        return np.ascontiguousarray(image), np.ascontiguousarray(mask)
+
+
+class EvalTransform:
+    """The reference val/test stack: (square) scale + normalize."""
+
+    def __init__(self, config, identity_norm: bool = False,
+                 square_size: Optional[int] = None):
+        self.config = config
+        self.identity_norm = identity_norm
+        self.square_size = square_size
+
+    def __call__(self, image, mask=None, rng=None):
+        c = self.config
+        if self.square_size:
+            image, mask = resize_to_square(image, mask, self.square_size)
+        image, mask = scale(image, mask, c.scale)
+        if self.identity_norm:
+            image = image.astype(np.float32) / 255.0
+        else:
+            image = normalize(image)
+        image = np.ascontiguousarray(image)
+        if mask is None:
+            return image
+        return image, np.ascontiguousarray(mask)

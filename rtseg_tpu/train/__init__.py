@@ -1,0 +1,12 @@
+from .checkpoint import (load_meta, restore_train_ckpt, restore_weights,
+                         save_best_ckpt, save_train_ckpt)
+from .optim import get_lr_schedule, get_optimizer
+from .state import TrainState, create_train_state, ema_update
+from .step import build_eval_step, build_predict_step, build_train_step
+from .trainer import SegTrainer
+
+__all__ = ['load_meta', 'restore_train_ckpt', 'restore_weights',
+           'save_best_ckpt', 'save_train_ckpt', 'get_lr_schedule',
+           'get_optimizer', 'TrainState', 'create_train_state', 'ema_update',
+           'build_eval_step', 'build_predict_step', 'build_train_step',
+           'SegTrainer']

@@ -1,0 +1,89 @@
+"""Checkpoint / resume via orbax.
+
+Reference semantics (core/base_trainer.py:126-163):
+  * last.ckpt  — every epoch: full train state (params, BN stats, optimizer,
+    EMA, step) + {cur_epoch, best_score}; restart auto-resumes from it
+    because load_ckpt_path defaults to save_dir/last.ckpt
+    (configs/base_config.py:99-100).
+  * best.ckpt  — when val mIoU improves: **EMA** weights only, no optimizer
+    state (base_trainer.py:155,161-162).
+Metadata rides in a JSON sidecar; arrays go through orbax (sharded-aware,
+async-safe, the TPU-native torch.save).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import orbax.checkpoint as ocp
+
+from .state import TrainState
+
+_META = 'meta.json'
+
+
+def _ckptr():
+    return ocp.PyTreeCheckpointer()
+
+
+def save_train_ckpt(path: str, state: TrainState, cur_epoch: int,
+                    best_score: float) -> None:
+    path = os.path.abspath(path)
+    state = jax.device_get(state)
+    _ckptr().save(path, {'step': state.step, 'params': state.params,
+                         'batch_stats': state.batch_stats,
+                         'opt_state': state.opt_state,
+                         'ema_params': state.ema_params,
+                         'ema_batch_stats': state.ema_batch_stats},
+                  force=True)
+    with open(os.path.join(path, _META), 'w') as f:
+        json.dump({'cur_epoch': cur_epoch, 'best_score': float(best_score),
+                   'kind': 'train'}, f)
+
+
+def save_best_ckpt(path: str, state: TrainState, cur_epoch: int,
+                   best_score: float) -> None:
+    """EMA weights only (reference base_trainer.py:155,161-162)."""
+    path = os.path.abspath(path)
+    state = jax.device_get(state)
+    _ckptr().save(path, {'params': state.ema_params,
+                         'batch_stats': state.ema_batch_stats}, force=True)
+    with open(os.path.join(path, _META), 'w') as f:
+        json.dump({'cur_epoch': cur_epoch, 'best_score': float(best_score),
+                   'kind': 'best'}, f)
+
+
+def load_meta(path: str) -> Optional[Dict[str, Any]]:
+    meta_path = os.path.join(os.path.abspath(path), _META)
+    if not os.path.exists(meta_path):
+        return None
+    with open(meta_path) as f:
+        return json.load(f)
+
+
+def restore_train_ckpt(path: str, state: TrainState
+                       ) -> Tuple[TrainState, int, float]:
+    """Full resume: epoch, step, optimizer, scheduler position, EMA
+    (reference base_trainer.py:133-141)."""
+    path = os.path.abspath(path)
+    template = {'step': state.step, 'params': state.params,
+                'batch_stats': state.batch_stats,
+                'opt_state': state.opt_state,
+                'ema_params': state.ema_params,
+                'ema_batch_stats': state.ema_batch_stats}
+    restored = _ckptr().restore(path, item=jax.device_get(template))
+    meta = load_meta(path) or {'cur_epoch': 0, 'best_score': 0.0}
+    new_state = TrainState(**restored)
+    return new_state, int(meta['cur_epoch']), float(meta['best_score'])
+
+
+def restore_weights(path: str, params, batch_stats):
+    """Weights-only load (reference base_trainer.py:142-149 else-branch and
+    the predict path)."""
+    path = os.path.abspath(path)
+    template = jax.device_get({'params': params, 'batch_stats': batch_stats})
+    restored = _ckptr().restore(path, item=template)
+    return restored['params'], restored['batch_stats']

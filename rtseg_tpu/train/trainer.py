@@ -1,0 +1,262 @@
+"""SegTrainer — the training/validation/prediction driver.
+
+Re-design of reference core/base_trainer.py:13-186 + core/seg_trainer.py:15-191
+around a functional train state and compiled steps:
+
+  * __init__ builds model/loaders/optimizer/steps and resumes from last.ckpt
+    (base_trainer.py:39-57,126-149).
+  * run(): epoch loop with val_interval / begin_val_epoch gating, best-model
+    tracking, last/best checkpointing, final val_best re-validation
+    (base_trainer.py:71-109,165-186).
+  * validate(): runs the EMA weights and reduces a confusion matrix on device
+    (seg_trainer.py:123-152).
+  * predict(): colormapped PNG masks + optional alpha-blend overlays
+    (seg_trainer.py:154-191).
+
+Device placement: batches are host numpy, placed with NamedSharding onto the
+mesh's batch axes; everything else lives replicated on device.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import SegConfig
+from ..data import get_loader, get_test_loader
+from ..models import get_model, get_teacher_model
+from ..parallel import (batch_sharding, init_multihost, main_rank, make_mesh)
+from ..utils import (TBWriter, get_colormap, get_logger, iou_from_cm,
+                     log_config, mkdir, save_config, set_seed)
+from .checkpoint import (load_meta, restore_train_ckpt, restore_weights,
+                         save_best_ckpt, save_train_ckpt)
+from .optim import get_optimizer
+from .state import create_train_state
+from .step import build_eval_step, build_predict_step, build_train_step
+
+
+class SegTrainer:
+    def __init__(self, config: SegConfig):
+        init_multihost(config)
+        self.mesh = make_mesh(spatial_partition=config.spatial_partition)
+        n_devices = int(self.mesh.devices.size)
+        # resolve is idempotent; re-resolving rebinds device-count-derived
+        # fields (lr scaling, workers) to the actual mesh size
+        config.resolve(num_devices=n_devices)
+        self.config = config
+        self.main_rank = main_rank()
+        self.logger = get_logger(config, self.main_rank)
+        mkdir(config.save_dir)
+        set_seed(config.random_seed)
+
+        self.model = get_model(config)
+        self.best_score = 0.0
+        self.cur_epoch = 0
+
+        if config.is_testing:
+            self.test_set = get_test_loader(config)
+            self._init_state_for_predict()
+            return
+
+        self.writer = TBWriter(config, self.main_rank)
+        self.train_loader, self.val_loader = get_loader(config)
+        self.optimizer = get_optimizer(config)
+
+        sample = jnp.zeros((1, config.crop_h, config.crop_w, 3), jnp.float32)
+        self.state = create_train_state(
+            self.model, self.optimizer,
+            jax.random.PRNGKey(config.random_seed), sample)
+
+        teacher_model, teacher_vars = None, None
+        if config.kd_training:
+            teacher_model = get_teacher_model(config)
+            t_sample = jnp.zeros((1, config.crop_h, config.crop_w, 3),
+                                 jnp.float32)
+            tv = teacher_model.init(jax.random.PRNGKey(0), t_sample, False)
+            tp, tbs = restore_weights(config.teacher_ckpt, tv['params'],
+                                      tv.get('batch_stats', {}))
+            teacher_vars = {'params': tp, 'batch_stats': tbs}
+
+        self.train_step = build_train_step(config, self.model, self.optimizer,
+                                           self.mesh, teacher_model,
+                                           teacher_vars)
+        self.eval_step = build_eval_step(config, self.model, self.mesh)
+        self._batch_sharding = batch_sharding(self.mesh)
+        self.load_ckpt()
+
+    # ------------------------------------------------------------------ ckpt
+    def load_ckpt(self) -> None:
+        cfg = self.config
+        path = cfg.load_ckpt_path
+        if not (cfg.load_ckpt and path and
+                os.path.exists(os.path.join(os.path.abspath(path),
+                                            'meta.json'))):
+            return
+        meta = load_meta(path) or {}
+        if cfg.resume_training and meta.get('kind') == 'train':
+            self.state, self.cur_epoch, self.best_score = \
+                restore_train_ckpt(path, self.state)
+            self.logger.info(f'Resumed from {path} at epoch {self.cur_epoch}'
+                             f' (best {self.best_score:.4f})')
+        else:
+            p, bs = restore_weights(path, self.state.params,
+                                    self.state.batch_stats)
+            self.state = self.state.replace(
+                params=p, batch_stats=bs,
+                ema_params=jax.tree.map(jnp.copy, p),
+                ema_batch_stats=jax.tree.map(jnp.copy, bs))
+            self.logger.info(f'Loaded weights from {path}')
+
+    def save_ckpt(self, best: bool = False) -> None:
+        cfg = self.config
+        if not cfg.save_ckpt or not self.main_rank:
+            return
+        name = cfg.ckpt_name or ('best.ckpt' if best else 'last.ckpt')
+        path = os.path.join(cfg.save_dir, name if cfg.ckpt_name is None else
+                            name)
+        if best:
+            save_best_ckpt(os.path.join(cfg.save_dir, 'best.ckpt'),
+                           self.state, self.cur_epoch + 1, self.best_score)
+        else:
+            save_train_ckpt(os.path.join(cfg.save_dir, 'last.ckpt'),
+                            self.state, self.cur_epoch + 1, self.best_score)
+
+    # ------------------------------------------------------------------- run
+    def _put(self, images: np.ndarray, masks: np.ndarray):
+        imgs = jax.device_put(images, self._batch_sharding)
+        msks = jax.device_put(masks.astype(np.int32), self._batch_sharding)
+        return imgs, msks
+
+    def run(self) -> float:
+        cfg = self.config
+        if self.main_rank:
+            save_config(cfg)
+            log_config(cfg, self.logger)
+        start = time.time()
+        for epoch in range(self.cur_epoch, cfg.total_epoch):
+            self.cur_epoch = epoch
+            self.train_one_epoch()
+            score = None
+            if (epoch >= cfg.begin_val_epoch
+                    and (epoch + 1) % cfg.val_interval == 0):
+                score = self.validate()
+                if score > self.best_score:
+                    self.best_score = score
+                    self.save_ckpt(best=True)
+            self.save_ckpt(best=False)
+        if time.time() - start > 0 and self.main_rank:
+            self.logger.info(
+                f'Training finished in {time.time() - start:.1f}s')
+        score = self.val_best()
+        self.writer.close()
+        return score
+
+    def train_one_epoch(self) -> None:
+        cfg = self.config
+        self.train_loader.set_epoch(self.cur_epoch)
+        for i, (images, masks) in enumerate(self.train_loader):
+            imgs, msks = self._put(images, masks)
+            self.state, metrics = self.train_step(self.state, imgs, msks)
+            step = int(self.state.step)
+            if self.main_rank and cfg.use_tb:
+                self.writer.add_scalar('train/loss', metrics['loss'], step)
+                if 'loss_detail' in metrics:
+                    self.writer.add_scalar('train/loss_detail',
+                                           metrics['loss_detail'], step)
+                if 'loss_kd' in metrics:
+                    self.writer.add_scalar('train/loss_kd',
+                                           metrics['loss_kd'], step)
+                    self.writer.add_scalar('train/loss_total',
+                                           metrics['loss'], step)
+        if self.main_rank:
+            self.logger.info(
+                f'Epoch:{self.cur_epoch + 1}/{cfg.total_epoch} | '
+                f"Loss:{float(metrics['loss']):.4g}")
+
+    def validate(self, val_best: bool = False) -> float:
+        cfg = self.config
+        cm = np.zeros((cfg.num_class, cfg.num_class), np.int64)
+        for images, masks in self.val_loader:
+            imgs, msks = self._put(images, masks)
+            cm += np.asarray(self.eval_step(self.state, imgs, msks),
+                             np.int64)
+        iou = np.asarray(iou_from_cm(jnp.asarray(cm)))
+        score = float(iou.mean())
+        if self.main_rank:
+            if val_best:
+                self.logger.info(
+                    f'Train {cfg.total_epoch} epochs finished. '
+                    f'Best mIoU is: {score:.4f}')
+            else:
+                self.logger.info(
+                    f'Epoch {self.cur_epoch + 1} mIoU: {score:.4f} | best '
+                    f'mIoU so far: {max(self.best_score, score):.4f}')
+            if cfg.use_tb and not val_best:
+                self.writer.add_scalar('val/mIoU', score, self.cur_epoch + 1)
+                for i in range(cfg.num_class):
+                    self.writer.add_scalar(f'val/IoU_cls{i:02d}', iou[i],
+                                           self.cur_epoch + 1)
+        return score
+
+    def val_best(self) -> float:
+        """Reload best.ckpt into the EMA slots and re-validate
+        (reference base_trainer.py:165-186)."""
+        cfg = self.config
+        best_path = os.path.join(cfg.save_dir, 'best.ckpt')
+        if load_meta(best_path) is None:
+            return self.validate(val_best=True)
+        p, bs = restore_weights(best_path, self.state.ema_params,
+                                self.state.ema_batch_stats)
+        self.state = self.state.replace(ema_params=p, ema_batch_stats=bs)
+        return self.validate(val_best=True)
+
+    # --------------------------------------------------------------- predict
+    def _init_state_for_predict(self) -> None:
+        cfg = self.config
+        sample = jnp.zeros((1, 64, 64, 3), jnp.float32)
+        variables = self.model.init(jax.random.PRNGKey(0), sample, False)
+        params, batch_stats = variables['params'], variables.get(
+            'batch_stats', {})
+        if cfg.load_ckpt and cfg.load_ckpt_path:
+            meta = load_meta(cfg.load_ckpt_path)
+            if meta is not None:
+                params, batch_stats = restore_weights(
+                    cfg.load_ckpt_path, params, batch_stats)
+                self.logger.info(f'Loaded weights from {cfg.load_ckpt_path}')
+        self.predict_vars = {'params': params, 'batch_stats': batch_stats}
+        self.predict_step = build_predict_step(cfg, self.model)
+
+    def predict(self) -> None:
+        """Reference core/seg_trainer.py:154-191: argmax -> colormap LUT ->
+        PNG mask and/or alpha-blend overlay."""
+        from PIL import Image
+        cfg = self.config
+        colormap = get_colormap(cfg)
+        save_dir = os.path.join(cfg.save_dir, 'predicts')
+        blend_dir = os.path.join(cfg.save_dir, 'predicts_blend')
+        mkdir(save_dir)
+        if cfg.blend_prediction:
+            mkdir(blend_dir)
+        for i in range(len(self.test_set)):
+            raw, aug, name = self.test_set.get(i)
+            pred = np.asarray(
+                self.predict_step(self.predict_vars, aug[None]))[0]
+            mask_rgb = colormap[pred]
+            base = os.path.splitext(name)[0]
+            if cfg.save_mask:
+                Image.fromarray(mask_rgb).save(
+                    os.path.join(save_dir, f'{base}.png'))
+            if cfg.blend_prediction:
+                h, w = raw.shape[:2]
+                up = np.asarray(Image.fromarray(mask_rgb).resize(
+                    (w, h), Image.NEAREST))
+                blend = (raw.astype(np.float32) * (1 - cfg.blend_alpha)
+                         + up.astype(np.float32) * cfg.blend_alpha)
+                Image.fromarray(blend.astype(np.uint8)).save(
+                    os.path.join(blend_dir, f'{base}.png'))
+        self.logger.info(f'Predictions saved to {save_dir}')
