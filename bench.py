@@ -1,0 +1,99 @@
+"""Throughput benchmark (reference tools/test_speed.py:9-61, TPU-native).
+
+Jit'd forward on the flagship model at 1024x512 (the reference's FPS
+resolution, README.md:174), `block_until_ready` fencing, auto-calibrated
+iteration count. Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "imgs/sec", "vs_baseline": N}
+
+vs_baseline compares against the reference's published RTX-2080 FPS for the
+same architecture (README.md:133-203).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+# Reference RTX-2080 FPS at 1024x512 bs1 (README.md:133-203).
+REFERENCE_FPS = {
+    'fastscnn': 358.0,
+    'bisenetv2': 142.0,
+    'ddrnet': 233.0,
+}
+
+
+def _pick_model():
+    from rtseg_tpu.models.registry import model_class
+    for name in ('bisenetv2', 'fastscnn'):
+        try:
+            model_class(name)
+            return name
+        except Exception:
+            continue
+    raise RuntimeError('no benchmarkable model in registry')
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    from rtseg_tpu.config import SegConfig
+    from rtseg_tpu.models import get_model
+
+    name = _pick_model()
+    # TPU prefers batched work; keep bs modest so latency stays comparable.
+    batch = 8
+    h, w = 512, 1024
+    cfg = SegConfig(dataset='synthetic', model=name, num_class=19,
+                    compute_dtype='bfloat16', save_dir='/tmp/rtseg_bench')
+    cfg.resolve(num_devices=1)
+    model = get_model(cfg)
+
+    dev = jax.devices()[0]
+    images = jax.device_put(
+        np.random.RandomState(0).rand(batch, h, w, 3).astype(np.float32), dev)
+    variables = jax.device_put(
+        model.init(jax.random.PRNGKey(0), jnp.zeros((1, h, w, 3)), False),
+        dev)
+
+    @jax.jit
+    def fwd(variables, images):
+        return model.apply(variables, images.astype(jnp.bfloat16), False)
+
+    # warmup / compile (reference test_speed.py:31-32)
+    for _ in range(3):
+        jax.block_until_ready(fwd(variables, images))
+
+    # auto-calibrate (~reference test_speed.py:34-48): time until >1s, x3
+    iters = 10
+    while True:
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fwd(variables, images)
+        jax.block_until_ready(out)
+        elapsed = time.perf_counter() - t0
+        if elapsed > 1.0:
+            break
+        iters *= 2
+    iters = max(iters, int(iters * 3.0 / elapsed))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fwd(variables, images)
+    jax.block_until_ready(out)
+    elapsed = time.perf_counter() - t0
+
+    imgs_per_sec = batch * iters / elapsed
+    base = REFERENCE_FPS.get(name)
+    print(json.dumps({
+        'metric': f'{name} forward imgs/sec/chip (1024x512, bs{batch})',
+        'value': round(imgs_per_sec, 2),
+        'unit': 'imgs/sec',
+        'vs_baseline': round(imgs_per_sec / base, 3) if base else None,
+    }))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -28,14 +28,20 @@ def get_loader(config):
     """Build train/val ShardedLoaders; fills config.train_num / val_num and
     schedule math (reference datasets/__init__.py:21-49 + scheduler seams)."""
     train_ds, val_ds = get_dataset(config)
-    config.train_num = int(len(train_ds) // config.train_bs * config.train_bs)
+    global_train = config.train_bs * config.gpu_num
+    global_val = config.val_bs * config.gpu_num
+    if len(train_ds) < global_train:
+        raise ValueError(
+            f'Training set ({len(train_ds)} samples) is smaller than the '
+            f'global batch ({global_train}); reduce train_bs or device count.')
+    # truncate to a multiple of the *global* batch so schedule math matches
+    # the number of steps the loader actually yields (drop_last semantics)
+    config.train_num = len(train_ds) // global_train * global_train
     config.val_num = len(val_ds)
     config.resolve_schedule(config.train_num)
 
     pc = jax.process_count()
     pi = jax.process_index()
-    global_train = config.train_bs * config.gpu_num
-    global_val = config.val_bs * config.gpu_num
     train_loader = ShardedLoader(
         train_ds, global_train, seed=config.random_seed, shuffle=True,
         drop_last=True, ignore_index=config.ignore_index,

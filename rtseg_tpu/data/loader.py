@@ -65,10 +65,19 @@ class ShardedLoader:
 
     def _make_batch(self, idxs: np.ndarray, rng: np.random.Generator):
         n_real = len(idxs)
+        want = self.local_batch
+        if n_real == 0:
+            # ragged multi-host tail where this process's slice is empty:
+            # emit an all-ignored batch so every host still joins the
+            # collectives for this step
+            img0, mask0 = self.dataset.get(0, rng)
+            images = np.repeat(img0[None], want, axis=0)
+            masks = np.full((want,) + mask0.shape, self.ignore_index,
+                            mask0.dtype)
+            return images, masks
         samples = [self.dataset.get(int(i), rng) for i in idxs]
         images = np.stack([s[0] for s in samples])
         masks = np.stack([s[1] for s in samples])
-        want = self.local_batch
         if n_real < want:                       # ragged val tail: pad+ignore
             reps = want - n_real
             images = np.concatenate(
@@ -85,6 +94,17 @@ class ShardedLoader:
         rng = np.random.default_rng(
             (self.seed, self.epoch, self.process_index))
 
+        stop = threading.Event()
+
+        def put(q: queue.Queue, item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.2)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
         def producer(q: queue.Queue):
             try:
                 for b in range(nb):
@@ -94,18 +114,24 @@ class ShardedLoader:
                     lo = self.process_index * self.local_batch
                     hi = lo + self.local_batch
                     local_idx = batch_idx[lo:hi]
-                    q.put(self._make_batch(local_idx, rng))
-                q.put(None)
+                    if not put(q, self._make_batch(local_idx, rng)):
+                        return                  # consumer went away
+                put(q, None)
             except BaseException as e:          # surface worker errors
-                q.put(e)
+                put(q, e)
 
         q: queue.Queue = queue.Queue(maxsize=self.prefetch)
         t = threading.Thread(target=producer, args=(q,), daemon=True)
         t.start()
-        while True:
-            item = q.get()
-            if item is None:
-                break
-            if isinstance(item, BaseException):
-                raise item
-            yield item
+        try:
+            while True:
+                item = q.get()
+                if item is None:
+                    break
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            # unblock the producer if the consumer exits early (exception in
+            # the train step, early stop, abandoned iterator)
+            stop.set()

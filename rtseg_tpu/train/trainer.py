@@ -116,15 +116,16 @@ class SegTrainer:
         cfg = self.config
         if not cfg.save_ckpt or not self.main_rank:
             return
+        # cfg.ckpt_name overrides the default name (the reference's intent at
+        # base_trainer.py:152-154, where the branch is a latent NameError)
         name = cfg.ckpt_name or ('best.ckpt' if best else 'last.ckpt')
-        path = os.path.join(cfg.save_dir, name if cfg.ckpt_name is None else
-                            name)
+        path = os.path.join(cfg.save_dir, name)
         if best:
-            save_best_ckpt(os.path.join(cfg.save_dir, 'best.ckpt'),
-                           self.state, self.cur_epoch + 1, self.best_score)
+            save_best_ckpt(path, self.state, self.cur_epoch + 1,
+                           self.best_score)
         else:
-            save_train_ckpt(os.path.join(cfg.save_dir, 'last.ckpt'),
-                            self.state, self.cur_epoch + 1, self.best_score)
+            save_train_ckpt(path, self.state, self.cur_epoch + 1,
+                            self.best_score)
 
     # ------------------------------------------------------------------- run
     def _put(self, images: np.ndarray, masks: np.ndarray):
@@ -149,7 +150,7 @@ class SegTrainer:
                     self.best_score = score
                     self.save_ckpt(best=True)
             self.save_ckpt(best=False)
-        if time.time() - start > 0 and self.main_rank:
+        if self.main_rank:
             self.logger.info(
                 f'Training finished in {time.time() - start:.1f}s')
         score = self.val_best()
@@ -159,11 +160,14 @@ class SegTrainer:
     def train_one_epoch(self) -> None:
         cfg = self.config
         self.train_loader.set_epoch(self.cur_epoch)
+        metrics = None
         for i, (images, masks) in enumerate(self.train_loader):
             imgs, msks = self._put(images, masks)
             self.state, metrics = self.train_step(self.state, imgs, msks)
-            step = int(self.state.step)
             if self.main_rank and cfg.use_tb:
+                # the only per-step host<->device sync; skipped entirely
+                # when TB is off so steps dispatch asynchronously
+                step = int(self.state.step)
                 self.writer.add_scalar('train/loss', metrics['loss'], step)
                 if 'loss_detail' in metrics:
                     self.writer.add_scalar('train/loss_detail',
@@ -173,6 +177,10 @@ class SegTrainer:
                                            metrics['loss_kd'], step)
                     self.writer.add_scalar('train/loss_total',
                                            metrics['loss'], step)
+        if metrics is None:
+            raise RuntimeError(
+                'Training loader yielded no batches; the dataset is smaller '
+                'than the global batch size.')
         if self.main_rank:
             self.logger.info(
                 f'Epoch:{self.cur_epoch + 1}/{cfg.total_epoch} | '
@@ -224,10 +232,15 @@ class SegTrainer:
             'batch_stats', {})
         if cfg.load_ckpt and cfg.load_ckpt_path:
             meta = load_meta(cfg.load_ckpt_path)
-            if meta is not None:
-                params, batch_stats = restore_weights(
-                    cfg.load_ckpt_path, params, batch_stats)
-                self.logger.info(f'Loaded weights from {cfg.load_ckpt_path}')
+            if meta is None:
+                # reference base_trainer.py:145-147 raises here; predicting
+                # with random weights silently writes garbage masks
+                raise FileNotFoundError(
+                    f'Could not find any pretrained checkpoint at '
+                    f'{cfg.load_ckpt_path}.')
+            params, batch_stats = restore_weights(
+                cfg.load_ckpt_path, params, batch_stats)
+            self.logger.info(f'Loaded weights from {cfg.load_ckpt_path}')
         self.predict_vars = {'params': params, 'batch_stats': batch_stats}
         self.predict_step = build_predict_step(cfg, self.model)
 
