@@ -1,0 +1,161 @@
+"""Flax backbones mirroring the reference's torchvision wrappers
+(reference models/backbone.py:4-57): ResNet-18/34/50/101/152 and MobileNetV2,
+each returning 4 stage features at 1/4, 1/8, 1/16, 1/32.
+
+Pretrained ImageNet weights: torchvision downloads them at construction
+(reference backbone.py:16,44 — a network side effect); here weight import is
+explicit and offline via utils/torch_import.load_torch_state_dict, which maps
+a local torchvision .pth state_dict onto these params. Randomly initialized
+otherwise.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..nn import BatchNorm, Conv
+from ..ops import max_pool
+
+RESNET_LAYERS = {
+    'resnet18': ('basic', (2, 2, 2, 2)),
+    'resnet34': ('basic', (3, 4, 6, 3)),
+    'resnet50': ('bottleneck', (3, 4, 6, 3)),
+    'resnet101': ('bottleneck', (3, 4, 23, 3)),
+    'resnet152': ('bottleneck', (3, 8, 36, 3)),
+}
+
+
+class BasicBlock(nn.Module):
+    channels: int
+    stride: int = 1
+    dilation: int = 1
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        identity = x
+        y = Conv(self.channels, 3, self.stride, self.dilation,
+                 name='conv1')(x)
+        y = BatchNorm(name='bn1')(y, train)
+        y = jax.nn.relu(y)
+        y = Conv(self.channels, 3, 1, self.dilation, name='conv2')(y)
+        y = BatchNorm(name='bn2')(y, train)
+        if self.stride != 1 or x.shape[-1] != self.channels:
+            identity = Conv(self.channels, 1, self.stride,
+                            name='downsample_conv')(x)
+            identity = BatchNorm(name='downsample_bn')(identity, train)
+        return jax.nn.relu(y + identity)
+
+
+class Bottleneck(nn.Module):
+    channels: int              # bottleneck width; output = channels * 4
+    stride: int = 1
+    dilation: int = 1
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        out_c = self.channels * 4
+        identity = x
+        y = Conv(self.channels, 1, name='conv1')(x)
+        y = BatchNorm(name='bn1')(y, train)
+        y = jax.nn.relu(y)
+        y = Conv(self.channels, 3, self.stride, self.dilation,
+                 name='conv2')(y)
+        y = BatchNorm(name='bn2')(y, train)
+        y = jax.nn.relu(y)
+        y = Conv(out_c, 1, name='conv3')(y)
+        y = BatchNorm(name='bn3')(y, train)
+        if self.stride != 1 or x.shape[-1] != out_c:
+            identity = Conv(out_c, 1, self.stride,
+                            name='downsample_conv')(x)
+            identity = BatchNorm(name='downsample_bn')(identity, train)
+        return jax.nn.relu(y + identity)
+
+
+class ResNet(nn.Module):
+    """torchvision-layout ResNet returning (x1, x2, x4, x8) stage features
+    at 1/4, 1/8, 1/16, 1/32 (reference models/backbone.py:26-36).
+
+    `dilations` can relax the stride-2 of layer3/layer4 into dilated convs
+    (ICNet's surgical rewrite, reference icnet.py:124-142, as a constructor
+    option instead of post-hoc weight surgery).
+    """
+    resnet_type: str = 'resnet18'
+    dilations: Sequence[int] = (1, 1, 1, 1)
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        if self.resnet_type not in RESNET_LAYERS:
+            raise ValueError(f'Unsupported ResNet type: {self.resnet_type}.')
+        kind, layers = RESNET_LAYERS[self.resnet_type]
+        block = BasicBlock if kind == 'basic' else Bottleneck
+        x = Conv(64, 7, 2, padding=3, name='conv1')(x)
+        x = BatchNorm(name='bn1')(x, train)
+        x = jax.nn.relu(x)
+        x = max_pool(x, 3, 2, 1)
+        feats = []
+        for i, (n, c) in enumerate(zip(layers, (64, 128, 256, 512))):
+            dil = self.dilations[i]
+            stride = 1 if (i == 0 or dil > 1) else 2
+            for j in range(n):
+                x = block(c, stride if j == 0 else 1, dil,
+                          name=f'layer{i + 1}_{j}')(x, train)
+            feats.append(x)
+        return tuple(feats)
+
+
+class MBInvertedResidual(nn.Module):
+    """torchvision MobileNetV2 inverted residual (ReLU6)."""
+    out_channels: int
+    stride: int
+    expand_ratio: int
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        in_c = x.shape[-1]
+        hid = int(round(in_c * self.expand_ratio))
+        use_res = self.stride == 1 and in_c == self.out_channels
+        y = x
+        if self.expand_ratio != 1:
+            y = Conv(hid, 1, name='expand')(y)
+            y = BatchNorm(name='expand_bn')(y, train)
+            y = jnp.clip(y, 0, 6)
+        y = Conv(hid, 3, self.stride, groups=hid, name='dw')(y)
+        y = BatchNorm(name='dw_bn')(y, train)
+        y = jnp.clip(y, 0, 6)
+        y = Conv(self.out_channels, 1, name='project')(y)
+        y = BatchNorm(name='project_bn')(y, train)
+        return x + y if use_res else y
+
+
+# torchvision mobilenet_v2 inverted-residual schedule: (t, c, n, s)
+_MBV2_SETTING = ((1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+                 (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1))
+
+
+class Mobilenetv2(nn.Module):
+    """MobileNetV2 features split at the reference's boundaries
+    (models/backbone.py:46-49): 1/4 (24ch), 1/8 (32ch), 1/16 (96ch),
+    1/32 (320ch)."""
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        x = Conv(32, 3, 2, name='stem')(x)
+        x = BatchNorm(name='stem_bn')(x, train)
+        x = jnp.clip(x, 0, 6)
+        feats = []
+        idx = 0
+        # feature indices 1..17; splits after block idx 3, 6, 13, 17
+        splits = {3, 6, 13}
+        for t, c, n, s in _MBV2_SETTING:
+            for j in range(n):
+                idx += 1
+                x = MBInvertedResidual(c, s if j == 0 else 1, t,
+                                       name=f'block{idx}')(x, train)
+                if idx in splits:
+                    feats.append(x)
+        feats.append(x)
+        return tuple(feats)

@@ -1,0 +1,99 @@
+"""BiSeNet V1 (arXiv:1808.00897), TPU-native Flax build.
+
+Behavior parity with reference models/bisenetv1.py:16-114: spatial path
+(3 stride-2 convs to 1/8, 128ch), ResNet context path with ARM-refined 1/16
+and 1/32 features merged upward, feature fusion with channel attention,
+SegHead + align_corners upsample. ARM/FFM are shared with STDC
+(reference stdc.py:13).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..nn import Conv, ConvBNAct, SegHead
+from ..ops import global_avg_pool, resize_bilinear
+from .backbone import ResNet
+
+
+class AttentionRefinementModule(nn.Module):
+    """Global-pool -> (broadcast) -> 1x1 ConvBN(sigmoid) gate
+    (reference bisenetv1.py:76-88; the conv runs on the *expanded* map)."""
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        c = x.shape[-1]
+        pool = jnp.broadcast_to(global_avg_pool(x), x.shape)
+        gate = ConvBNAct(c, 1, act_type='sigmoid')(pool, train)
+        return x * gate
+
+
+class FeatureFusionModule(nn.Module):
+    """concat -> 3x3 ConvBNAct -> channel attention (1x1 relu, 1x1 sigmoid
+    on the expanded pooled map) -> x + x*gate (reference :91-114)."""
+    out_channels: int
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x_low, x_high, train=False):
+        x = jnp.concatenate([x_low, x_high], axis=-1)
+        x = ConvBNAct(self.out_channels, 3, act_type=self.act_type)(x, train)
+        pool = jnp.broadcast_to(global_avg_pool(x), x.shape)
+        gate = Conv(self.out_channels, 1, name='att1')(pool)
+        gate = jax.nn.relu(gate)
+        gate = Conv(self.out_channels, 1, name='att2')(gate)
+        gate = jax.nn.sigmoid(gate)
+        return x + x * gate
+
+
+class SpatialPath(nn.Module):
+    out_channels: int = 128
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        c = self.out_channels
+        for _ in range(3):
+            x = ConvBNAct(c, 3, 2, act_type=self.act_type)(x, train)
+        return x
+
+
+class ContextPath(nn.Module):
+    out_channels: int = 256
+    backbone_type: str = 'resnet18'
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        if 'resnet' not in self.backbone_type:
+            raise NotImplementedError()
+        _, _, x_16, x_32 = ResNet(self.backbone_type,
+                                  name='backbone')(x, train)
+        x_32_avg = global_avg_pool(x_32)
+        x_32 = AttentionRefinementModule(name='arm_32')(x_32, train)
+        x_32 = x_32 + x_32_avg
+        x_32 = Conv(self.out_channels, 1, name='conv_32')(x_32)
+        x_32 = resize_bilinear(x_32, x_16.shape[1:3], align_corners=True)
+
+        x_16 = AttentionRefinementModule(name='arm_16')(x_16, train)
+        x_16 = Conv(self.out_channels, 1, name='conv_16')(x_16)
+        x_16 = x_16 + x_32
+        target = (x_16.shape[1] * 2, x_16.shape[2] * 2)
+        return resize_bilinear(x_16, target, align_corners=True)
+
+
+class BiSeNetv1(nn.Module):
+    num_class: int = 1
+    backbone_type: str = 'resnet18'
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        size = x.shape[1:3]
+        x_s = SpatialPath(128, self.act_type)(x, train)
+        x_c = ContextPath(256, self.backbone_type, self.act_type)(x, train)
+        x = FeatureFusionModule(256, self.act_type)(x_s, x_c, train)
+        x = SegHead(self.num_class, self.act_type)(x, train)
+        return resize_bilinear(x, size, align_corners=True)

@@ -1,0 +1,160 @@
+"""BiSeNet V2 (arXiv:2004.02147), TPU-native Flax build.
+
+Behavior parity with reference models/bisenetv2.py:17-221: detail branch
+(3 stride-2 conv stages to 1/8), semantic branch (stem + gather-expansion
+stages to 1/32 + context embedding), bilateral guided aggregation with
+sigmoid gating, SegHead + bilinear (align_corners) upsample to input size.
+With use_aux and train=True returns (logits, (aux2, aux3, aux4, aux5)) at
+stage resolutions (reference :26-40).
+"""
+
+from __future__ import annotations
+
+from flax import linen as nn
+import jax
+
+from ..nn import (Activation, BatchNorm, Conv, ConvBNAct, DWConvBNAct,
+                  PWConvBNAct, SegHead)
+from ..ops import global_avg_pool, max_pool, avg_pool, resize_bilinear
+
+
+class StemBlock(nn.Module):
+    out_channels: int = 16
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        c = self.out_channels
+        x = ConvBNAct(c, 3, 2, act_type=self.act_type)(x, train)
+        left = ConvBNAct(c // 2, 1, act_type=self.act_type)(x, train)
+        left = ConvBNAct(c, 3, 2, act_type=self.act_type)(left, train)
+        right = max_pool(x, 3, 2, 1)
+        x = jax.numpy.concatenate([left, right], axis=-1)
+        return ConvBNAct(c, 3, 1, act_type=self.act_type)(x, train)
+
+
+class GatherExpansionLayer(nn.Module):
+    out_channels: int
+    stride: int = 1
+    act_type: str = 'relu'
+    expand_ratio: int = 6
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        in_c = x.shape[-1]
+        hid = int(round(in_c * self.expand_ratio))
+        y = ConvBNAct(in_c, 3, act_type=self.act_type)(x, train)
+        if self.stride == 2:
+            y = DWConvBNAct(hid, 3, 2, act_type='none')(y, train)
+            y = DWConvBNAct(hid, 3, 1, act_type='none')(y, train)
+            res = DWConvBNAct(in_c, 3, 2, act_type='none')(x, train)
+            res = PWConvBNAct(self.out_channels, act_type='none')(res, train)
+        else:
+            y = DWConvBNAct(hid, 3, 1, act_type='none')(y, train)
+            res = x
+        y = PWConvBNAct(self.out_channels, act_type='none')(y, train)
+        return Activation(self.act_type)(res + y)
+
+
+class ContextEmbeddingBlock(nn.Module):
+    out_channels: int
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        in_c = x.shape[-1]
+        res = global_avg_pool(x)                      # (N,1,1,C)
+        res = BatchNorm()(res, train)
+        res = ConvBNAct(in_c, 1, act_type=self.act_type)(res, train)
+        x = res + x                                   # broadcast over H, W
+        return Conv(self.out_channels, 3)(x)
+
+
+class DetailBranch(nn.Module):
+    out_channels: int = 128
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        a = self.act_type
+        for c, s in ((64, 2), (64, 1), (64, 2), (64, 1), (128, 1),
+                     (128, 2), (128, 1), (self.out_channels, 1)):
+            x = ConvBNAct(c, 3, s, act_type=a)(x, train)
+        return x
+
+
+class SemanticBranch(nn.Module):
+    out_channels: int = 128
+    num_class: int = 1
+    act_type: str = 'relu'
+    use_aux: bool = False
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        a = self.act_type
+        aux = []
+        x = StemBlock(16, a)(x, train)                         # 1/4
+        if self.use_aux:
+            aux.append(SegHead(self.num_class, a, name='seg_head2')(x, train))
+        x = GatherExpansionLayer(32, 2, a)(x, train)           # 1/8
+        x = GatherExpansionLayer(32, 1, a)(x, train)
+        if self.use_aux:
+            aux.append(SegHead(self.num_class, a, name='seg_head3')(x, train))
+        x = GatherExpansionLayer(64, 2, a)(x, train)           # 1/16
+        x = GatherExpansionLayer(64, 1, a)(x, train)
+        if self.use_aux:
+            aux.append(SegHead(self.num_class, a, name='seg_head4')(x, train))
+        x = GatherExpansionLayer(128, 2, a)(x, train)          # 1/32
+        for _ in range(3):
+            x = GatherExpansionLayer(128, 1, a)(x, train)
+        if self.use_aux:
+            aux.append(SegHead(self.num_class, a, name='seg_head5')(x, train))
+        x = ContextEmbeddingBlock(self.out_channels, a)(x, train)
+        return (x, aux) if self.use_aux else (x, [])
+
+
+class BilateralGuidedAggregationLayer(nn.Module):
+    out_channels: int = 128
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x_d, x_s, train=False):
+        in_c = x_d.shape[-1]
+        a = self.act_type
+        d_high = DWConvBNAct(in_c, 3, act_type=a)(x_d, train)
+        d_high = Conv(in_c, 1)(d_high)
+        d_low = DWConvBNAct(in_c, 3, 2, act_type=a)(x_d, train)
+        d_low = avg_pool(d_low, 3, 2, 1)
+
+        s_high = ConvBNAct(in_c, 3, act_type=a)(x_s, train)
+        s_high = resize_bilinear(s_high, d_high.shape[1:3],
+                                 align_corners=True)
+        s_high = jax.nn.sigmoid(s_high)
+        s_low = DWConvBNAct(in_c, 3, act_type=a)(x_s, train)
+        s_low = Conv(in_c, 1)(s_low)
+        s_low = jax.nn.sigmoid(s_low)
+
+        high = d_high * s_high
+        low = resize_bilinear(d_low * s_low, high.shape[1:3],
+                              align_corners=True)
+        return ConvBNAct(self.out_channels, 3, act_type=a)(high + low, train)
+
+
+class BiSeNetv2(nn.Module):
+    num_class: int = 1
+    act_type: str = 'relu'
+    use_aux: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        size = x.shape[1:3]
+        x_d = DetailBranch(128, self.act_type)(x, train)
+        x_s, aux = SemanticBranch(128, self.num_class, self.act_type,
+                                  self.use_aux)(x, train)
+        x = BilateralGuidedAggregationLayer(128, self.act_type)(
+            x_d, x_s, train)
+        x = SegHead(self.num_class, self.act_type)(x, train)
+        x = resize_bilinear(x, size, align_corners=True)
+        if self.use_aux and train:
+            return x, tuple(aux)
+        return x

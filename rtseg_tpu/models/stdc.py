@@ -1,0 +1,139 @@
+"""STDC (arXiv:2104.13188), TPU-native Flax build.
+
+Behavior parity with reference models/stdc.py:16-128: STDC1/2 encoder
+(concat-of-shrinking-blocks modules), BiSeNetv1 ARM/FFM decoder, SegHead;
+optional 3 aux heads OR a detail head (mutually exclusive, reference :24).
+
+The detail-head ground-truth path (reference core/seg_trainer.py:68-82)
+is exposed as `detail_targets(pyramid)`: the model's own 1x1 `detail_conv`
+applied to the Laplacian pyramid of the masks (pyramid built by
+losses.laplacian_pyramid, reference LaplacianConv stdc.py:131-147).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..nn import Conv, ConvBNAct, SegHead
+from ..ops import avg_pool, global_avg_pool, resize_bilinear
+from .bisenetv1 import AttentionRefinementModule, FeatureFusionModule
+
+REPEAT_TIMES_HUB = {'stdc1': (1, 1, 1), 'stdc2': (3, 4, 2)}
+
+
+class STDCModule(nn.Module):
+    """Concat of 1x1 half + 3x3 quarter (strided) + two 3x3 eighths
+    (reference stdc.py:104-128)."""
+    out_channels: int
+    stride: int = 1
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        c = self.out_channels
+        if c % 8 != 0:
+            raise ValueError('Output channel should be evenly divided by 8.')
+        if self.stride not in (1, 2):
+            raise ValueError(f'Unsupported stride: {self.stride}')
+        x1 = ConvBNAct(c // 2, 1)(x, train)
+        x2 = ConvBNAct(c // 4, 3, self.stride)(x1, train)
+        if self.stride == 2:
+            x1 = avg_pool(x1, 3, 2, 1)
+        x3 = ConvBNAct(c // 8, 3)(x2, train)
+        x4 = ConvBNAct(c // 8, 3)(x3, train)
+        return jnp.concatenate([x1, x2, x3, x4], axis=-1)
+
+
+class Stage(nn.Module):
+    out_channels: int
+    repeat_times: int
+    act_type: str
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        x = STDCModule(self.out_channels, 2, self.act_type)(x, train)
+        for _ in range(self.repeat_times):
+            x = STDCModule(self.out_channels, 1, self.act_type)(x, train)
+        return x
+
+
+class STDC(nn.Module):
+    num_class: int = 1
+    encoder_type: str = 'stdc1'
+    use_detail_head: bool = False
+    use_aux: bool = False
+    act_type: str = 'relu'
+
+    def setup(self):
+        if self.encoder_type not in REPEAT_TIMES_HUB:
+            raise ValueError('Unsupported encoder type.')
+        if self.use_detail_head and self.use_aux:
+            raise ValueError(
+                'Currently only support either aux-head or detail head.')
+        rep = REPEAT_TIMES_HUB[self.encoder_type]
+        a = self.act_type
+        self.stage1 = ConvBNAct(32, 3, 2)
+        self.stage2 = ConvBNAct(64, 3, 2)
+        self.stage3 = Stage(256, rep[0], a)
+        self.stage4 = Stage(512, rep[1], a)
+        self.stage5 = Stage(1024, rep[2], a)
+        if self.use_aux:
+            self.aux_head3 = SegHead(self.num_class, a)
+            self.aux_head4 = SegHead(self.num_class, a)
+            self.aux_head5 = SegHead(self.num_class, a)
+        self.arm4 = AttentionRefinementModule()
+        self.arm5 = AttentionRefinementModule()
+        self.conv4 = Conv(256, 1)
+        self.conv5 = Conv(256, 1)
+        self.ffm = FeatureFusionModule(128, a)
+        self.seg_head = SegHead(self.num_class, a)
+        if self.use_detail_head:
+            self.detail_head = SegHead(1, a)
+            self.detail_conv = Conv(1, 1, use_bias=False)
+
+    def detail_targets(self, pyramid):
+        """1x1 conv over the 3-scale Laplacian pyramid of the masks
+        (reference core/seg_trainer.py:74; conv weights are the model's own
+        detail_conv, stop-gradded by the train step)."""
+        return self.detail_conv(pyramid)
+
+    def __call__(self, x, train: bool = False):
+        size = x.shape[1:3]
+        if self.use_detail_head and self.is_initializing():
+            # materialize detail_conv params (used only via detail_targets,
+            # which apply() can't reach during init)
+            self.detail_conv(x[:1, :1, :1, :])
+        x = self.stage1(x, train)
+        x = self.stage2(x, train)
+        x3 = self.stage3(x, train)
+        if self.use_aux:
+            aux3 = self.aux_head3(x3, train)
+        x4 = self.stage4(x3, train)
+        if self.use_aux:
+            aux4 = self.aux_head4(x4, train)
+        x5 = self.stage5(x4, train)
+        if self.use_aux:
+            aux5 = self.aux_head5(x5, train)
+
+        x5_pool = global_avg_pool(x5)
+        x5 = x5_pool + self.arm5(x5, train)
+        x5 = self.conv5(x5)
+        x5 = resize_bilinear(x5, (x5.shape[1] * 2, x5.shape[2] * 2),
+                             align_corners=True)
+        x4 = self.arm4(x4, train)
+        x4 = self.conv4(x4)
+        x4 = x4 + x5
+        x4 = resize_bilinear(x4, (x4.shape[1] * 2, x4.shape[2] * 2),
+                             align_corners=True)
+        x = self.ffm(x4, x3, train)
+        x = self.seg_head(x, train)
+        x = resize_bilinear(x, size, align_corners=True)
+
+        if self.use_detail_head and (train or self.is_initializing()):
+            x_detail = self.detail_head(x3, train)
+            if train:
+                return x, x_detail
+        if self.use_aux and train:
+            return x, (aux3, aux4, aux5)
+        return x

@@ -33,7 +33,9 @@ def max_pool(x: jnp.ndarray, window: Size2, stride: Optional[Size2] = None,
     kh, kw = _pair(window)
     sh, sw = _pair(stride if stride is not None else window)
     ph, pw = _pair(padding)
-    neg = jnp.finfo(x.dtype).min if jnp.issubdtype(x.dtype, jnp.floating) \
+    # -inf (not finfo.min) so XLA recognizes the differentiable
+    # reduce_window_max pattern
+    neg = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
         else jnp.iinfo(x.dtype).min
     return lax.reduce_window(
         x, neg, lax.max, (1, kh, kw, 1), (1, sh, sw, 1),

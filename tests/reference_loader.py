@@ -1,0 +1,43 @@
+"""Load reference (torch) model files directly, bypassing the package
+__init__ (which imports segmentation_models_pytorch, absent here). Used only
+by parity tests to compare parameter counts / output shapes — never to copy
+weights or code."""
+
+import importlib.util
+import sys
+
+REF = '/root/reference/models'
+
+_loaded = {}
+
+
+def _load(name, path):
+    if name in _loaded:
+        return _loaded[name]
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    _loaded[name] = mod
+    return mod
+
+
+def load_ref_model_module(model_file: str):
+    """Import /root/reference/models/<model_file>.py with its intra-package
+    deps stubbed in sys.modules."""
+    if 'models' not in sys.modules:
+        pkg = type(sys)('models')
+        pkg.__path__ = [REF]
+        sys.modules['models'] = pkg
+    # modules that reference model files import from
+    for dep in ('modules', 'enet', 'lednet', 'bisenetv1'):
+        if f'models.{dep}' not in sys.modules and dep != model_file:
+            try:
+                _load(f'models.{dep}', f'{REF}/{dep}.py')
+            except Exception:
+                pass
+    return _load(f'models.{model_file}', f'{REF}/{model_file}.py')
+
+
+def torch_param_count(model) -> int:
+    return sum(p.numel() for p in model.parameters())

@@ -88,3 +88,33 @@ def test_sync_bn_stats_identical_across_replicas(mesh8):
     # from the host: fully-replicated output implies identical shards)
     for leaf in jax.tree.leaves(state.batch_stats):
         assert np.isfinite(np.asarray(leaf)).all()
+
+
+def test_train_step_aux_bisenetv2(mesh8):
+    cfg = _cfg()
+    cfg.model = 'bisenetv2'
+    cfg.use_aux = True
+    model = get_model(cfg)
+    opt = get_optimizer(cfg)
+    state = create_train_state(model, opt, jax.random.PRNGKey(0),
+                               jnp.zeros((1, 32, 64, 3), jnp.float32))
+    step = build_train_step(cfg, model, opt, mesh8)
+    images, masks = _batch()
+    state, metrics = step(state, images, masks)
+    assert int(state.step) == 1
+    assert np.isfinite(float(metrics['loss']))
+
+
+def test_train_step_detail_stdc(mesh8):
+    cfg = _cfg()
+    cfg.model = 'stdc'
+    cfg.use_detail_head = True
+    model = get_model(cfg)
+    opt = get_optimizer(cfg)
+    state = create_train_state(model, opt, jax.random.PRNGKey(0),
+                               jnp.zeros((1, 32, 64, 3), jnp.float32))
+    step = build_train_step(cfg, model, opt, mesh8)
+    images, masks = _batch()
+    state, metrics = step(state, images, masks)
+    assert np.isfinite(float(metrics['loss']))
+    assert np.isfinite(float(metrics['loss_detail']))
