@@ -1,0 +1,95 @@
+"""CGNet (arXiv:1811.08201), TPU-native Flax build.
+
+Behavior parity with reference models/cgnet.py:15-113: context-guided
+blocks (local DW conv + surround dilated DW conv, joint BN+act, global
+FC sigmoid gate), downsampled-input injection at 1/4 and 1/8, 1x1 head.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..nn import Activation, BatchNorm, Conv, ConvBNAct
+from ..ops import global_avg_pool, resize_bilinear
+
+
+class InitBlock(nn.Module):
+    out_channels: int = 32
+    act_type: str = 'prelu'
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        a = self.act_type
+        x0 = ConvBNAct(self.out_channels, 3, 2, act_type=a)(x, train)
+        x = ConvBNAct(self.out_channels, 3, act_type=a)(x0, train)
+        x = ConvBNAct(self.out_channels, 3, act_type=a)(x, train)
+        return x, x0
+
+
+class CGBlock(nn.Module):
+    out_channels: int
+    stride: int = 1
+    dilation: int = 1
+    res_type: str = 'GRL'
+    act_type: str = 'prelu'
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        if self.res_type not in ('GRL', 'LRL'):
+            raise ValueError('Residual learning only support GRL and LRL.')
+        in_c = x.shape[-1]
+        c = self.out_channels
+        use_skip = self.stride == 1 and in_c == c
+        residual = x
+        x = Conv(c // 2, 1)(x)
+        loc = Conv(c // 2, 3, self.stride, groups=c // 2, name='loc')(x)
+        sur = Conv(c // 2, 3, self.stride, dilation=self.dilation,
+                   groups=c // 2, name='sur')(x)
+        x = jnp.concatenate([loc, sur], axis=-1)
+        x = BatchNorm()(x, train)
+        x = Activation(self.act_type)(x)
+        if use_skip and self.res_type == 'LRL':
+            x = x + residual
+        g = global_avg_pool(x)[:, 0, 0, :]
+        g = nn.Dense(c // 8, name='glo1')(g)
+        g = nn.Dense(c, name='glo2')(g)
+        g = jax.nn.sigmoid(g)[:, None, None, :]
+        x = x * g
+        if use_skip and self.res_type == 'GRL':
+            x = x + residual
+        return x
+
+
+class CGNet(nn.Module):
+    num_class: int = 1
+    M: int = 3
+    N: int = 15
+    act_type: str = 'prelu'
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        size = x.shape[1:3]
+        a = self.act_type
+        x_d4 = resize_bilinear(x, (size[0] // 4, size[1] // 4),
+                               align_corners=True)
+        x_d8 = resize_bilinear(x, (size[0] // 8, size[1] // 8),
+                               align_corners=True)
+
+        x, x1 = InitBlock(32, a)(x, train)
+        x = jnp.concatenate([x, x1], axis=-1)
+        x2 = CGBlock(64, 2, 2, act_type=a)(x, train)
+        x = jnp.concatenate([x2, x_d4], axis=-1)       # input injection
+        for _ in range(self.M - 1):
+            x = CGBlock(64, 1, 2, act_type=a)(x, train)
+
+        x = jnp.concatenate([x, x2], axis=-1)
+        x3 = CGBlock(128, 2, 4, act_type=a)(x, train)
+        x = jnp.concatenate([x3, x_d8], axis=-1)       # input injection
+        for _ in range(self.N - 1):
+            x = CGBlock(128, 1, 4, act_type=a)(x, train)
+
+        x = jnp.concatenate([x, x3], axis=-1)
+        x = Conv(self.num_class, 1)(x)
+        return resize_bilinear(x, size, align_corners=True)

@@ -1,0 +1,93 @@
+"""ContextNet (arXiv:1805.04554), TPU-native Flax build.
+
+Behavior parity with reference models/contextnet.py:15-123: full-resolution
+shallow DS-conv branch + 1/4-resolution MobileNetV2-style deep branch,
+dilated DS-conv feature fusion, 1x1 ConvBNAct classifier.
+"""
+
+from __future__ import annotations
+
+from flax import linen as nn
+
+from ..nn import (Activation, Conv, ConvBNAct, DSConvBNAct, DWConvBNAct,
+                  PWConvBNAct)
+from ..ops import resize_bilinear
+
+
+class InvertedResidual(nn.Module):
+    out_channels: int
+    stride: int
+    expand_ratio: int = 6
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        in_c = x.shape[-1]
+        hid = int(round(in_c * self.expand_ratio))
+        use_res = self.stride == 1 and in_c == self.out_channels
+        y = PWConvBNAct(hid, act_type=self.act_type)(x, train)
+        y = DWConvBNAct(hid, 3, self.stride, act_type=self.act_type)(y, train)
+        y = ConvBNAct(self.out_channels, 1, act_type='none')(y, train)
+        return x + y if use_res else y
+
+
+class Branch1(nn.Module):
+    """Full-res: conv + 3x (DW none + PW act) ladder (reference :35-46)."""
+    out_channels: int = 128
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        a = self.act_type
+        x = ConvBNAct(32, 3, 2, act_type=a)(x, train)
+        for hid, nxt in ((32, 64), (64, 128), (128, self.out_channels)):
+            x = DWConvBNAct(hid, 3, 1, act_type='none')(x, train)
+            x = PWConvBNAct(nxt, act_type=a)(x, train)
+        return x
+
+
+class Branch4(nn.Module):
+    """1/4-res deep branch (reference :49-80)."""
+    out_channels: int = 128
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        a = self.act_type
+        x = ConvBNAct(32, 3, 2, act_type=a)(x, train)
+        for t, c, n, s in ((1, 32, 1, 1), (6, 32, 1, 1), (6, 48, 3, 2),
+                           (6, 64, 3, 2), (6, 96, 2, 1), (6, 128, 2, 1)):
+            for i in range(n):
+                x = InvertedResidual(c, s if i == 0 else 1, t, a)(x, train)
+        return ConvBNAct(self.out_channels, 3, 1, act_type=a)(x, train)
+
+
+class FeatureFusion(nn.Module):
+    out_channels: int = 128
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, b1, b4, train=False):
+        size = b1.shape[1:3]
+        b1 = Conv(self.out_channels, 1, name='branch_1_conv')(b1)
+        b4 = resize_bilinear(b4, size, align_corners=True)
+        b4 = DSConvBNAct(self.out_channels, 3, dilation=4,
+                         act_type='none')(b4, train)
+        b4 = Conv(self.out_channels, 1, name='branch_4_conv')(b4)
+        return Activation(self.act_type)(b1 + b4)
+
+
+class ContextNet(nn.Module):
+    num_class: int = 1
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        size = x.shape[1:3]
+        x_low = resize_bilinear(x, (size[0] // 4, size[1] // 4),
+                                align_corners=True)
+        full = Branch1(128, self.act_type)(x, train)
+        low = Branch4(128, self.act_type)(x_low, train)
+        x = FeatureFusion(128, self.act_type)(full, low, train)
+        x = ConvBNAct(self.num_class, 1, act_type=self.act_type)(x, train)
+        return resize_bilinear(x, size, align_corners=True)

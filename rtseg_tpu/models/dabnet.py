@@ -1,0 +1,68 @@
+"""DABNet (arXiv:1907.11357), TPU-native Flax build.
+
+Behavior parity with reference models/dabnet.py:16-98: depth-wise
+asymmetric bottleneck modules (plain + dilated DW 3x1/1x3 branches summed),
+avg-pooled input injection at 1/2, 1/4, 1/8, 1x1 head + bilinear upsample.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..nn import Conv, ConvBNAct, DWConvBNAct
+from ..ops import avg_pool, resize_bilinear
+from .enet import InitialBlock
+
+
+class DABModule(nn.Module):
+    dilation: int
+    act_type: str = 'prelu'
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        c = x.shape[-1]
+        assert c % 2 == 0, 'Input channel of DABModule should be multiple of 2.'
+        hid = c // 2
+        a = self.act_type
+        d = self.dilation
+        y = ConvBNAct(hid, 3, act_type=a)(x, train)
+        left = DWConvBNAct(hid, (3, 1), act_type=a)(y, train)
+        left = DWConvBNAct(hid, (1, 3), act_type=a)(left, train)
+        right = DWConvBNAct(hid, (3, 1), dilation=d, act_type=a)(y, train)
+        right = DWConvBNAct(hid, (1, 3), dilation=d, act_type=a)(right, train)
+        y = ConvBNAct(c, 1, act_type=a)(left + right, train)
+        return y + x
+
+
+class DABNet(nn.Module):
+    num_class: int = 1
+    act_type: str = 'prelu'
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        size = x.shape[1:3]
+        a = self.act_type
+        x_d2 = avg_pool(x, 3, 2, 1)
+        x_d4 = avg_pool(x_d2, 3, 2, 1)
+        x_d8 = avg_pool(x_d4, 3, 2, 1)
+
+        x = ConvBNAct(32, 3, 2, act_type=a)(x, train)
+        x = ConvBNAct(32, 3, 1, act_type=a)(x, train)
+        x = ConvBNAct(32, 3, 1, act_type=a)(x, train)
+        x = jnp.concatenate([x, x_d2], axis=-1)
+
+        x = InitialBlock(64, a)(x, train)
+        block1 = x
+        for _ in range(3):
+            x = DABModule(2, a)(x, train)
+        x = jnp.concatenate([x, block1, x_d4], axis=-1)
+
+        x = ConvBNAct(128, 3, 2, act_type=a)(x, train)
+        block2 = x
+        for d in (4, 4, 8, 8, 16, 16):
+            x = DABModule(d, a)(x, train)
+        x = jnp.concatenate([x, block2, x_d8], axis=-1)
+
+        x = Conv(self.num_class, 1)(x)
+        return resize_bilinear(x, size, align_corners=True)

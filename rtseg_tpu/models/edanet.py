@@ -1,0 +1,66 @@
+"""EDANet (arXiv:1809.06323), TPU-native Flax build.
+
+Behavior parity with reference models/edanet.py:15-85: conv||pool
+downsampling blocks, dense asymmetric dilated EDA modules (growth k=40,
+concat), 1x1 projection + bilinear (align_corners) upsample.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..nn import Activation, BatchNorm, Conv, ConvBNAct
+from ..ops import max_pool, resize_bilinear
+
+
+class DownsamplingBlock(nn.Module):
+    out_channels: int
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        in_c = x.shape[-1]
+        y = Conv(self.out_channels - in_c, 3, 2)(x)
+        x = jnp.concatenate([y, max_pool(x, 2, 2)], axis=-1)
+        x = BatchNorm()(x, train)
+        return Activation(self.act_type)(x)
+
+
+class EDAModule(nn.Module):
+    k: int
+    dilation: int = 1
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        k, d = self.k, self.dilation
+        y = ConvBNAct(k, 1)(x, train)
+        y = Conv(k, (3, 1))(y)
+        y = ConvBNAct(k, (1, 3), act_type=self.act_type)(y, train)
+        y = Conv(k, (3, 1), dilation=d)(y)
+        y = ConvBNAct(k, (1, 3), dilation=d,
+                      act_type=self.act_type)(y, train)
+        return jnp.concatenate([y, x], axis=-1)
+
+
+class EDANet(nn.Module):
+    num_class: int = 1
+    k: int = 40
+    num_b1: int = 5
+    num_b2: int = 8
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        size = x.shape[1:3]
+        a = self.act_type
+        x = DownsamplingBlock(15, a)(x, train)
+        x = DownsamplingBlock(60, a)(x, train)
+        for d in (1, 1, 1, 2, 2):
+            x = EDAModule(self.k, d, a)(x, train)
+        x = ConvBNAct(130, 3, 2, act_type=a)(x, train)
+        for d in (2, 2, 4, 4, 8, 8, 16, 16):
+            x = EDAModule(self.k, d, a)(x, train)
+        x = Conv(self.num_class, 1)(x)
+        return resize_bilinear(x, size, align_corners=True)

@@ -1,0 +1,157 @@
+"""ENet (arXiv:1606.02147), TPU-native Flax build.
+
+Behavior parity with reference models/enet.py:14-205: initial block
+(conv||maxpool concat), bottleneck encoder with argmax-captured max pooling,
+dilated/asymmetric bottlenecks with dropout, unpooling decoder (one-hot
+scatter instead of MaxUnpool2d — ops/pool.py), deconv or conv+bilinear
+upsampling. `InitialBlock` and `Upsample` are reused across the zoo
+(reference aglnet.py:14, lednet.py, fssnet.py, ...).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..nn import Activation, BatchNorm, Conv, ConvBNAct, Dropout
+from ..ops import (max_pool, max_pool_argmax_2x2, max_unpool_2x2,
+                   resize_bilinear)
+
+
+class InitialBlock(nn.Module):
+    """conv(stride2, out-in ch) || maxpool(3,2,1), concat
+    (reference enet.py:38-48)."""
+    out_channels: int
+    act_type: str = 'prelu'
+    kernel_size: int = 3
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        in_c = x.shape[-1]
+        assert self.out_channels > in_c, \
+            'out_channels should be larger than in_channels.'
+        y = ConvBNAct(self.out_channels - in_c, self.kernel_size, 2,
+                      act_type=self.act_type)(x, train)
+        return jnp.concatenate([y, max_pool(x, 3, 2, 1)], axis=-1)
+
+
+class Upsample(nn.Module):
+    """reference enet.py:187-205: bare deconv (k=2s-1, out_pad=1, no BN/act)
+    or 1x1 ConvBNAct + bilinear (align_corners=False)."""
+    out_channels: int
+    scale_factor: int = 2
+    kernel_size: Optional[int] = None
+    upsample_type: Optional[str] = None
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        s = self.scale_factor
+        if self.upsample_type == 'deconvolution':
+            k = self.kernel_size if self.kernel_size is not None else 2 * s - 1
+            pad = (k - 1) // 2
+            lo = k - 1 - pad
+            hi = k - 1 - pad + 1                 # output_padding=1
+            return nn.ConvTranspose(
+                self.out_channels, (k, k), (s, s),
+                padding=((lo, hi), (lo, hi)), use_bias=False,
+                dtype=x.dtype, param_dtype=jnp.float32,
+                transpose_kernel=True, name='deconv')(x)
+        x = ConvBNAct(self.out_channels, 1, act_type=self.act_type)(x, train)
+        return resize_bilinear(x, (x.shape[1] * s, x.shape[2] * s),
+                               align_corners=False)
+
+
+class Bottleneck(nn.Module):
+    """ENet bottleneck (reference enet.py:119-184)."""
+    out_channels: int
+    conv_type: str = 'regular'
+    act_type: str = 'prelu'
+    upsample_type: str = 'regular'
+    dilation: int = 1
+    drop_p: float = 0.1
+    shrink_ratio: float = 0.25
+
+    @nn.compact
+    def __call__(self, x, indices=None, train=False):
+        in_c = x.shape[-1]
+        hid = int(in_c * self.shrink_ratio)
+        a = self.act_type
+        ct = self.conv_type
+
+        if ct == 'regular':
+            y = ConvBNAct(hid, 1)(x, train)
+            y = ConvBNAct(hid, 3)(y, train)
+        elif ct == 'downsampling':
+            y = ConvBNAct(hid, 3, 2)(x, train)
+            y = ConvBNAct(hid, 3)(y, train)
+        elif ct == 'upsampling':
+            y = ConvBNAct(hid, 1)(x, train)
+            y = Upsample(hid, 2, kernel_size=3,
+                         upsample_type=self.upsample_type)(y, train)
+        elif ct == 'dilate':
+            y = ConvBNAct(hid, 1)(x, train)
+            y = ConvBNAct(hid, 3, dilation=self.dilation)(y, train)
+        elif ct == 'asymmetric':
+            y = ConvBNAct(hid, 1)(x, train)
+            y = ConvBNAct(hid, (5, 1))(y, train)
+            y = ConvBNAct(hid, (1, 5))(y, train)
+        else:
+            raise ValueError(f'[!] Unsupport convolution type: {ct}')
+        y = Conv(self.out_channels, 1)(y)
+        y = Dropout(self.drop_p)(y, train)
+
+        act = Activation(a)
+        if ct == 'downsampling':
+            left, idx = max_pool_argmax_2x2(x)
+            left = ConvBNAct(self.out_channels, 1)(left, train)
+            return act(left + y), idx
+        if ct == 'upsampling':
+            if indices is None:
+                raise ValueError('Upsampling-type conv needs pooling indices.')
+            left = ConvBNAct(self.out_channels, 1)(x, train)
+            left = max_unpool_2x2(left, indices)
+            return act(left + y)
+        return act(x + y)
+
+
+class ENet(nn.Module):
+    num_class: int = 1
+    act_type: str = 'prelu'
+    upsample_type: str = 'deconvolution'
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        a = self.act_type
+        x = InitialBlock(16, a)(x, train)
+
+        # bottleneck1: downsample + 4 regular (drop 0.01)
+        x, idx1 = Bottleneck(64, 'downsampling', a, drop_p=0.01)(
+            x, train=train)
+        for _ in range(4):
+            x = Bottleneck(64, 'regular', a, drop_p=0.01)(x, train=train)
+
+        # bottleneck2 (downsample) / bottleneck3: regular+dilate+asym ladder
+        x, idx2 = Bottleneck(128, 'downsampling', a)(x, train=train)
+        for _ in range(2):
+            x = Bottleneck(128, 'regular', a)(x, train=train)
+            x = Bottleneck(128, 'dilate', a, dilation=2)(x, train=train)
+            x = Bottleneck(128, 'asymmetric', a)(x, train=train)
+            x = Bottleneck(128, 'dilate', a, dilation=4)(x, train=train)
+            x = Bottleneck(128, 'regular', a)(x, train=train)
+            x = Bottleneck(128, 'dilate', a, dilation=8)(x, train=train)
+            x = Bottleneck(128, 'asymmetric', a)(x, train=train)
+            x = Bottleneck(128, 'dilate', a, dilation=16)(x, train=train)
+
+        # bottleneck4/5: unpool decoders
+        x = Bottleneck(64, 'upsampling', a, self.upsample_type)(
+            x, idx2, train)
+        x = Bottleneck(64, 'regular', a)(x, train=train)
+        x = Bottleneck(64, 'regular', a)(x, train=train)
+        x = Bottleneck(16, 'upsampling', a, self.upsample_type)(
+            x, idx1, train)
+        x = Bottleneck(16, 'regular', a)(x, train=train)
+
+        return Upsample(self.num_class, 2, act_type=a)(x, train)

@@ -1,0 +1,54 @@
+"""ERFNet (IEEE 8063438), TPU-native Flax build.
+
+Behavior parity with reference models/erfnet.py:15-82: ENet downsampler
+blocks, non-bottleneck-1D factorized residual units (3x1/1x3 pairs, second
+pair dilated, residual add then BN+act), deconv decoder ending in a
+num_class deconv.
+"""
+
+from __future__ import annotations
+
+from flax import linen as nn
+
+from ..nn import Activation, BatchNorm, Conv, ConvBNAct, DeConvBNAct
+from .enet import InitialBlock as DownsamplerBlock
+
+
+class NonBt1DBlock(nn.Module):
+    dilation: int = 1
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        c = x.shape[-1]
+        d = self.dilation
+        y = ConvBNAct(c, (3, 1))(x, train)
+        y = ConvBNAct(c, (1, 3))(y, train)
+        y = ConvBNAct(c, (3, 1), dilation=d)(y, train)
+        y = Conv(c, (1, 3), dilation=d)(y)
+        y = y + x
+        y = BatchNorm()(y, train)
+        return Activation(self.act_type)(y)
+
+
+class ERFNet(nn.Module):
+    num_class: int = 1
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        a = self.act_type
+        x = DownsamplerBlock(16, a)(x, train)
+        x = DownsamplerBlock(64, a)(x, train)
+        for _ in range(5):
+            x = NonBt1DBlock(1, a)(x, train)
+        x = DownsamplerBlock(128, a)(x, train)
+        for d in (2, 4, 8, 16, 2, 4, 8, 16):
+            x = NonBt1DBlock(d, a)(x, train)
+        x = DeConvBNAct(64, act_type=a)(x, train)
+        for _ in range(2):
+            x = NonBt1DBlock(1, a)(x, train)
+        x = DeConvBNAct(16, act_type=a)(x, train)
+        for _ in range(2):
+            x = NonBt1DBlock(1, a)(x, train)
+        return DeConvBNAct(self.num_class, act_type=a)(x, train)

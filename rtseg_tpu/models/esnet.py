@@ -1,0 +1,73 @@
+"""ESNet (arXiv:1906.09826), TPU-native Flax build.
+
+Behavior parity with reference models/esnet.py:16-130: symmetric
+encoder-decoder of factorized (FCU, kernel K) and parallel-dilated
+(PFCU, r=2,5,9) units over ENet downsampling blocks, deconv decoder.
+"""
+
+from __future__ import annotations
+
+from flax import linen as nn
+
+from ..nn import Activation, Conv, ConvBNAct, DeConvBNAct
+from .enet import InitialBlock as DownsamplingUnit
+
+
+class FCU(nn.Module):
+    K: int
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        c = x.shape[-1]
+        a = self.act_type
+        act = Activation(a)
+        y = act(Conv(c, (self.K, 1))(x))
+        y = ConvBNAct(c, (1, self.K), act_type=a)(y, train)
+        y = act(Conv(c, (self.K, 1))(y))
+        y = ConvBNAct(c, (1, self.K), act_type='none')(y, train)
+        return act(y + x)
+
+
+class PFCU(nn.Module):
+    rates: tuple = (2, 5, 9)
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        c = x.shape[-1]
+        a = self.act_type
+        act = Activation(a)
+        y = act(Conv(c, (3, 1))(x))
+        y = ConvBNAct(c, (1, 3), act_type=a)(y, train)
+        outs = []
+        for r in self.rates:
+            z = act(Conv(c, (3, 1), dilation=r)(y))
+            z = ConvBNAct(c, (1, 3), dilation=r, act_type='none')(z, train)
+            outs.append(z)
+        return act(outs[0] + outs[1] + outs[2] + x)
+
+
+class ESNet(nn.Module):
+    num_class: int = 1
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        a = self.act_type
+        x = DownsamplingUnit(16, a)(x, train)
+        for _ in range(3):
+            x = FCU(3, a)(x, train)
+        x = DownsamplingUnit(64, a)(x, train)
+        for _ in range(2):
+            x = FCU(5, a)(x, train)
+        x = DownsamplingUnit(128, a)(x, train)
+        for _ in range(3):
+            x = PFCU((2, 5, 9), a)(x, train)
+        x = DeConvBNAct(64, act_type=a)(x, train)
+        for _ in range(2):
+            x = FCU(5, a)(x, train)
+        x = DeConvBNAct(16, act_type=a)(x, train)
+        for _ in range(2):
+            x = FCU(3, a)(x, train)
+        return DeConvBNAct(self.num_class, act_type=a)(x, train)

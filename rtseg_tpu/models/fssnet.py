@@ -1,0 +1,108 @@
+"""FSSNet (IEEE 8392426), TPU-native Flax build.
+
+Behavior parity with reference models/fssnet.py:16-146: ENet-style init,
+factorized (1x3/3x1) and dilated bottlenecks, conv||pool downsampling with
+residual sum, skip-sum upsampling decoder, deconv full-conv head.
+"""
+
+from __future__ import annotations
+
+from flax import linen as nn
+
+from ..nn import Activation, ConvBNAct, DeConvBNAct
+from ..ops import max_pool, resize_bilinear
+from .enet import InitialBlock as InitBlock
+
+
+class FactorizedBlock(nn.Module):
+    dilation: int = 1                    # unused; keeps build_blocks signature
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        c = x.shape[-1]
+        hid = c // 4
+        a = self.act_type
+        y = ConvBNAct(hid, 1, act_type=a)(x, train)
+        y = ConvBNAct(hid, (1, 3), act_type='none')(y, train)
+        y = ConvBNAct(hid, (3, 1), act_type=a)(y, train)
+        y = ConvBNAct(c, 1, act_type='none')(y, train)
+        return Activation(a)(y + x)
+
+
+class DilatedBlock(nn.Module):
+    dilation: int = 1
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        c = x.shape[-1]
+        hid = c // 4
+        a = self.act_type
+        y = ConvBNAct(hid, 1, act_type=a)(x, train)
+        y = ConvBNAct(hid, 3, dilation=self.dilation, act_type=a)(y, train)
+        y = ConvBNAct(c, 1, act_type='none')(y, train)
+        return Activation(a)(y + x)
+
+
+class DownsamplingBlock(nn.Module):
+    out_channels: int
+    act_type: str = 'prelu'
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        c = self.out_channels
+        hid = c // 4
+        a = self.act_type
+        y = ConvBNAct(hid, 2, 2, act_type=a)(x, train)
+        y = ConvBNAct(hid, 3, act_type=a)(y, train)
+        y = ConvBNAct(c, 1, act_type='none')(y, train)
+        p = max_pool(x, 3, 2, 1)
+        p = ConvBNAct(c, 1, act_type='none')(p, train)
+        return Activation(a)(y + p)
+
+
+class UpsamplingBlock(nn.Module):
+    out_channels: int
+    act_type: str = 'prelu'
+
+    @nn.compact
+    def __call__(self, x, pool_feat, train=False):
+        in_c = x.shape[-1]
+        hid = in_c // 4
+        a = self.act_type
+        y = ConvBNAct(hid, 1, act_type=a)(x, train)
+        y = DeConvBNAct(hid, act_type=a)(y, train)
+        y = ConvBNAct(self.out_channels, 1, act_type='none')(y, train)
+
+        x = x + pool_feat
+        x = ConvBNAct(self.out_channels, 1, act_type='none')(x, train)
+        x = resize_bilinear(x, (x.shape[1] * 2, x.shape[2] * 2),
+                            align_corners=True)
+        return Activation(a)(x + y)
+
+
+class FSSNet(nn.Module):
+    num_class: int = 1
+    act_type: str = 'prelu'
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        a = self.act_type
+        x = InitBlock(16, a)(x, train)
+        x_d1 = DownsamplingBlock(64, a)(x, train)
+        x = x_d1
+        for _ in range(4):
+            x = FactorizedBlock(act_type=a)(x, train)
+        x_d2 = DownsamplingBlock(128, a)(x, train)
+        x = x_d2
+        for d in (2, 5, 9, 2, 5, 9):
+            x = DilatedBlock(d, a)(x, train)
+
+        x = UpsamplingBlock(64, a)(x, x_d2, train)
+        for _ in range(2):
+            x = DilatedBlock(1, a)(x, train)
+        x = UpsamplingBlock(16, a)(x, x_d1, train)
+        for _ in range(2):
+            x = DilatedBlock(1, a)(x, train)
+        return DeConvBNAct(self.num_class, act_type=a)(x, train)

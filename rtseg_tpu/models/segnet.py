@@ -1,0 +1,67 @@
+"""SegNet (arXiv:1511.00561), TPU-native Flax build.
+
+Behavior parity with reference models/segnet.py:14-80: VGG-ish symmetric
+encoder-decoder, argmax-captured 2x2 max pooling at all 5 stages, unpooling
+decoder (one-hot scatter, ops/pool.py), ConvBNAct classifier.
+"""
+
+from __future__ import annotations
+
+from flax import linen as nn
+
+from ..nn import ConvBNAct
+from ..ops import max_pool_argmax_2x2, max_unpool_2x2
+
+
+class DownsampleBlock(nn.Module):
+    out_channels: int
+    act_type: str = 'relu'
+    extra_conv: bool = False
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        c = self.out_channels
+        x = ConvBNAct(c, 3, act_type=self.act_type)(x, train)
+        x = ConvBNAct(c, 3, act_type=self.act_type)(x, train)
+        if self.extra_conv:
+            x = ConvBNAct(c, 3, act_type=self.act_type)(x, train)
+        return max_pool_argmax_2x2(x)
+
+
+class UpsampleBlock(nn.Module):
+    out_channels: int
+    act_type: str = 'relu'
+    extra_conv: bool = False
+
+    @nn.compact
+    def __call__(self, x, indices, train=False):
+        in_c = x.shape[-1]
+        hid = in_c if self.extra_conv else self.out_channels
+        x = max_unpool_2x2(x, indices)
+        x = ConvBNAct(in_c, 3, act_type=self.act_type)(x, train)
+        x = ConvBNAct(hid, 3, act_type=self.act_type)(x, train)
+        if self.extra_conv:
+            x = ConvBNAct(self.out_channels, 3,
+                          act_type=self.act_type)(x, train)
+        return x
+
+
+class SegNet(nn.Module):
+    num_class: int = 1
+    hid_channel: int = 64
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h, a = self.hid_channel, self.act_type
+        x, i1 = DownsampleBlock(h, a, False)(x, train)
+        x, i2 = DownsampleBlock(h * 2, a, False)(x, train)
+        x, i3 = DownsampleBlock(h * 4, a, True)(x, train)
+        x, i4 = DownsampleBlock(h * 8, a, True)(x, train)
+        x, i5 = DownsampleBlock(h * 8, a, True)(x, train)
+        x = UpsampleBlock(h * 8, a, True)(x, i5, train)
+        x = UpsampleBlock(h * 4, a, True)(x, i4, train)
+        x = UpsampleBlock(h * 2, a, True)(x, i3, train)
+        x = UpsampleBlock(h, a, False)(x, i2, train)
+        x = UpsampleBlock(h, a, False)(x, i1, train)
+        return ConvBNAct(self.num_class, act_type=a)(x, train)

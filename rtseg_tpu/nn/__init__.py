@@ -1,11 +1,11 @@
 from .modules import (ACTIVATIONS, Activation, BatchNorm, Conv, ConvBNAct,
-                      DSConvBNAct, DWConvBNAct, DeConvBNAct, PReLU,
+                      DSConvBNAct, DWConvBNAct, DeConvBNAct, Dropout, PReLU,
                       PWConvBNAct, PyramidPoolingModule, SegHead, conv1x1,
                       conv3x3, get_bn_axis, set_bn_axis)
 
 __all__ = [
     'ACTIVATIONS', 'Activation', 'BatchNorm', 'Conv', 'ConvBNAct',
-    'DSConvBNAct', 'DWConvBNAct', 'DeConvBNAct', 'PReLU', 'PWConvBNAct',
-    'PyramidPoolingModule', 'SegHead', 'conv1x1', 'conv3x3', 'get_bn_axis',
-    'set_bn_axis',
+    'DSConvBNAct', 'DWConvBNAct', 'DeConvBNAct', 'Dropout', 'PReLU',
+    'PWConvBNAct', 'PyramidPoolingModule', 'SegHead', 'conv1x1', 'conv3x3',
+    'get_bn_axis', 'set_bn_axis',
 ]

@@ -268,6 +268,19 @@ class DeConvBNAct(nn.Module):
         return Activation(self.act_type)(x)
 
 
+# ---------------------------------------------------------------------- misc
+
+class Dropout(nn.Module):
+    """torch nn.Dropout equivalent; needs an apply-time 'dropout' rng when
+    train=True (the train step folds one in per step/shard)."""
+    rate: float = 0.5
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        return nn.Dropout(self.rate, deterministic=not train,
+                          name='drop')(x)
+
+
 # ------------------------------------------------------------- composite heads
 
 class PyramidPoolingModule(nn.Module):

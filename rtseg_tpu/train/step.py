@@ -61,11 +61,18 @@ def build_train_step(config, model, optimizer, mesh: Mesh,
     # utils/parallel.py:36-37) — collective baked into the BN modules.
     set_bn_axis(axes if config.sync_bn else None)
 
-    def forward_loss(params, batch_stats, images, masks):
+    base_rng = jax.random.PRNGKey(config.random_seed + 1)
+
+    def forward_loss(params, batch_stats, images, masks, step):
         variables = {'params': params, 'batch_stats': batch_stats}
         x = images.astype(compute_dtype)
+        # per-step, per-shard dropout rng (torch Dropout semantics)
+        rng = jax.random.fold_in(base_rng, step)
+        for ax in axes:
+            rng = jax.random.fold_in(rng, lax.axis_index(ax))
         out, mutated = model.apply(variables, x, True,
-                                   mutable=['batch_stats'])
+                                   mutable=['batch_stats'],
+                                   rngs={'dropout': rng})
         metrics = {}
         if config.use_aux:
             preds, preds_aux = out
@@ -112,7 +119,7 @@ def build_train_step(config, model, optimizer, mesh: Mesh,
     def step(state: TrainState, images, masks):
         grad_fn = jax.value_and_grad(forward_loss, has_aux=True)
         (loss, (new_bs, metrics)), grads = grad_fn(
-            state.params, state.batch_stats, images, masks)
+            state.params, state.batch_stats, images, masks, state.step)
 
         # the one collective DDP hides in backward hooks:
         grads = lax.pmean(grads, axes)

@@ -105,3 +105,36 @@ def test_bisenetv1_forward():
     n, v = flax_param_count(m)
     out = m.apply(v, jnp.zeros((1, H, W, 3)), False)
     assert out.shape == (1, H, W, NC)
+
+
+# Simple no-backbone models: (reference file, class name). The same name is
+# used for the rtseg_tpu.models submodule and class.
+SIMPLE_MODELS = [
+    ('enet', 'ENet'),
+    ('erfnet', 'ERFNet'),
+    ('segnet', 'SegNet'),
+    ('edanet', 'EDANet'),
+    ('cgnet', 'CGNet'),
+    ('dabnet', 'DABNet'),
+    ('contextnet', 'ContextNet'),
+    ('fssnet', 'FSSNet'),
+    ('esnet', 'ESNet'),
+]
+
+
+@pytest.mark.parametrize('fname,cls', SIMPLE_MODELS)
+def test_simple_model_parity(fname, cls):
+    import importlib
+    ref = load_ref_model_module(fname)
+    want = torch_param_count(getattr(ref, cls)(num_class=NC))
+    M = getattr(importlib.import_module(f'rtseg_tpu.models.{fname}'), cls)
+    m = M(num_class=NC)
+    n, v = flax_param_count(m)
+    assert n == want, f'{fname}: {n} != {want}'
+    out = m.apply(v, jnp.zeros((1, H, W, 3)), False)
+    assert out.shape == (1, H, W, NC)
+    # train-mode forward (dropout rng where needed)
+    out, _ = m.apply(v, jnp.zeros((1, H, W, 3)), True,
+                     mutable=['batch_stats'],
+                     rngs={'dropout': jax.random.PRNGKey(1)})
+    assert out.shape == (1, H, W, NC)
