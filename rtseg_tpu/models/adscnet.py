@@ -1,0 +1,80 @@
+"""ADSCNet (s10489-019-01587-1), TPU-native Flax build.
+
+Behavior parity with reference models/adscnet.py:15-125: asymmetric
+depth-wise separable modules (DW 3x1 + 1x1 + DW 1x3 + 1x1; stride-2
+variant concats an avg-pooled copy), dense dilated concat context block
+(DDCC with same-size avg pools), deconv decoder with encoder skips.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..nn import Conv, ConvBNAct, DWConvBNAct, DeConvBNAct
+from ..ops import avg_pool
+
+
+class ADSCModule(nn.Module):
+    stride: int = 1
+    dilation: int = 1
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        assert self.stride in (1, 2), 'Unsupported stride type.'
+        c = x.shape[-1]
+        a = self.act_type
+        y = DWConvBNAct(c, (3, 1), self.stride, self.dilation, a)(x, train)
+        y = Conv(c, 1)(y)
+        y = DWConvBNAct(c, (1, 3), 1, self.dilation, a)(y, train)
+        y = Conv(c, 1)(y)
+        if self.stride == 1:
+            return x + y
+        return jnp.concatenate([y, avg_pool(x, 3, 2, 1)], axis=-1)
+
+
+class DDCC(nn.Module):
+    """Dense dilated concat context (reference :81-125); the avg pools use
+    kernel=dilation, stride 1, pad=dilation//2 (same spatial size)."""
+    dilations: tuple = (3, 5, 9, 13)
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        c = x.shape[-1]
+        a = self.act_type
+        feats = [x]
+        for i, d in enumerate(self.dilations):
+            y = jnp.concatenate(feats, axis=-1)
+            if i > 0:
+                y = Conv(c, 1, name=f'proj{i + 1}')(y)
+            y = avg_pool(y, d, 1, d // 2)
+            y = ADSCModule(1, d, a)(y, train)
+            feats.append(y)
+        return Conv(c, 1, name='conv_last')(
+            jnp.concatenate(feats, axis=-1))
+
+
+class ADSCNet(nn.Module):
+    num_class: int = 1
+    act_type: str = 'relu6'
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        a = self.act_type
+        x = ConvBNAct(32, 3, 2, act_type=a)(x, train)
+        x1 = ADSCModule(1, act_type=a)(x, train)
+        x = ADSCModule(1, act_type=a)(x1, train)
+        x = ADSCModule(2, act_type=a)(x, train)          # 32 -> 64
+        x4 = ADSCModule(1, act_type=a)(x, train)
+        x = ADSCModule(2, act_type=a)(x4, train)         # 64 -> 128
+        x = DDCC((3, 5, 9, 13), a)(x, train)
+        x = DeConvBNAct(64)(x, train)
+        x = ADSCModule(1, act_type=a)(x, train)
+        x = x + x4
+        x = ADSCModule(1, act_type=a)(x, train)
+        x = DeConvBNAct(32)(x, train)
+        x = x + x1
+        x = ADSCModule(1, act_type=a)(x, train)
+        return DeConvBNAct(self.num_class)(x, train)

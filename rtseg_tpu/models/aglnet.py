@@ -1,0 +1,103 @@
+"""AGLNet (S1568494620306207), TPU-native Flax build.
+
+Behavior parity with reference models/aglnet.py:18-179: ENet downsampling +
+LEDNet SSnbt encoder, pyramid-feature-attention module with global-pool
+residual (FAPM), two gated attention upsample modules (GAUM), 1x1 head.
+"""
+
+from __future__ import annotations
+
+import jax
+from flax import linen as nn
+
+from ..nn import Activation, BatchNorm, Conv, ConvBNAct
+from ..ops import global_avg_pool, resize_bilinear
+from .enet import InitialBlock as DownsamplingUnit
+from .lednet import SSnbtUnit
+
+
+class PyramidFeatureAttention(nn.Module):
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        a = self.act_type
+        size0 = x.shape[1:3]
+        x = ConvBNAct(1, (1, 7), 2, act_type=a)(x, train)
+        size1 = x.shape[1:3]
+        x1 = ConvBNAct(1, (7, 1), 1, act_type=a)(x, train)
+        x = ConvBNAct(1, (1, 5), 2, act_type=a)(x, train)
+        size2 = x.shape[1:3]
+        x2 = ConvBNAct(1, (5, 1), 1, act_type=a)(x, train)
+        x = ConvBNAct(1, (1, 3), 2, act_type=a)(x, train)
+        x = ConvBNAct(1, (3, 1), 1, act_type=a)(x, train)
+        x = resize_bilinear(x, size2, align_corners=True) + x2
+        x = resize_bilinear(x, size1, align_corners=True) + x1
+        return resize_bilinear(x, size0, align_corners=True)
+
+
+class FAPM(nn.Module):
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        c = x.shape[-1]
+        size = x.shape[1:3]
+        pfa = PyramidFeatureAttention(self.act_type)(x, train)
+        pfa = Conv(c, 1)(pfa)
+        gp = Conv(c, 1)(global_avg_pool(x))
+        gp = resize_bilinear(gp, size, align_corners=True)
+        return x * pfa + gp
+
+
+class GAUM(nn.Module):
+    low_channels: int
+    out_channels: int
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x_high, x_low, train=False):
+        # spatial gate on the skip features
+        s = jax.nn.sigmoid(Conv(1, 1, name='sab')(x_low))
+        x_low = x_low * s
+        # deconv upsample of the deep features (k3 s2 p1 outpad1, bias=True)
+        y = nn.ConvTranspose(self.low_channels, (3, 3), (2, 2),
+                             padding=((1, 2), (1, 2)), use_bias=True,
+                             dtype=x_high.dtype, param_dtype=jax.numpy.float32,
+                             transpose_kernel=True, name='up_conv')(x_high)
+        y = BatchNorm()(y, train)
+        y = Activation(self.act_type)(y)
+        skip = y
+        y = y * x_low
+        skip2 = y
+        c = jax.nn.sigmoid(Conv(self.out_channels, 1, name='cab')(
+            global_avg_pool(y)))
+        y = y * c
+        y = y * skip2
+        return y + skip
+
+
+class AGLNet(nn.Module):
+    num_class: int = 1
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        size = x.shape[1:3]
+        a = self.act_type
+        x = DownsamplingUnit(32, a)(x, train)
+        for _ in range(3):
+            x = SSnbtUnit(1, a)(x, train)
+        x_s1 = x
+        x = DownsamplingUnit(64, a)(x, train)
+        for _ in range(2):
+            x = SSnbtUnit(1, a)(x, train)
+        x_s2 = x
+        x = DownsamplingUnit(128, a)(x, train)
+        for d in (1, 2, 5, 9, 2, 5, 9, 17):
+            x = SSnbtUnit(d, a)(x, train)
+        x = FAPM(a)(x, train)
+        x = GAUM(64, 64, a)(x, x_s2, train)
+        x = GAUM(32, 32, a)(x, x_s1, train)
+        x = Conv(self.num_class, 1)(x)
+        return resize_bilinear(x, size, align_corners=True)

@@ -1,0 +1,99 @@
+"""CFPNet (arXiv:2103.12212), TPU-native Flax build.
+
+Behavior parity with reference models/cfpnet.py:17-138: channel-wise
+feature-pyramid modules (K=4 parallel asymmetric-dilated FPC ladders with
+cumulative sums), ENet downsampling, multi-scale input injection.
+"""
+
+from __future__ import annotations
+
+from math import ceil
+from typing import Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..nn import ConvBNAct
+from ..ops import resize_bilinear
+from .enet import InitialBlock as DownsamplingBlock
+
+
+class FeaturePyramidChannel(nn.Module):
+    channels: int                # output channels (== input of the ladder)
+    dilation: int
+    act_type: str = 'prelu'
+    channel_split: Sequence[int] = (1, 1, 2)
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        c, d, a = self.channels, self.dilation, self.act_type
+        split_num = sum(self.channel_split)
+        assert c % split_num == 0, \
+            f'Channel of FPC should be multiple of {split_num}.'
+        unit = c // split_num
+        ch = [unit * s for s in self.channel_split]
+        outs = []
+        y = x
+        for i in range(3):
+            y = ConvBNAct(ch[i], (3, 1), dilation=d, act_type=a)(y, train)
+            y = ConvBNAct(ch[i], (1, 3), dilation=d, act_type=a)(y, train)
+            outs.append(y)
+        return jnp.concatenate(outs, axis=-1)
+
+
+class CFPModule(nn.Module):
+    rk: int
+    K: int = 4
+    act_type: str = 'prelu'
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        c = x.shape[-1]
+        a = self.act_type
+        ratios = (1 / self.rk, 1 / 4, 1 / 2, 1)
+        ch_kn = c // self.K
+        y = ConvBNAct(ch_kn, 1, act_type=a)(x, train)
+        feats = []
+        for k in range(self.K):
+            dt = ceil(self.rk * ratios[k])
+            z = FeaturePyramidChannel(ch_kn, dt, a)(y, train)
+            if k > 0:
+                z = z + feats[-1]
+            feats.append(z)
+        y = jnp.concatenate(feats, axis=-1)
+        y = ConvBNAct(c, 1, act_type=a)(y, train)
+        return y + x
+
+
+class CFPNet(nn.Module):
+    num_class: int = 1
+    n: int = 2
+    m: int = 6
+    dilations: Sequence[int] = (2, 2, 4, 4, 8, 8, 16, 16)
+    act_type: str = 'prelu'
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        assert len(self.dilations) == self.n + self.m
+        size = x.shape[1:3]
+        a = self.act_type
+        inj = [resize_bilinear(x, (size[0] // s, size[1] // s),
+                               align_corners=True) for s in (2, 4, 8)]
+
+        x = ConvBNAct(32, 3, 2, act_type=a)(x, train)
+        x = ConvBNAct(32, 3, act_type=a)(x, train)
+        x = ConvBNAct(32, 3, act_type=a)(x, train)
+        x = jnp.concatenate([x, inj[0]], axis=-1)
+
+        x = DownsamplingBlock(64, a)(x, train)
+        for d in self.dilations[:self.n]:
+            x = CFPModule(d, act_type=a)(x, train)
+        x = jnp.concatenate([x, inj[1]], axis=-1)
+
+        x = DownsamplingBlock(128, a)(x, train)
+        for d in self.dilations[self.n:]:
+            x = CFPModule(d, act_type=a)(x, train)
+        x = jnp.concatenate([x, inj[2]], axis=-1)
+
+        x = ConvBNAct(self.num_class, 1, act_type=a)(x, train)
+        return resize_bilinear(x, size, align_corners=True)

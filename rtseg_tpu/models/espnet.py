@@ -1,0 +1,131 @@
+"""ESPNet (arXiv:1803.06815), TPU-native Flax build.
+
+Behavior parity with reference models/espnet.py:15-223: hierarchical ESP
+modules (1x1 reduce, K=5 dilated branches d=2^k with hierarchical sums,
+concat, optional residual), input reinforcement at 1/2 and 1/4
+(align_corners=False, reference :47,101), espnet/-a/-b/-c variants, light
+deconv decoder for the full 'espnet' variant.
+"""
+
+from __future__ import annotations
+
+from flax import linen as nn
+import jax.numpy as jnp
+
+from ..nn import Conv, ConvBNAct, DeConvBNAct
+from ..ops import resize_bilinear
+
+
+class ESPModule(nn.Module):
+    out_channels: int
+    K: int = 5
+    ks: int = 3
+    stride: int = 1
+    act_type: str = 'prelu'
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        in_c = x.shape[-1]
+        use_skip = in_c == self.out_channels and self.stride == 1
+        kn = self.out_channels // self.K
+        k1 = self.out_channels - (self.K - 1) * kn
+        residual = x
+        feats = []
+        if k1 == kn:
+            y = Conv(kn, 1, self.stride)(x)
+            for k in range(self.K):
+                z = ConvBNAct(kn, self.ks, 1, 2 ** k,
+                              act_type=self.act_type)(y, train)
+                if k > 0:
+                    z = z + feats[-1]
+                feats.append(z)
+        else:
+            y1 = Conv(k1, 1, self.stride, name='conv_k1')(x)
+            yn = Conv(kn, 1, self.stride, name='conv_kn')(x)
+            feats.append(ConvBNAct(k1, self.ks, 1, 1,
+                                   act_type=self.act_type)(y1, train))
+            for k in range(1, self.K):
+                z = ConvBNAct(kn, self.ks, 1, 2 ** k,
+                              act_type=self.act_type)(yn, train)
+                if k > 1:
+                    z = z + feats[-1]
+                feats.append(z)
+        y = jnp.concatenate(feats, axis=-1)
+        if use_skip:
+            y = y + residual
+        return y
+
+
+class Decoder(nn.Module):
+    num_class: int
+    act_type: str = 'prelu'
+
+    @nn.compact
+    def __call__(self, x, x_l1, x_l2, train=False):
+        nc, a = self.num_class, self.act_type
+        x = DeConvBNAct(nc, act_type=a)(x, train)
+        l2 = ConvBNAct(nc, 1)(x_l2, train)
+        x = ESPModule(nc)(jnp.concatenate([x, l2], axis=-1), train)
+        x = DeConvBNAct(nc, act_type=a)(x, train)
+        l1 = ConvBNAct(nc, 1)(x_l1, train)
+        x = ESPModule(nc)(jnp.concatenate([x, l1], axis=-1), train)
+        return DeConvBNAct(nc)(x, train)
+
+
+class ESPNet(nn.Module):
+    num_class: int = 1
+    arch_type: str = 'espnet'
+    alpha2: int = 2
+    alpha3: int = 8
+    block_channel: tuple = (16, 64, 128)
+    act_type: str = 'prelu'
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if self.arch_type not in ('espnet', 'espnet-a', 'espnet-b',
+                                  'espnet-c'):
+            raise ValueError(
+                f'Unsupport architecture type: {self.arch_type}.')
+        use_skip = self.arch_type in ('espnet', 'espnet-b', 'espnet-c')
+        reinforce = self.arch_type in ('espnet', 'espnet-c')
+        use_decoder = self.arch_type == 'espnet'
+        bc = list(self.block_channel)
+        if self.arch_type == 'espnet-a':
+            bc[2] = bc[1]
+        a = self.act_type
+        x_input = x
+        size = x.shape[1:3]
+
+        x = ConvBNAct(bc[0], 3, 2, act_type=a)(x, train)
+        x_l1 = None
+        if reinforce:
+            half = resize_bilinear(x_input, x.shape[1:3],
+                                   align_corners=False)
+            x = jnp.concatenate([x, half], axis=-1)
+            x_l1 = x
+
+        # L2
+        x = ESPModule(bc[1], stride=2, act_type=a)(x, train)
+        skip = x
+        for _ in range(self.alpha2):
+            x = ESPModule(bc[1], act_type=a)(x, train)
+        if use_skip:
+            x = jnp.concatenate([x, skip], axis=-1)
+        if reinforce:
+            quarter = resize_bilinear(x_input, x.shape[1:3],
+                                      align_corners=False)
+            x = jnp.concatenate([x, quarter], axis=-1)
+        x_l2 = x
+
+        # L3
+        x = ESPModule(128, stride=2, act_type=a)(x, train)
+        skip = x
+        for _ in range(self.alpha3):
+            x = ESPModule(128, act_type=a)(x, train)
+        if use_skip:
+            x = jnp.concatenate([x, skip], axis=-1)
+        if use_decoder:
+            x = ConvBNAct(self.num_class, 1, act_type=a)(x, train)
+            return Decoder(self.num_class, a)(x, x_l1, x_l2, train)
+        x = Conv(self.num_class, 1)(x)
+        return resize_bilinear(x, size, align_corners=True)

@@ -1,0 +1,83 @@
+"""ESPNetv2 (arXiv:1811.11431), TPU-native Flax build.
+
+Behavior parity with reference models/espnetv2.py:17-113: grouped-conv EESP
+units (grouped 1x1 reduce, K=4 dilated DS-conv branches with hierarchical
+sums, grouped 1x1 expand), downsampled-image injection at each strided unit,
+PPM + SegHead decoder over an L4->L3 merge.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..nn import Conv, ConvBNAct, DSConvBNAct, PyramidPoolingModule, SegHead
+from ..ops import avg_pool, resize_bilinear
+
+
+class EESPModule(nn.Module):
+    K: int = 4
+    ks: int = 3
+    stride: int = 1
+    act_type: str = 'prelu'
+
+    @nn.compact
+    def __call__(self, x, img=None, train=False):
+        c = x.shape[-1]
+        assert c % self.K == 0, \
+            'Input channels should be integer multiples of K.'
+        ck = c // self.K
+        use_skip = self.stride == 1
+        if not use_skip and img is None:
+            raise ValueError('Strided EESP unit needs downsampled image.')
+        residual = x
+        y = Conv(ck, 1, groups=self.K, name='conv_init')(x)
+        feats = []
+        for k in range(self.K):
+            z = DSConvBNAct(ck, self.ks, self.stride, 2 ** k,
+                            act_type=self.act_type)(y, train)
+            if k > 0:
+                z = z + feats[-1]
+            feats.append(z)
+        y = jnp.concatenate(feats, axis=-1)
+        y = Conv(c, 1, groups=self.K, name='conv_last')(y)
+        if use_skip:
+            return y + residual
+        residual = avg_pool(residual, 3, 2, 1)
+        y = jnp.concatenate([y, residual], axis=-1)
+        img = ConvBNAct(3, 3)(img, train)
+        img = Conv(2 * c, 1)(img)
+        return y + img
+
+
+class ESPNetv2(nn.Module):
+    num_class: int = 1
+    K: int = 4
+    alpha3: int = 3
+    alpha4: int = 7
+    act_type: str = 'prelu'
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        size = x.shape[1:3]
+        a = self.act_type
+        x_d2 = avg_pool(x, 3, 2, 1)
+        x_d4 = avg_pool(x_d2, 3, 2, 1)
+        x_d8 = avg_pool(x_d4, 3, 2, 1)
+        x_d16 = avg_pool(x_d8, 3, 2, 1)
+
+        x = ConvBNAct(32, 3, 2, act_type=a)(x, train)
+        x = EESPModule(self.K, stride=2, act_type=a)(x, x_d4, train)
+        x = EESPModule(self.K, stride=2, act_type=a)(x, x_d8, train)
+        for _ in range(self.alpha3):
+            x = EESPModule(self.K, act_type=a)(x, train=train)
+        x3 = x
+        x = EESPModule(self.K, stride=2, act_type=a)(x3, x_d16, train)
+        for _ in range(self.alpha4):
+            x = EESPModule(self.K, act_type=a)(x, train=train)
+        x = resize_bilinear(x, x3.shape[1:3], align_corners=True)
+        x = ConvBNAct(128, 1)(x, train)
+        x = jnp.concatenate([x, x3], axis=-1)
+        x = PyramidPoolingModule(256, act_type=a, bias=True)(x, train)
+        x = SegHead(self.num_class, a)(x, train)
+        return resize_bilinear(x, size, align_corners=True)

@@ -1,0 +1,59 @@
+"""FDDWNet (arXiv:1911.00632), TPU-native Flax build.
+
+Behavior parity with reference models/fddwnet.py:16-80: factorized dilated
+depth-wise EERM units over ENet downsampling blocks, long encoder skip
+summed before the 1/4 decoder stage, deconv head.
+"""
+
+from __future__ import annotations
+
+from flax import linen as nn
+
+from ..nn import Activation, ConvBNAct, DWConvBNAct, DeConvBNAct
+from .enet import InitialBlock as DownsamplingUnit
+
+
+class EERMUnit(nn.Module):
+    ks: int = 3
+    dilation: int = 1
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        c = x.shape[-1]
+        k, d, a = self.ks, self.dilation, self.act_type
+        y = DWConvBNAct(c, (k, 1), act_type='none')(x, train)
+        y = DWConvBNAct(c, (1, k), act_type='none')(y, train)
+        y = ConvBNAct(c, 1, act_type=a)(y, train)
+        y = DWConvBNAct(c, (k, 1), dilation=d, act_type='none')(y, train)
+        y = DWConvBNAct(c, (1, k), dilation=d, act_type='none')(y, train)
+        y = ConvBNAct(c, 1, act_type='none')(y, train)
+        return Activation(a)(y + x)
+
+
+class FDDWNet(nn.Module):
+    num_class: int = 1
+    ks: int = 3
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        a, k = self.act_type, self.ks
+        x = DownsamplingUnit(16, a)(x, train)
+        x = DownsamplingUnit(64, a)(x, train)
+        for _ in range(5):
+            x = EERMUnit(k, 1, a)(x, train)
+        residual = x
+        x = DownsamplingUnit(128, a)(residual, train)
+        for d in (1, 2, 5, 9, 1, 2, 5, 9):
+            x = EERMUnit(k, d, a)(x, train)
+        for d in (2, 5, 9, 17, 2, 5, 9, 17):
+            x = EERMUnit(k, d, a)(x, train)
+        x = DeConvBNAct(64, act_type=a)(x, train)
+        for _ in range(2):
+            x = EERMUnit(k, 1, a)(x, train)
+        x = x + residual
+        x = DeConvBNAct(16, act_type=a)(x, train)
+        for _ in range(2):
+            x = EERMUnit(k, 1, a)(x, train)
+        return DeConvBNAct(self.num_class, act_type=a)(x, train)

@@ -1,0 +1,96 @@
+"""FPENet (arXiv:1909.08599), TPU-native Flax build.
+
+Behavior parity with reference models/fpenet.py:15-131: feature-pyramid
+encoding blocks (channel-split multi-dilation DW convs with cumulative
+sums), mutual-embedding upsample decoder (spatial x channel attention),
+1x1 ConvBNAct head + bilinear upsample.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..nn import ConvBNAct, DWConvBNAct
+from ..ops import global_avg_pool, resize_bilinear
+
+
+class FPEBlock(nn.Module):
+    out_channels: int
+    expansion: int
+    stride: int = 1
+    dilations: Sequence[int] = (1, 2, 4, 8)
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        K = len(self.dilations)
+        in_c = x.shape[-1]
+        use_skip = in_c == self.out_channels and self.stride == 1
+        expand = self.out_channels * self.expansion
+        ch = expand // K
+        a = self.act_type
+        residual = x
+        x = ConvBNAct(expand, 1, act_type=a)(x, train)
+        feats = []
+        for i, d in enumerate(self.dilations):
+            y = DWConvBNAct(ch, 3, self.stride, d, act_type=a)(
+                x[..., i * ch:(i + 1) * ch], train)
+            if i > 0:
+                y = y + feats[-1]
+            feats.append(y)
+        x = jnp.concatenate(feats, axis=-1)
+        x = ConvBNAct(self.out_channels, 1, act_type=a)(x, train)
+        if use_skip:
+            x = x + residual
+        return x
+
+
+class MEUModule(nn.Module):
+    out_channels: int
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x_low, x_high, train=False):
+        c, a = self.out_channels, self.act_type
+        x_low = ConvBNAct(c, 1, act_type=a, name='conv_low')(x_low, train)
+        x_high = ConvBNAct(c, 1, act_type=a, name='conv_high')(x_high, train)
+        # spatial attention from the low features, channel attention from high
+        sa = ConvBNAct(1, 1, act_type=a, name='sa')(
+            x_low.mean(axis=-1, keepdims=True), train)
+        ca = ConvBNAct(c, 1, act_type=a, name='ca')(
+            global_avg_pool(x_high), train)
+        x_low = x_low * ca
+        x_high = resize_bilinear(
+            x_high, (x_high.shape[1] * 2, x_high.shape[2] * 2),
+            align_corners=True)
+        x_high = x_high * sa
+        return x_low + x_high
+
+
+class FPENet(nn.Module):
+    num_class: int = 1
+    p: int = 3
+    q: int = 9
+    k: int = 4
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        size = x.shape[1:3]
+        a = self.act_type
+        x = ConvBNAct(16, 3, 2, act_type=a)(x, train)
+        x1 = FPEBlock(16, 1, 1, act_type=a)(x, train)
+        x = FPEBlock(32, self.k, 2, act_type=a)(x1, train)
+        for _ in range(self.p - 1):
+            x = FPEBlock(32, self.k, 1, act_type=a)(x, train)
+        x2 = x
+        x = FPEBlock(64, self.k, 2, act_type=a)(x2, train)
+        for _ in range(self.q - 1):
+            x = FPEBlock(64, self.k, 1, act_type=a)(x, train)
+        x = MEUModule(64, a)(x2, x, train)
+        x = MEUModule(32, a)(x1, x, train)
+        x = ConvBNAct(self.num_class, 1, act_type=a)(x, train)
+        return resize_bilinear(x, size, align_corners=True)

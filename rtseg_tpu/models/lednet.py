@@ -1,0 +1,96 @@
+"""LEDNet (arXiv:1905.02423), TPU-native Flax build.
+
+Behavior parity with reference models/lednet.py:16-136: ENet downsample
+units + split-shuffle non-bottleneck (SSnbt) units (channel split, twin
+asymmetric-conv branches with biased bare convs, concat-residual,
+channel_shuffle), attention-pyramid decoder head.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..nn import Activation, Conv, ConvBNAct
+from ..ops import channel_shuffle, global_avg_pool, resize_bilinear
+from .enet import InitialBlock as DownsampleUnit
+
+
+class SSnbtUnit(nn.Module):
+    dilation: int = 1
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        c = x.shape[-1]
+        assert c % 2 == 0, 'Input channel should be multiple of 2.'
+        s = c // 2
+        d, a = self.dilation, self.act_type
+        act = Activation(a)
+        left, right = x[..., :s], x[..., s:]
+
+        left = act(Conv(s, (3, 1), use_bias=True)(left))
+        left = ConvBNAct(s, (1, 3), act_type=a)(left, train)
+        left = act(Conv(s, (3, 1), dilation=d, use_bias=True)(left))
+        left = ConvBNAct(s, (1, 3), dilation=d, act_type=a)(left, train)
+
+        right = act(Conv(s, (1, 3), use_bias=True)(right))
+        right = ConvBNAct(s, (3, 1), act_type=a)(right, train)
+        right = act(Conv(s, (1, 3), dilation=d, use_bias=True)(right))
+        right = ConvBNAct(s, (3, 1), dilation=d, act_type=a)(right, train)
+
+        y = act(x + jnp.concatenate([left, right], axis=-1))
+        return channel_shuffle(y, 2)
+
+
+class AttentionPyramidNetwork(nn.Module):
+    out_channels: int
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        in_c = x.shape[-1]
+        c, a = self.out_channels, self.act_type
+        size0 = x.shape[1:3]
+
+        l1 = ConvBNAct(in_c, 3, 2, act_type=a)(x, train)
+        size1 = l1.shape[1:3]
+        l2 = ConvBNAct(in_c, 3, 2, act_type=a)(l1, train)
+        size2 = l2.shape[1:3]
+        l3 = ConvBNAct(in_c, 3, 2, act_type=a)(l2, train)
+        l3 = ConvBNAct(c, 3, act_type=a)(l3, train)
+        l3 = resize_bilinear(l3, size2, align_corners=True)
+
+        l2 = ConvBNAct(c, 3, act_type=a)(l2, train)
+        l2 = resize_bilinear(l2 + l3, size1, align_corners=True)
+
+        l1 = ConvBNAct(c, 3, act_type=a)(l1, train)
+        l1 = resize_bilinear(l1 + l2, size0, align_corners=True)
+
+        mid = ConvBNAct(c, 3, act_type=a)(x, train)
+        mid = l1 * mid
+
+        right = ConvBNAct(c, 3, act_type=a)(global_avg_pool(x), train)
+        right = resize_bilinear(right, size0, align_corners=True)
+        return mid + right
+
+
+class LEDNet(nn.Module):
+    num_class: int = 1
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        size = x.shape[1:3]
+        a = self.act_type
+        x = DownsampleUnit(32, a)(x, train)
+        for _ in range(3):
+            x = SSnbtUnit(1, a)(x, train)
+        x = DownsampleUnit(64, a)(x, train)
+        for _ in range(2):
+            x = SSnbtUnit(1, a)(x, train)
+        x = DownsampleUnit(128, a)(x, train)
+        for d in (1, 2, 5, 9, 2, 5, 9, 17):
+            x = SSnbtUnit(d, a)(x, train)
+        x = AttentionPyramidNetwork(self.num_class, a)(x, train)
+        return resize_bilinear(x, size, align_corners=True)

@@ -1,0 +1,71 @@
+"""MiniNet (IEEE 8793923), TPU-native Flax build.
+
+Behavior parity with reference models/mininet.py:14-106: DS-conv
+downsample ladder, dual dilated branches (branch2 goes 2 levels deeper),
+skip-concat deconv upsample ladder, dropout-0.25 conv modules (bare DW
+convs + activation, no BN).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..nn import (Activation, Conv, DSConvBNAct, DeConvBNAct, Dropout,
+                  conv1x1)
+
+
+class ConvModule(nn.Module):
+    dilation: int
+    act_type: str = 'selu'
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        c = x.shape[-1]
+        d, a = self.dilation, self.act_type
+        act = Activation(a)
+        x1 = act(Conv(c, (1, 3), dilation=d, groups=c)(x))
+        x1 = act(Conv(c, (3, 1), dilation=d, groups=c)(x1))
+        y = act(Conv(c, (3, 1), dilation=d, groups=c)(x1))
+        y = Conv(c, (1, 3), dilation=d, groups=c)(y)
+        y = y + x1
+        y = Dropout(0.25)(y, train)
+        return act(y + x)
+
+
+class MiniNet(nn.Module):
+    num_class: int = 1
+    act_type: str = 'selu'
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        a = self.act_type
+        x_d1 = DSConvBNAct(12, 3, 2, act_type=a)(x, train)
+        x_d2 = DSConvBNAct(24, 3, 2, act_type=a)(x_d1, train)
+        x_d3 = DSConvBNAct(48, 3, 2, act_type=a)(x_d2, train)
+        x_d4 = DSConvBNAct(96, 3, 2, act_type=a)(x_d3, train)
+
+        x_b1 = x_d4
+        for d in (1, 2, 4, 8):
+            x_b1 = ConvModule(d, a)(x_b1, train)
+
+        x_d5 = DSConvBNAct(192, 3, 2, act_type=a)(x_d4, train)
+        x_b2 = ConvModule(1, a)(x_d5, train)
+        x_b2 = DSConvBNAct(386, 3, 2, act_type=a)(x_b2, train)
+        x_b2 = ConvModule(1, a)(x_b2, train)
+        x_b2 = ConvModule(1, a)(x_b2, train)
+        x_b2 = DeConvBNAct(192, act_type=a)(x_b2, train)
+        x_b2 = ConvModule(1, a)(x_b2, train)
+        x_b2 = jnp.concatenate([x_b2, x_d5], axis=-1)
+        x_b2 = DeConvBNAct(96, act_type=a)(x_b2, train)
+
+        x = jnp.concatenate([x_b1, x_b2, x_d4], axis=-1)
+        x = DeConvBNAct(96, act_type=a)(x, train)
+        x = ConvModule(1, a)(x, train)
+        x = conv1x1(48)(x)
+        x = jnp.concatenate([x, x_d3], axis=-1)
+        x = DeConvBNAct(24, act_type=a)(x, train)
+        x = jnp.concatenate([x, x_d2], axis=-1)
+        x = DeConvBNAct(12, act_type=a)(x, train)
+        x = jnp.concatenate([x, x_d1], axis=-1)
+        return DeConvBNAct(self.num_class, act_type=a)(x, train)

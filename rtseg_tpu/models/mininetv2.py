@@ -1,0 +1,61 @@
+"""MiniNetv2 (IEEE 9023474), TPU-native Flax build.
+
+Behavior parity with reference models/mininetv2.py:16-84: multi-dilation
+DS convs (plain DW + optional dilated DW summed, then PW), auxiliary
+downsampled 'ref' branch added after the first deconv, bilinear head.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from flax import linen as nn
+
+from ..nn import DWConvBNAct, DeConvBNAct, PWConvBNAct
+from ..ops import resize_bilinear
+from .enet import InitialBlock as DownsamplingUnit
+
+
+class MultiDilationDSConv(nn.Module):
+    out_channels: int
+    kernel_size: int = 3
+    stride: int = 1
+    dilation: int = 1
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        in_c = x.shape[-1]
+        a = self.act_type
+        y = DWConvBNAct(in_c, self.kernel_size, self.stride, 1, a)(x, train)
+        if self.dilation > 1:
+            y = y + DWConvBNAct(in_c, self.kernel_size, self.stride,
+                                self.dilation, a)(x, train)
+        return PWConvBNAct(self.out_channels, a)(y, train)
+
+
+class MiniNetv2(nn.Module):
+    num_class: int = 1
+    feat_dt: Sequence[int] = (1, 2, 1, 4, 1, 8, 1, 16, 1, 1, 1, 2, 1, 4, 1, 8)
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        size = x.shape[1:3]
+        a = self.act_type
+        x_ref = DownsamplingUnit(16, a)(x, train)
+        x_ref = DownsamplingUnit(64, a)(x_ref, train)
+
+        y = DownsamplingUnit(16, a)(x, train)
+        y = DownsamplingUnit(64, a)(y, train)
+        for _ in range(10):
+            y = MultiDilationDSConv(64, act_type=a)(y, train)
+        y = DownsamplingUnit(128, a)(y, train)
+        for d in self.feat_dt:
+            y = MultiDilationDSConv(128, dilation=d, act_type=a)(y, train)
+        y = DeConvBNAct(64, act_type=a)(y, train)
+        y = y + x_ref
+        for _ in range(4):
+            y = MultiDilationDSConv(64, act_type=a)(y, train)
+        y = DeConvBNAct(self.num_class, act_type=a)(y, train)
+        return resize_bilinear(y, size, align_corners=True)

@@ -1,0 +1,84 @@
+"""SQNet (openreview S1uHiFyyg), TPU-native Flax build.
+
+Behavior parity with reference models/sqnet.py:14-112: SqueezeNet-1.1 fire
+encoder, parallel dilated conv context (d=1,2,4,8 summed), deconv decoder
+with bypass refinement skips.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..nn import ConvBNAct, DeConvBNAct
+from ..ops import max_pool
+
+
+class FireModule(nn.Module):
+    sq_channels: int
+    ex1_channels: int
+    ex3_channels: int
+    act_type: str = 'elu'
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        a = self.act_type
+        x = ConvBNAct(self.sq_channels, 1, act_type=a)(x, train)
+        x1 = ConvBNAct(self.ex1_channels, 1, act_type=a)(x, train)
+        x3 = ConvBNAct(self.ex3_channels, 3, act_type=a)(x, train)
+        return jnp.concatenate([x1, x3], axis=-1)
+
+
+class ParallelDilatedConv(nn.Module):
+    out_channels: int
+    dilations: tuple = (1, 2, 4, 8)
+    act_type: str = 'elu'
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        outs = [ConvBNAct(self.out_channels, 3, dilation=d,
+                          act_type=self.act_type)(x, train)
+                for d in self.dilations]
+        return outs[0] + outs[1] + outs[2] + outs[3]
+
+
+class BypassRefinementModule(nn.Module):
+    out_channels: int
+    act_type: str = 'elu'
+
+    @nn.compact
+    def __call__(self, x_low, x_high, train=False):
+        a = self.act_type
+        low = ConvBNAct(x_low.shape[-1], 3, act_type=a)(x_low, train)
+        x = jnp.concatenate([low, x_high], axis=-1)
+        return ConvBNAct(self.out_channels, 3, act_type=a)(x, train)
+
+
+class SQNet(nn.Module):
+    num_class: int = 1
+    act_type: str = 'elu'
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        a = self.act_type
+        x1 = ConvBNAct(64, 3, 2, act_type=a)(x, train)
+        x = max_pool(x1, 3, 2, 1)
+        x = FireModule(16, 64, 64, a)(x, train)
+        x2 = FireModule(16, 64, 64, a)(x, train)
+        x = max_pool(x2, 3, 2, 1)
+        x = FireModule(32, 128, 128, a)(x, train)
+        x3 = FireModule(32, 128, 128, a)(x, train)
+        x = max_pool(x3, 3, 2, 1)
+        x = FireModule(48, 192, 192, a)(x, train)
+        x = FireModule(48, 192, 192, a)(x, train)
+        x = FireModule(64, 256, 256, a)(x, train)
+        x = FireModule(64, 256, 256, a)(x, train)
+
+        x = ParallelDilatedConv(128, (1, 2, 4, 8), a)(x, train)
+        x = DeConvBNAct(128, act_type=a)(x, train)
+        x = BypassRefinementModule(128, a)(x3, x, train)
+        x = DeConvBNAct(128, act_type=a)(x, train)
+        x = BypassRefinementModule(64, a)(x2, x, train)
+        x = DeConvBNAct(64, act_type=a)(x, train)
+        x = BypassRefinementModule(self.num_class, a)(x1, x, train)
+        return DeConvBNAct(self.num_class, act_type=a)(x, train)

@@ -119,7 +119,33 @@ SIMPLE_MODELS = [
     ('contextnet', 'ContextNet'),
     ('fssnet', 'FSSNet'),
     ('esnet', 'ESNet'),
+    ('fddwnet', 'FDDWNet'),
+    ('mininet', 'MiniNet'),
+    ('mininetv2', 'MiniNetv2'),
+    ('fpenet', 'FPENet'),
+    ('lednet', 'LEDNet'),
+    ('aglnet', 'AGLNet'),
+    ('cfpnet', 'CFPNet'),
+    ('adscnet', 'ADSCNet'),
+    ('sqnet', 'SQNet'),
+    ('espnetv2', 'ESPNetv2'),
 ]
+
+
+def test_espnet_variants_parity():
+    '''Reference ESPNet has a mutable-default-argument bug: espnet-a mutates
+    the shared block_channel list (espnet.py:29). Pass a fresh list per
+    construction to compare against the intended architecture.'''
+    ref = load_ref_model_module('espnet')
+    from rtseg_tpu.models.espnet import ESPNet
+    for arch in ('espnet', 'espnet-a', 'espnet-b', 'espnet-c'):
+        want = torch_param_count(ref.ESPNet(
+            num_class=NC, arch_type=arch, block_channel=[16, 64, 128]))
+        m = ESPNet(num_class=NC, arch_type=arch)
+        n, v = flax_param_count(m)
+        assert n == want, f'{arch}: {n} != {want}'
+        out = m.apply(v, jnp.zeros((1, H, W, 3)), False)
+        assert out.shape == (1, H, W, NC)
 
 
 @pytest.mark.parametrize('fname,cls', SIMPLE_MODELS)
