@@ -1,0 +1,87 @@
+"""CANet (arXiv:1907.10958), TPU-native Flax build.
+
+Behavior parity with reference models/canet.py:15-117: spatial branch
+(3 stride-2 convs), context branch (MobileNetV2/ResNet + two deconv merges),
+feature cross attention (spatial gate from spatial branch x channel gate
+from context branch), deconv x8 upsample head.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..nn import ConvBNAct, DeConvBNAct
+from ..ops import adaptive_max_pool, global_avg_pool
+from .backbone import Mobilenetv2, ResNet
+
+
+class SpatialBranch(nn.Module):
+    channels: int = 64
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        a = self.act_type
+        c = self.channels
+        x = ConvBNAct(c, 3, 2, act_type=a)(x, train)
+        x = ConvBNAct(c * 2, 3, 2, act_type=a)(x, train)
+        return ConvBNAct(c * 4, 3, 2, act_type=a)(x, train)
+
+
+class ContextBranch(nn.Module):
+    out_channels: int
+    backbone_type: str = 'mobilenet_v2'
+    hid_channels: int = 192
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        if 'mobilenet' in self.backbone_type:
+            feats = Mobilenetv2(name='backbone')(x, train)
+        elif 'resnet' in self.backbone_type:
+            feats = ResNet(self.backbone_type, name='backbone')(x, train)
+        else:
+            raise NotImplementedError()
+        _, _, x_d16, x = feats
+        x = DeConvBNAct(self.hid_channels)(x, train)
+        x = jnp.concatenate([x, x_d16], axis=-1)
+        return DeConvBNAct(self.out_channels)(x, train)
+
+
+class FeatureCrossAttentionModule(nn.Module):
+    out_channels: int
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x_s, x_c, train=False):
+        c = x_s.shape[-1]
+        a = self.act_type
+        x = jnp.concatenate([x_s, x_c], axis=-1)
+        sa = ConvBNAct(1, act_type='sigmoid')(x_s, train)
+        # channel attention: shared Dense over max+avg pooled context
+        fc = nn.Dense(c, name='ca_fc')
+        g_max = fc(adaptive_max_pool(x_c, 1)[:, 0, 0, :])
+        g_avg = fc(global_avg_pool(x_c)[:, 0, 0, :])
+        ca = jax.nn.sigmoid(g_max + g_avg)[:, None, None, :]
+
+        x = ConvBNAct(c, act_type=a)(x, train)
+        residual = x
+        x = x * sa
+        x = x * ca
+        x = x + residual
+        return ConvBNAct(self.out_channels)(x, train)
+
+
+class CANet(nn.Module):
+    num_class: int = 1
+    backbone_type: str = 'mobilenet_v2'
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x_s = SpatialBranch(64, self.act_type)(x, train)
+        x_c = ContextBranch(256, self.backbone_type)(x, train)
+        x = FeatureCrossAttentionModule(self.num_class,
+                                        self.act_type)(x_s, x_c, train)
+        return DeConvBNAct(self.num_class, scale_factor=8)(x, train)

@@ -1,0 +1,152 @@
+"""DFANet (arXiv:1904.02216), TPU-native Flax build.
+
+Behavior parity with reference models/dfanet.py:15-193: three cascaded
+Xception-A encoders with feature + FC-attention aggregation (channel-rotated
+concat fusion between backbones), multi-scale additive decoder.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..nn import (Activation, Conv, ConvBNAct, DSConvBNAct, DWConvBNAct,
+                  SegHead)
+from ..ops import adaptive_max_pool, resize_bilinear
+
+
+class XceptionBlock(nn.Module):
+    out_channels: int
+    stride: int = 1
+    expansion: int = 4
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        in_c = x.shape[-1]
+        c, a = self.out_channels, self.act_type
+        use_skip = in_c == c and self.stride == 1
+        hid = c // self.expansion
+        y = DSConvBNAct(hid, 3, act_type=a)(x, train)
+        y = DSConvBNAct(hid, 3, act_type=a)(y, train)
+        y = DWConvBNAct(c, 3, self.stride, act_type=a)(y, train)
+        y = Conv(c, 1)(y)
+        y = Activation(a)(y)
+        if self.stride > 1:
+            y = y + Conv(c, 1, 2)(x)
+        if use_skip:
+            y = y + x
+        return y
+
+
+class FCAttention(nn.Module):
+    act_type: str = 'relu'
+    linear_channels: int = 1000
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        c = x.shape[-1]
+        att = adaptive_max_pool(x, 1)[:, 0, 0, :]
+        att = nn.Dense(self.linear_channels)(att)
+        att = att[:, None, None, :]
+        att = ConvBNAct(c, 1, act_type=self.act_type)(att, train)
+        return x * att
+
+
+class Encoder(nn.Module):
+    channels: Sequence[int]
+    expansion: int = 4
+    repeat_times: Sequence[int] = (4, 6, 4)
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, x_enc2=None, x_enc3=None, x_enc4=None, train=False):
+        ch, a = self.channels, self.act_type
+
+        def block(x, c, rep, name):
+            x = XceptionBlock(c, 2, self.expansion, a,
+                              name=f'{name}_0')(x, train)
+            for i in range(1, rep):
+                x = XceptionBlock(c, 1, self.expansion, a,
+                                  name=f'{name}_{i}')(x, train)
+            return x
+
+        if x_enc2 is not None:
+            x = jnp.concatenate([x, x_enc2], axis=-1)
+        x = block(x, ch[0], self.repeat_times[0], 'enc2')
+        x_enc2 = x
+        if x_enc3 is not None:
+            x = jnp.concatenate([x, x_enc3], axis=-1)
+        x = block(x, ch[1], self.repeat_times[1], 'enc3')
+        x_enc3 = x
+        if x_enc4 is not None:
+            x = jnp.concatenate([x, x_enc4], axis=-1)
+        x = block(x, ch[2], self.repeat_times[2], 'enc4')
+        x_enc4 = x
+        x = FCAttention(a)(x, train)
+        return x, x_enc2, x_enc3, x_enc4
+
+
+class Decoder(nn.Module):
+    num_class: int
+    act_type: str = 'relu'
+    hid_channels: int = 48
+
+    @nn.compact
+    def __call__(self, enc1, enc2, enc3, fc1, fc2, fc3, train=False):
+        a, hid = self.act_type, self.hid_channels
+
+        def up(x, s):
+            return resize_bilinear(x, (x.shape[1] * s, x.shape[2] * s),
+                                   align_corners=True)
+
+        e1 = ConvBNAct(hid, 3, act_type=a)(enc1, train)
+        e2 = up(ConvBNAct(hid, 3, act_type=a)(enc2, train), 2)
+        e3 = up(ConvBNAct(hid, 3, act_type=a)(enc3, train), 4)
+        enc = Conv(self.num_class, 1)(e1 + e2 + e3)
+
+        f1 = up(SegHead(self.num_class, a)(fc1, train), 4)
+        f2 = up(SegHead(self.num_class, a)(fc2, train), 8)
+        f3 = up(SegHead(self.num_class, a)(fc3, train), 16)
+        return up(enc + f1 + f2 + f3, 4)
+
+
+class DFANet(nn.Module):
+    num_class: int = 1
+    backbone_type: str = 'XceptionA'
+    expansion: int = 4
+    repeat_times: Sequence[int] = (4, 6, 4)
+    use_extra_backbone: bool = True
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if self.backbone_type == 'XceptionA':
+            ch = (48, 96, 192)
+        elif self.backbone_type == 'XceptionB':
+            ch = (32, 64, 128)
+        else:
+            raise NotImplementedError()
+        a = self.act_type
+        x = ConvBNAct(8, 3, 2, act_type=a)(x, train)
+        x, e2, e3, e4 = Encoder(ch, self.expansion, self.repeat_times, a,
+                                name='backbone1')(x, train=train)
+        if not self.use_extra_backbone:
+            x = SegHead(self.num_class, a)(x, train)
+            return resize_bilinear(x, (x.shape[1] * 16, x.shape[2] * 16),
+                                   align_corners=True)
+
+        enc1, fc1 = e2, x
+        x = resize_bilinear(x, (x.shape[1] * 4, x.shape[2] * 4),
+                            align_corners=True)
+        x, e2, e3, e4 = Encoder(ch, self.expansion, self.repeat_times, a,
+                                name='backbone2')(x, e2, e3, e4, train)
+        enc2, fc2 = e2, x
+        x = resize_bilinear(x, (x.shape[1] * 4, x.shape[2] * 4),
+                            align_corners=True)
+        fc3, enc3, _, _ = Encoder(ch, self.expansion, self.repeat_times, a,
+                                  name='backbone3')(x, e2, e3, e4, train)
+        return Decoder(self.num_class, a)(enc1, enc2, enc3, fc1, fc2, fc3,
+                                          train)

@@ -1,0 +1,66 @@
+"""FarSee-Net (arXiv:2003.03913), TPU-native Flax build.
+
+Behavior parity with reference models/farseenet.py:17-106: ResNet frontend,
+FASPP backend (parallel dilated DW branches over the 1/32 features,
+PixelShuffle x2 sub-pixel upsample, low-level fusion at 1/16, PixelShuffle
+x4 to 1/4), final bilinear to input size.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..nn import Conv, ConvBNAct, DWConvBNAct
+from ..ops import pixel_shuffle, resize_bilinear
+from .backbone import ResNet
+
+
+class FASPP(nn.Module):
+    num_class: int
+    act_type: str = 'relu'
+    dilations: tuple = (6, 12, 18)
+    hid_channels: int = 256
+
+    @nn.compact
+    def __call__(self, x_high, x_low, train=False):
+        hid, a = self.hid_channels, self.act_type
+        # high-level branches
+        feats = [ConvBNAct(hid, 1, act_type=a)(x_high, train)]
+        for dt in self.dilations:
+            y = ConvBNAct(hid, 1, act_type=a)(x_high, train)
+            y = DWConvBNAct(hid, 3, dilation=dt, act_type=a)(y, train)
+            feats.append(y)
+        x = jnp.concatenate(feats, axis=-1)
+        x = Conv(hid * 2 * 4, 1)(x)
+        x = pixel_shuffle(x, 2)
+
+        # low-level fusion
+        x_low = ConvBNAct(48, 1, act_type=a)(x_low, train)
+        x = jnp.concatenate([x, x_low], axis=-1)
+        feats = [ConvBNAct(hid // 2, 1, act_type=a)(x, train)]
+        for dt in self.dilations[:-1]:
+            y = ConvBNAct(hid // 2, 1, act_type=a)(x, train)
+            y = DWConvBNAct(hid // 2, 3, dilation=dt, act_type=a)(y, train)
+            feats.append(y)
+        x = jnp.concatenate(feats, axis=-1)
+        x = ConvBNAct(hid * 2, 1, act_type=a)(x, train)
+        x = ConvBNAct(hid * 2, 3, act_type=a)(x, train)
+        x = Conv(self.num_class * 16, 1)(x)
+        return pixel_shuffle(x, 4)
+
+
+class FarSeeNet(nn.Module):
+    num_class: int = 1
+    backbone_type: str = 'resnet18'
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if 'resnet' not in self.backbone_type:
+            raise NotImplementedError()
+        size = x.shape[1:3]
+        _, _, x_low, x_high = ResNet(self.backbone_type,
+                                     name='frontend')(x, train)
+        x = FASPP(self.num_class, self.act_type)(x_high, x_low, train)
+        return resize_bilinear(x, size, align_corners=True)

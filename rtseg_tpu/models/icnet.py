@@ -1,0 +1,111 @@
+"""ICNet (arXiv:1704.08545), TPU-native Flax build.
+
+Behavior parity with reference models/icnet.py:15-154: 3-resolution cascade
+(1, 1/2, 1/4) sharing one dilated ResNet (the reference surgically rewrites
+torchvision layer3/4 stride-2 convs into dilated stride-1 convs with weight
+copy, icnet.py:124-142 — here the backbone is simply constructed with
+dilations=(1,1,2,4)), PPM on the lowest branch, cascade feature fusion with
+aux heads, SegHead at 1/4.
+
+Deliberate deviation: the reference's surgery dilates only the FIRST conv of
+layer3/layer4's first block, leaving later blocks at dilation 1; this build
+uses the standard DeepLab/torchvision `replace_stride_with_dilation`
+semantics (whole stage dilated). Same parameter count, same output
+geometry, more faithful to the dilated-ResNet literature.
+"""
+
+from __future__ import annotations
+
+from flax import linen as nn
+
+from ..nn import Activation, ConvBNAct, PyramidPoolingModule, SegHead
+from ..ops import resize_bilinear
+from .backbone import ResNet
+
+
+class CascadeFeatureFusionUnit(nn.Module):
+    out_channels: int
+    num_class: int
+    act_type: str = 'relu'
+    use_aux: bool = True
+
+    @nn.compact
+    def __call__(self, x1, x2, train=False):
+        x1 = resize_bilinear(x1, (x1.shape[1] * 2, x1.shape[2] * 2),
+                             align_corners=True)
+        x_aux = None
+        if self.use_aux:
+            x_aux = SegHead(self.num_class, self.act_type,
+                            name='classifier')(x1, train)
+        x1 = ConvBNAct(self.out_channels, 3, 1, 2, act_type='none')(x1, train)
+        x2 = ConvBNAct(self.out_channels, 1, act_type='none')(x2, train)
+        x = Activation(self.act_type)(x1 + x2)
+        if self.use_aux:
+            return x, x_aux
+        return x
+
+
+class HighResolutionBranch(nn.Module):
+    out_channels: int = 128
+    hid_channels: int = 32
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        h, a = self.hid_channels, self.act_type
+        x = ConvBNAct(h, 3, 2, act_type=a)(x, train)
+        x = ConvBNAct(h * 2, 3, 2, act_type=a)(x, train)
+        return ConvBNAct(self.out_channels, 3, 2, act_type=a)(x, train)
+
+
+class ICNet(nn.Module):
+    num_class: int = 1
+    backbone_type: str = 'resnet18'
+    act_type: str = 'relu'
+    use_aux: bool = True
+
+    def setup(self):
+        if 'resnet' not in self.backbone_type:
+            raise NotImplementedError()
+        self.ch2 = 128 if self.backbone_type in ('resnet18', 'resnet34') \
+            else 512
+        # ONE shared dilated backbone serves both the 1/4 and 1/2 branches
+        # (reference calls self.backbone twice, icnet.py:39-43)
+        self.backbone = ResNet(self.backbone_type, dilations=(1, 1, 2, 4))
+        self.bottom_branch = HighResolutionBranch(128, act_type=self.act_type)
+        self.ppm = PyramidPoolingModule(256, act_type=self.act_type)
+        self.cff42 = CascadeFeatureFusionUnit(128, self.num_class,
+                                              self.act_type, self.use_aux)
+        self.cff21 = CascadeFeatureFusionUnit(128, self.num_class,
+                                              self.act_type, self.use_aux)
+        self.seg_head = SegHead(self.num_class, self.act_type)
+
+    def __call__(self, x, train: bool = False):
+        size = x.shape[1:3]
+        x_d2 = resize_bilinear(x, (size[0] // 2, size[1] // 2),
+                               align_corners=True)
+        x_d4 = resize_bilinear(x, (size[0] // 4, size[1] // 4),
+                               align_corners=True)
+
+        # lowest resolution branch: full dilated backbone + PPM (1/32 eq)
+        _, _, _, f4 = self.backbone(x_d4, train)
+        x_d4 = self.ppm(f4, train)
+        # medium resolution branch: layer2 features of the SAME backbone
+        _, f2, _, _ = self.backbone(x_d2, train)
+        # high resolution branch
+        xh = self.bottom_branch(x, train)
+
+        if self.use_aux:
+            x_d2, aux2 = self.cff42(x_d4, f2, train)
+            xh, aux3 = self.cff21(x_d2, xh, train)
+        else:
+            x_d2 = self.cff42(x_d4, f2, train)
+            xh = self.cff21(x_d2, xh, train)
+
+        xh = resize_bilinear(xh, (xh.shape[1] * 2, xh.shape[2] * 2),
+                             align_corners=True)
+        xh = self.seg_head(xh, train)
+        xh = resize_bilinear(xh, size, align_corners=True)
+        if self.use_aux and train:
+            return xh, (aux2, aux3)
+        return xh

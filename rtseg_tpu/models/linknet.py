@@ -1,0 +1,52 @@
+"""LinkNet (arXiv:1707.03718), TPU-native Flax build.
+
+Behavior parity with reference models/linknet.py:15-67: ResNet encoder,
+bottleneck decoder blocks with additive skips, deconv seg head.
+"""
+
+from __future__ import annotations
+
+from flax import linen as nn
+
+from ..nn import ConvBNAct, DeConvBNAct
+from .backbone import ResNet
+
+
+class DecoderBlock(nn.Module):
+    out_channels: int
+    act_type: str = 'relu'
+    scale_factor: int = 2
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        hid = x.shape[-1] // 4
+        a = self.act_type
+        x = ConvBNAct(hid, 1, act_type=a)(x, train)
+        if self.scale_factor > 1:
+            x = DeConvBNAct(hid, self.scale_factor, act_type=a)(x, train)
+        else:
+            x = ConvBNAct(hid, 3, act_type=a)(x, train)
+        return ConvBNAct(self.out_channels, 1, act_type=a)(x, train)
+
+
+class LinkNet(nn.Module):
+    num_class: int = 1
+    backbone_type: str = 'resnet18'
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if 'resnet' not in self.backbone_type:
+            raise NotImplementedError()
+        ch0 = 64 if self.backbone_type in ('resnet18', 'resnet34') else 256
+        a = self.act_type
+        x1, x2, x3, x4 = ResNet(self.backbone_type, name='backbone')(x, train)
+        x = DecoderBlock(x3.shape[-1], a)(x4, train)
+        x = DecoderBlock(x2.shape[-1], a)(x + x3, train)
+        x = DecoderBlock(x1.shape[-1], a)(x + x2, train)
+        x = DecoderBlock(ch0, a, scale_factor=1)(x + x1, train)
+        # seg head: deconv -> conv -> deconv (reference :60-67)
+        hid = ch0 // 2
+        x = DeConvBNAct(hid, act_type=a)(x, train)
+        x = ConvBNAct(hid, 3, act_type=a)(x, train)
+        return DeConvBNAct(self.num_class, act_type=a)(x, train)

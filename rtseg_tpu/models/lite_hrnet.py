@@ -1,0 +1,243 @@
+"""Lite-HRNet (arXiv:2104.06403), TPU-native Flax build.
+
+Behavior parity with reference models/lite_hrnet.py:15-320: shuffle-block
+stem, 2->4 parallel-resolution stages of conditional-channel-weight (CCW)
+blocks gated by cross-resolution weights, dense N-to-N fusion blocks,
+concat representation head. Arch hub litehrnet18/30.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..nn import Conv, ConvBNAct, DSConvBNAct, DWConvBNAct
+from ..ops import (adaptive_avg_pool, channel_shuffle, global_avg_pool,
+                   resize_bilinear, resize_nearest)
+
+ARCH_HUB = {'litehrnet18': (2, 4, 2), 'litehrnet30': (3, 8, 3)}
+
+
+class ShuffleBlock(nn.Module):
+    out_channels: int
+    stride: int = 1
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        in_c = x.shape[-1]
+        in_l = in_c // 2
+        out_l = self.out_channels // 2
+        out_r = self.out_channels - out_l
+        a = self.act_type
+        xl, xr = x[..., :in_l], x[..., in_l:]
+        if self.stride != 1 or in_l != out_l:
+            xl = ConvBNAct(out_l, 1, self.stride, act_type=a)(xl, train)
+        xr = ConvBNAct(out_r, 1, act_type=a)(xr, train)
+        xr = DWConvBNAct(out_r, 3, self.stride, act_type=a)(xr, train)
+        xr = ConvBNAct(out_r, 1, act_type=a)(xr, train)
+        return channel_shuffle(jnp.concatenate([xl, xr], axis=-1), 2)
+
+
+class SpatialWeightModule(nn.Module):
+    act_type: str = 'relu'
+    ch_reduction: int = 8
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        c = x.shape[-1]
+        hid = c // self.ch_reduction
+        g = global_avg_pool(x)
+        g = ConvBNAct(hid, 1, act_type=self.act_type)(g, train)
+        return ConvBNAct(c, 1, act_type='sigmoid')(g, train)
+
+
+class CCWBlock(nn.Module):
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, cr_weight, train=False):
+        in_c = x.shape[-1]
+        in_l = in_c // 2
+        out_l, out_r = in_l, in_c - in_l
+        a = self.act_type
+        xl, xr = x[..., :in_l], x[..., in_l:]
+        # left is identity (stride 1, equal channels)
+        w = resize_nearest(cr_weight, xr.shape[1:3])
+        xr = DWConvBNAct(out_r, 3, 1, act_type=a)(xr * w, train)
+        xr = xr * SpatialWeightModule(a)(xr, train)
+        return channel_shuffle(jnp.concatenate([xl, xr], axis=-1), 2)
+
+
+class CrossResolutionWeightModule(nn.Module):
+    act_type: str = 'relu'
+    ch_reduction: int = 8
+
+    @nn.compact
+    def __call__(self, feats, train=False):
+        pool_size = feats[-1].shape[1:3]
+        ch_r = [f.shape[-1] // 2 for f in feats]
+        parts = []
+        for i, f in enumerate(feats):
+            half = f[..., ch_r[i]:]
+            if i < len(feats) - 1:
+                half = adaptive_avg_pool(half, pool_size)
+            parts.append(half)
+        w = jnp.concatenate(parts, axis=-1)
+        hid = w.shape[-1] // self.ch_reduction
+        w = ConvBNAct(hid, 1, act_type=self.act_type)(w, train)
+        w = ConvBNAct(sum(ch_r), 1, act_type='sigmoid')(w, train)
+        splits = jnp.cumsum(jnp.array(ch_r))[:-1]
+        return jnp.split(w, list(map(int, splits)), axis=-1)
+
+
+class UpsampleBlock(nn.Module):
+    out_channels: int
+    scale_factor: int
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        x = ConvBNAct(self.out_channels, 1, act_type=self.act_type)(x, train)
+        s = self.scale_factor
+        return resize_bilinear(x, (x.shape[1] * s, x.shape[2] * s),
+                               align_corners=True)
+
+
+class DownsampleBlock(nn.Module):
+    out_channels: int
+    num_block: int
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        in_c = x.shape[-1]
+        a = self.act_type
+        if self.num_block > 1:
+            for i in range(self.num_block):
+                hid = in_c if i != self.num_block - 1 else self.out_channels
+                x = DSConvBNAct(hid, 3, 2, act_type=a)(x, train)
+        else:
+            x = DSConvBNAct(self.out_channels, 3, 2, act_type=a)(x, train)
+        return x
+
+
+class FusionBlock(nn.Module):
+    base_ch: int
+    stage: int
+    extra_output: bool
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, feats, train=False):
+        assert self.stage in (2, 3, 4) and len(feats) == self.stage
+        a = self.act_type
+        st = self.stage
+        chans = list(range(st)) + ([st] if self.extra_output else [])
+        chans = [2 ** c * self.base_ch for c in chans]
+
+        # stream1: from feats[0] down to every lower resolution
+        n1 = st + 1 if self.extra_output else st
+        s1 = [feats[0]] + [
+            DownsampleBlock(chans[i], i, a, name=f's1_{i}')(feats[0], train)
+            for i in range(1, n1)]
+        # stream2: feats[1] up to res0, identity, downs
+        n2 = st if self.extra_output else st - 1
+        s2 = [UpsampleBlock(chans[0], 2, a, name='s2_up')(feats[1], train),
+              feats[1]] + [
+            DownsampleBlock(chans[i + 1], i, a, name=f's2_{i}')(
+                feats[1], train) for i in range(1, n2)]
+
+        x3, x4 = None, None
+        x1 = s1[0] + s2[0]
+        x2 = s1[1] + s2[1]
+        if st in (3, 4) or self.extra_output:
+            x3 = s1[2] + s2[2]
+        if st in (3, 4):
+            s3 = [UpsampleBlock(chans[2 - i], 2 ** i, a,
+                                name=f's3_up{i}')(feats[2], train)
+                  for i in range(2, 0, -1)] + [feats[2]]
+            if self.extra_output or st == 4:
+                s3.append(DownsampleBlock(chans[3], 1, a,
+                                          name='s3_down')(feats[2], train))
+            x1 = x1 + s3[0]
+            x2 = x2 + s3[1]
+            x3 = x3 + s3[2]
+            if st == 4 or self.extra_output:
+                x4 = s1[3] + s2[3] + s3[3]
+                if st == 4:
+                    s4 = [UpsampleBlock(chans[3 - i], 2 ** i, a,
+                                        name=f's4_up{i}')(feats[3], train)
+                          for i in range(3, 0, -1)] + [feats[3]]
+                    x1 = x1 + s4[0]
+                    x2 = x2 + s4[1]
+                    x3 = x3 + s4[2]
+                    x4 = x4 + s4[3]
+        res = [x1, x2]
+        if x3 is not None:
+            res.append(x3)
+        if x4 is not None:
+            res.append(x4)
+        return res
+
+
+class StageBlock(nn.Module):
+    base_ch: int
+    stage: int
+    repeat: int
+    num_modules: int
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, feats: List, train=False):
+        for i in range(self.num_modules):
+            cr_weight = CrossResolutionWeightModule(
+                self.act_type, name=f'crw{i}')(feats, train)
+            for j in range(self.stage):
+                for r in range(self.repeat):
+                    feats[j] = CCWBlock(self.act_type,
+                                        name=f'ccw{i}_{j}_{r}')(
+                        feats[j], cr_weight[j], train)
+            extra = (i == self.num_modules - 1) and (self.stage != 4)
+            feats = FusionBlock(self.base_ch, self.stage, extra,
+                                self.act_type, name=f'fusion{i}')(
+                feats, train)
+        return feats
+
+
+class LiteHRNet(nn.Module):
+    num_class: int = 1
+    base_ch: int = 40
+    arch_type: str = 'litehrnet18'
+    repeat: int = 2
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if self.arch_type not in ARCH_HUB:
+            raise ValueError(f'Unsupport architecture type: {self.arch_type}.')
+        nm = ARCH_HUB[self.arch_type]
+        a = self.act_type
+        size = x.shape[1:3]
+
+        x = ConvBNAct(32, 3, 2, act_type=a)(x, train)
+        x = ShuffleBlock(self.base_ch, 2, a)(x, train)
+        x2 = DSConvBNAct(self.base_ch * 2, 3, 2, act_type=a)(x, train)
+        feats = [x, x2]
+        feats = StageBlock(self.base_ch, 2, self.repeat, nm[0], a)(
+            feats, train)
+        feats = StageBlock(self.base_ch, 3, self.repeat, nm[1], a)(
+            feats, train)
+        feats = StageBlock(self.base_ch, 4, self.repeat, nm[2], a)(
+            feats, train)
+
+        # representation head: upsample all to 1/4, concat, DS head
+        top = feats[0].shape[1:3]
+        ups = [feats[0]] + [resize_bilinear(f, top, align_corners=True)
+                            for f in feats[1:]]
+        x = jnp.concatenate(ups, axis=-1)
+        x = DSConvBNAct(128, 3, act_type=a)(x, train)
+        x = Conv(self.num_class, 1)(x)
+        return resize_bilinear(x, size, align_corners=True)

@@ -1,0 +1,62 @@
+"""LiteSeg (arXiv:1912.06683), TPU-native Flax build.
+
+Behavior parity with reference models/liteseg.py:16-82: MobileNetV2/ResNet
+encoder, dense ASPP (d=3,6,9 + global branch, concat with input), skip
+concat at 1/8, conv seg head.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..nn import Conv, ConvBNAct
+from ..ops import global_avg_pool, resize_bilinear
+from .backbone import Mobilenetv2, ResNet
+
+
+class DASPPModule(nn.Module):
+    out_channels: int
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        in_c = x.shape[-1]
+        hid = in_c // 5
+        last = in_c - hid * 4
+        a = self.act_type
+        size = x.shape[1:3]
+        x1 = ConvBNAct(hid, 1, act_type=a)(x, train)
+        x2 = ConvBNAct(hid, 3, dilation=3, act_type=a)(x, train)
+        x3 = ConvBNAct(hid, 3, dilation=6, act_type=a)(x, train)
+        x4 = ConvBNAct(hid, 3, dilation=9, act_type=a)(x, train)
+        x5 = Conv(last, 1)(global_avg_pool(x))
+        x5 = resize_bilinear(x5, size, align_corners=True)
+        y = jnp.concatenate([x, x1, x2, x3, x4, x5], axis=-1)
+        return ConvBNAct(self.out_channels, 1, act_type=a)(y, train)
+
+
+class LiteSeg(nn.Module):
+    num_class: int = 1
+    backbone_type: str = 'mobilenet_v2'
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        size = x.shape[1:3]
+        a = self.act_type
+        if self.backbone_type == 'mobilenet_v2':
+            feats = Mobilenetv2(name='backbone')(x, train)
+        elif 'resnet' in self.backbone_type:
+            feats = ResNet(self.backbone_type, name='backbone')(x, train)
+        else:
+            raise NotImplementedError()
+        _, x1, _, x = feats
+        x = DASPPModule(512, a)(x, train)
+        x = resize_bilinear(x, x1.shape[1:3], align_corners=True)
+        x = jnp.concatenate([x, x1], axis=-1)
+        # seg head (reference :76-82)
+        x = ConvBNAct(256, 3, act_type=a)(x, train)
+        x = ConvBNAct(128, 3, act_type=a)(x, train)
+        x = Conv(self.num_class, 1)(x)
+        return resize_bilinear(x, size, align_corners=True)

@@ -1,0 +1,135 @@
+"""PP-LiteSeg (arXiv:2204.02681), TPU-native Flax build.
+
+Behavior parity with reference models/pp_liteseg.py:15-201: own STDC1/2
+backbone (avg-pool stride variant), simplified PPM (SPPM, summed pooled
+branches + 3x3 conv), flexible-lightweight decoder with unified attention
+fusion (spatial or channel).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..nn import Conv, ConvBNAct
+from ..ops import (adaptive_avg_pool, adaptive_max_pool, avg_pool,
+                   global_avg_pool, resize_bilinear)
+
+DECODER_CHANNEL_HUB = {'stdc1': (32, 64, 128), 'stdc2': (64, 96, 128)}
+REPEAT_TIMES_HUB = {'stdc1': (1, 1, 1), 'stdc2': (3, 4, 2)}
+
+
+class STDCModule(nn.Module):
+    """PP-LiteSeg's STDC module variant: stride-2 pools the 1x1 output with
+    AvgPool(3,2,1) (reference pp_liteseg.py:126-147)."""
+    out_channels: int
+    stride: int = 1
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        c = self.out_channels
+        if c % 8 != 0:
+            raise ValueError('Output channel should be evenly divided by 8.')
+        x = ConvBNAct(c // 2, 1)(x, train)
+        x2 = ConvBNAct(c // 4, 3, self.stride)(x, train)
+        if self.stride == 2:
+            x = avg_pool(x, 3, 2, 1)
+        x3 = ConvBNAct(c // 8, 3)(x2, train)
+        x4 = ConvBNAct(c // 8, 3)(x3, train)
+        return jnp.concatenate([x, x2, x3, x4], axis=-1)
+
+
+class STDCBackbone(nn.Module):
+    encoder_channels: Sequence[int]
+    encoder_type: str = 'stdc1'
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        ec = self.encoder_channels
+        rep = REPEAT_TIMES_HUB[self.encoder_type]
+        a = self.act_type
+        x = ConvBNAct(ec[0], 3, 2)(x, train)
+        x = ConvBNAct(ec[1], 3, 2)(x, train)
+        feats = []
+        for c, r in zip(ec[2:], rep):
+            x = STDCModule(c, 2, a)(x, train)
+            for _ in range(r):
+                x = STDCModule(c, 1, a)(x, train)
+            feats.append(x)
+        return tuple(feats)
+
+
+class SPPM(nn.Module):
+    out_channels: int
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        in_c = x.shape[-1]
+        hid = in_c // 4
+        size = x.shape[1:3]
+        acc = None
+        for i, ps in enumerate((1, 2, 4)):
+            y = adaptive_avg_pool(x, ps)
+            y = ConvBNAct(hid, 1, act_type=self.act_type,
+                          name=f'pool{i + 1}')(y, train)
+            y = resize_bilinear(y, size, align_corners=True)
+            acc = y if acc is None else acc + y
+        return Conv(self.out_channels, 3)(acc)
+
+
+class UAFM(nn.Module):
+    out_channels: int
+    fusion_type: str = 'spatial'
+
+    @nn.compact
+    def __call__(self, x_high, x_low, train=False):
+        if self.fusion_type not in ('spatial', 'channel'):
+            raise ValueError(f'Unsupport fusion type: {self.fusion_type}.')
+        size = x_low.shape[1:3]
+        x_low = Conv(self.out_channels, 1)(x_low)
+        x_up = resize_bilinear(x_high, size, align_corners=True)
+        if self.fusion_type == 'spatial':
+            feats = jnp.concatenate(
+                [x_up.mean(-1, keepdims=True), x_up.max(-1, keepdims=True),
+                 x_low.mean(-1, keepdims=True), x_low.max(-1, keepdims=True)],
+                axis=-1)
+            alpha = jax.nn.sigmoid(Conv(1, 1)(feats))
+        else:
+            feats = jnp.concatenate(
+                [global_avg_pool(x_up), adaptive_max_pool(x_up, 1),
+                 global_avg_pool(x_low), adaptive_max_pool(x_low, 1)],
+                axis=-1)
+            alpha = jax.nn.sigmoid(Conv(self.out_channels, 1)(feats))
+        return alpha * x_up + (1 - alpha) * x_low
+
+
+class PPLiteSeg(nn.Module):
+    num_class: int = 1
+    encoder_channels: Sequence[int] = (32, 64, 256, 512, 1024)
+    encoder_type: str = 'stdc1'
+    fusion_type: str = 'spatial'
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if self.encoder_type not in DECODER_CHANNEL_HUB:
+            raise ValueError(f'Unsupport encoder type: {self.encoder_type}.')
+        dc = DECODER_CHANNEL_HUB[self.encoder_type]
+        size = x.shape[1:3]
+        a = self.act_type
+        x3, x4, x5 = STDCBackbone(self.encoder_channels, self.encoder_type,
+                                  a)(x, train)
+        x5 = SPPM(dc[0], a)(x5, train)
+        x = ConvBNAct(dc[0])(x5, train)
+        x = UAFM(dc[0], self.fusion_type)(x, x4, train)
+        x = ConvBNAct(dc[1])(x, train)
+        x = UAFM(dc[1], self.fusion_type)(x, x3, train)
+        x = ConvBNAct(dc[2])(x, train)
+        x = ConvBNAct(self.num_class, 3, act_type=a)(x, train)
+        return resize_bilinear(x, size, align_corners=True)

@@ -1,0 +1,94 @@
+"""ShelfNet (arXiv:1811.11254), TPU-native Flax build.
+
+Behavior parity with reference models/shelfnet.py:16-135: ResNet encoder
+with 1x1 lateral columns, then decoder-encoder-decoder "shelf" of residual
+S-blocks connected by strided convs / deconvs.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from flax import linen as nn
+
+from ..nn import Conv, ConvBNAct, DeConvBNAct, Activation
+from ..ops import resize_bilinear
+from .backbone import ResNet
+
+
+class SBlock(nn.Module):
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x_l, x_v=0., train=False):
+        c = x_l.shape[-1]
+        a = self.act_type
+        x = x_l + x_v
+        residual = x
+        x = ConvBNAct(c, 3, act_type=a)(x, train)
+        x = ConvBNAct(c, 3, act_type='none')(x, train)
+        return Activation(a)(x + residual)
+
+
+class DecoderBlock(nn.Module):
+    channels: Sequence[int]
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x_a, x_b, x_c, x_d, train=False,
+                 return_hid_feats=False):
+        ch, a = self.channels, self.act_type
+        x_d = SBlock(a, name='block_D')(x_d, train=train)
+        x = DeConvBNAct(ch[2], act_type=a, name='up_D')(x_d, train)
+        x_c = SBlock(a, name='block_C')(x_c, x, train)
+        x = DeConvBNAct(ch[1], act_type=a, name='up_C')(x_c, train)
+        x_b = SBlock(a, name='block_B')(x_b, x, train)
+        x = DeConvBNAct(ch[0], act_type=a, name='up_B')(x_b, train)
+        x_a = SBlock(a, name='block_A')(x_a, x, train)
+        if return_hid_feats:
+            return x_a, x_b, x_c
+        return x_a
+
+
+class EncoderBlock(nn.Module):
+    channels: Sequence[int]
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x_a, x_b, x_c, train=False):
+        ch, a = self.channels, self.act_type
+        x_a = SBlock(a, name='block_A')(x_a, train=train)
+        x = ConvBNAct(ch[1], 3, 2, act_type=a, name='down_A')(x_a, train)
+        x_b = SBlock(a, name='block_B')(x_b, x, train)
+        x = ConvBNAct(ch[2], 3, 2, act_type=a, name='down_B')(x_b, train)
+        x_c = SBlock(a, name='block_C')(x_c, x, train)
+        x_d = ConvBNAct(ch[3], 3, 2, act_type=a, name='down_C')(x_c, train)
+        return x_a, x_b, x_c, x_d
+
+
+class ShelfNet(nn.Module):
+    num_class: int = 1
+    backbone_type: str = 'resnet18'
+    hid_channels: Sequence[int] = (32, 64, 128, 256)
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if 'resnet' not in self.backbone_type:
+            raise NotImplementedError()
+        size = x.shape[1:3]
+        hc, a = self.hid_channels, self.act_type
+        x_a, x_b, x_c, x_d = ResNet(self.backbone_type,
+                                    name='backbone')(x, train)
+        x_a = ConvBNAct(hc[0], 1, act_type=a)(x_a, train)
+        x_b = ConvBNAct(hc[1], 1, act_type=a)(x_b, train)
+        x_c = ConvBNAct(hc[2], 1, act_type=a)(x_c, train)
+        x_d = ConvBNAct(hc[3], 1, act_type=a)(x_d, train)
+
+        x_a, x_b, x_c = DecoderBlock(hc, a, name='decoder2')(
+            x_a, x_b, x_c, x_d, train, return_hid_feats=True)
+        x_a, x_b, x_c, x_d = EncoderBlock(hc, a, name='encoder3')(
+            x_a, x_b, x_c, train)
+        x = DecoderBlock(hc, a, name='decoder4')(x_a, x_b, x_c, x_d, train)
+        x = Conv(self.num_class, 1)(x)
+        return resize_bilinear(x, size, align_corners=True)

@@ -1,0 +1,46 @@
+"""SwiftNet (arXiv:1903.08469), TPU-native Flax build.
+
+Behavior parity with reference models/swiftnet.py:17-72: ResNet/MobileNetV2
+encoder, 1x1 lateral connections to a common width, PPM on the deepest
+features, lightweight additive-skip upsample decoder.
+"""
+
+from __future__ import annotations
+
+from flax import linen as nn
+
+from ..nn import ConvBNAct, PyramidPoolingModule
+from ..ops import resize_bilinear
+from .backbone import Mobilenetv2, ResNet
+
+
+class SwiftNet(nn.Module):
+    num_class: int = 1
+    backbone_type: str = 'resnet18'
+    up_channels: int = 128
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        size = x.shape[1:3]
+        a = self.act_type
+        c = self.up_channels
+        if 'resnet' in self.backbone_type:
+            feats = ResNet(self.backbone_type, name='backbone')(x, train)
+        elif self.backbone_type == 'mobilenet_v2':
+            feats = Mobilenetv2(name='backbone')(x, train)
+        else:
+            raise NotImplementedError()
+        x1, x2, x3, x4 = feats
+        x1 = ConvBNAct(c, 1, act_type=a)(x1, train)
+        x2 = ConvBNAct(c, 1, act_type=a)(x2, train)
+        x3 = ConvBNAct(c, 1, act_type=a)(x3, train)
+        x = PyramidPoolingModule(c, a, bias=True)(x4, train)
+
+        x = resize_bilinear(x, x3.shape[1:3], align_corners=True) + x3
+        x = ConvBNAct(c, 3, act_type=a)(x, train)
+        x = resize_bilinear(x, x2.shape[1:3], align_corners=True) + x2
+        x = ConvBNAct(c, 3, act_type=a)(x, train)
+        x = resize_bilinear(x, x1.shape[1:3], align_corners=True) + x1
+        x = ConvBNAct(self.num_class, 3, act_type=a)(x, train)
+        return resize_bilinear(x, size, align_corners=True)

@@ -164,3 +164,69 @@ def test_simple_model_parity(fname, cls):
                      mutable=['batch_stats'],
                      rngs={'dropout': jax.random.PRNGKey(1)})
     assert out.shape == (1, H, W, NC)
+
+
+def test_dfanet_parity():
+    ref = load_ref_model_module('dfanet')
+    from rtseg_tpu.models.dfanet import DFANet
+    want = torch_param_count(ref.DFANet(num_class=NC))
+    m = DFANet(num_class=NC)
+    n, v = flax_param_count(m)
+    assert n == want, f'{n} != {want}'
+    assert m.apply(v, jnp.zeros((1, H, W, 3)), False).shape == (1, H, W, NC)
+
+
+def test_ppliteseg_parity():
+    ref = load_ref_model_module('pp_liteseg')
+    from rtseg_tpu.models.pp_liteseg import PPLiteSeg
+    for enc in ('stdc1', 'stdc2'):
+        for fus in ('spatial', 'channel'):
+            want = torch_param_count(ref.PPLiteSeg(
+                num_class=NC, encoder_type=enc, fusion_type=fus,
+                encoder_channels=[32, 64, 256, 512, 1024]))
+            m = PPLiteSeg(num_class=NC, encoder_type=enc, fusion_type=fus)
+            n, _ = flax_param_count(m)
+            assert n == want, f'{enc}/{fus}: {n} != {want}'
+
+
+def test_litehrnet_parity():
+    ref = load_ref_model_module('lite_hrnet')
+    from rtseg_tpu.models.lite_hrnet import LiteHRNet
+    for arch in ('litehrnet18', 'litehrnet30'):
+        want = torch_param_count(ref.LiteHRNet(num_class=NC, arch_type=arch))
+        m = LiteHRNet(num_class=NC, arch_type=arch)
+        n, v = flax_param_count(m)
+        assert n == want, f'{arch}: {n} != {want}'
+        assert m.apply(v, jnp.zeros((1, H, W, 3)), False).shape \
+            == (1, H, W, NC)
+
+
+# Models whose reference requires torchvision (absent offline) or is broken:
+# forward-shape contract only. regseg: reference unconstructable (groups ->
+# Activation TypeError, reference modules.py:73-84).
+SHAPE_ONLY_MODELS = [
+    ('regseg', 'RegSeg'), ('linknet', 'LinkNet'), ('swiftnet', 'SwiftNet'),
+    ('liteseg', 'LiteSeg'), ('farseenet', 'FarSeeNet'), ('canet', 'CANet'),
+    ('shelfnet', 'ShelfNet'),
+]
+
+
+@pytest.mark.parametrize('fname,cls', SHAPE_ONLY_MODELS)
+def test_shape_only_model_forward(fname, cls):
+    import importlib
+    M = getattr(importlib.import_module(f'rtseg_tpu.models.{fname}'), cls)
+    m = M(num_class=NC)
+    n, v = flax_param_count(m)
+    assert n > 0
+    out = m.apply(v, jnp.zeros((1, H, W, 3)), False)
+    assert out.shape == (1, H, W, NC)
+
+
+def test_icnet_aux_forward():
+    from rtseg_tpu.models.icnet import ICNet
+    m = ICNet(num_class=NC, use_aux=True)
+    n, v = flax_param_count(m)
+    (main, aux), _ = m.apply(v, jnp.zeros((1, H, W, 3)), True,
+                             mutable=['batch_stats'])
+    assert main.shape == (1, H, W, NC)
+    assert len(aux) == 2
