@@ -38,6 +38,16 @@ def _shard_map(fn, mesh, in_specs, out_specs):
                          out_specs=out_specs, check_vma=False)
 
 
+def _pin_bn_axis(fn: Callable, axis) -> Callable:
+    """jit traces lazily (on first call), but BN modules read the global
+    collective axis at trace time — pin this builder's value right before
+    every call so builders with different strategies can coexist."""
+    def wrapper(*args, **kwargs):
+        set_bn_axis(axis)
+        return fn(*args, **kwargs)
+    return wrapper
+
+
 def _mesh_axes(mesh: Mesh) -> Tuple[str, ...]:
     return tuple(mesh.axis_names)
 
@@ -48,7 +58,21 @@ def build_train_step(config, model, optimizer, mesh: Mesh,
 
     images: [global_B, H, W, 3] fp32/bf16, masks: [global_B, H, W] int32,
     both sharded over the mesh batch axes; state is replicated.
+
+    Two compilation strategies:
+      * data-only mesh -> shard_map with explicit lax.pmean collectives
+        (per-shard control, BN axis_name sync).
+      * mesh with a 'spatial' axis -> GSPMD (jit + sharding annotations):
+        convolutions over the sharded H dimension need halo exchange, which
+        XLA's spatial partitioner inserts automatically — shard_map would
+        silently compute wrong boundaries. BN statistics and gradients are
+        global reductions under GSPMD, so sync-BN/grad-allreduce come for
+        free.
     """
+    from ..parallel.mesh import SPATIAL_AXIS
+    if SPATIAL_AXIS in mesh.axis_names:
+        return _build_train_step_gspmd(config, model, optimizer, mesh,
+                                       teacher_model, teacher_variables)
     loss_fn = get_loss_fn(config)
     detail_loss_fn = get_detail_loss_fn(config)
     kd_fn = get_kd_loss_fn(config)
@@ -59,7 +83,7 @@ def build_train_step(config, model, optimizer, mesh: Mesh,
 
     # cross-replica BN statistics (reference SyncBatchNorm conversion,
     # utils/parallel.py:36-37) — collective baked into the BN modules.
-    set_bn_axis(axes if config.sync_bn else None)
+    bn_axis = axes if config.sync_bn else None
 
     base_rng = jax.random.PRNGKey(config.random_seed + 1)
 
@@ -161,7 +185,100 @@ def build_train_step(config, model, optimizer, mesh: Mesh,
     sharded = _shard_map(step, mesh,
                          in_specs=(P(), bspec, bspec),
                          out_specs=(P(), P()))
-    return jax.jit(sharded, donate_argnums=(0,))
+    return _pin_bn_axis(jax.jit(sharded, donate_argnums=(0,)), bn_axis)
+
+
+def _build_train_step_gspmd(config, model, optimizer, mesh: Mesh,
+                            teacher_model=None,
+                            teacher_variables=None) -> Callable:
+    """GSPMD train step: one jit'd program with sharding annotations; XLA
+    partitions convs over ('data', 'spatial') with automatic halo exchange
+    and turns the global-mean loss/BN statistics into collectives."""
+    from jax.sharding import NamedSharding
+    from ..parallel import batch_sharding, replicated
+
+    loss_fn = get_loss_fn(config)
+    detail_loss_fn = get_detail_loss_fn(config)
+    kd_fn = get_kd_loss_fn(config)
+    compute_dtype = jnp.dtype(config.compute_dtype)
+    total_itrs = max(int(config.total_itrs), 1)
+    aux_coef = config.aux_coef
+    base_rng = jax.random.PRNGKey(config.random_seed + 1)
+
+    def forward_loss(params, batch_stats, images, masks, step):
+        variables = {'params': params, 'batch_stats': batch_stats}
+        x = images.astype(compute_dtype)
+        rng = jax.random.fold_in(base_rng, step)
+        out, mutated = model.apply(variables, x, True,
+                                   mutable=['batch_stats'],
+                                   rngs={'dropout': rng})
+        metrics = {}
+        if config.use_aux:
+            preds, preds_aux = out
+            loss = loss_fn(preds, masks)
+            coefs = aux_coef if aux_coef is not None \
+                else (1.0,) * len(preds_aux)
+            m4 = masks[..., None].astype(jnp.float32)
+            for coef, pa in zip(coefs, preds_aux):
+                ms = resize_nearest(m4, pa.shape[1:3])[..., 0]
+                loss = loss + coef * loss_fn(pa, ms.astype(jnp.int32))
+        elif config.use_detail_head:
+            preds, preds_detail = out
+            loss = loss_fn(preds, masks)
+            pyr = laplacian_pyramid(masks)
+            dgt = model.apply(
+                {'params': jax.lax.stop_gradient(params)}, pyr,
+                method='detail_targets')
+            dgt = (dgt > config.detail_thrs).astype(jnp.float32)
+            pd = resize_bilinear(preds_detail, dgt.shape[1:3],
+                                 align_corners=True)
+            loss_detail = detail_loss_fn(pd.astype(jnp.float32), dgt)
+            metrics['loss_detail'] = loss_detail
+            loss = loss + config.detail_loss_coef * loss_detail
+        else:
+            preds = out
+            loss = loss_fn(preds, masks)
+        if config.kd_training:
+            t_out = teacher_model.apply(teacher_variables, x, False)
+            t_out = jax.lax.stop_gradient(t_out)
+            loss_kd = kd_fn(preds, t_out)
+            metrics['loss_kd'] = loss_kd
+            loss = loss + config.kd_loss_coefficient * loss_kd
+        return loss, (mutated.get('batch_stats', batch_stats), metrics)
+
+    def step(state: TrainState, images, masks):
+        grad_fn = jax.value_and_grad(forward_loss, has_aux=True)
+        (loss, (new_bs, metrics)), grads = grad_fn(
+            state.params, state.batch_stats, images, masks, state.step)
+        updates, new_opt = optimizer.update(grads, state.opt_state,
+                                            state.params)
+        new_params = jax.tree.map(
+            lambda p, u: (p.astype(jnp.float32) + u).astype(p.dtype),
+            state.params, updates)
+        new_step = state.step + 1
+        if config.use_ema:
+            decay = jnp.clip(new_step.astype(jnp.float32) / total_itrs,
+                             0.0, 1.0)
+            new_ema_p = ema_update(new_params, state.ema_params, decay)
+            new_ema_bs = ema_update(new_bs, state.ema_batch_stats, decay)
+        else:
+            new_ema_p = jax.tree.map(lambda x: x, new_params)
+            new_ema_bs = jax.tree.map(lambda x: x, new_bs)
+        metrics = dict(metrics)
+        metrics['loss'] = loss
+        new_state = TrainState(step=new_step, params=new_params,
+                               batch_stats=new_bs, opt_state=new_opt,
+                               ema_params=new_ema_p,
+                               ema_batch_stats=new_ema_bs)
+        return new_state, metrics
+
+    bsh = batch_sharding(mesh)
+    rep = replicated(mesh)
+    # BN batch stats are already global reductions under GSPMD -> no axis
+    return _pin_bn_axis(jax.jit(step,
+                                in_shardings=(rep, bsh, bsh),
+                                out_shardings=(rep, rep),
+                                donate_argnums=(0,)), None)
 
 
 def build_eval_step(config, model, mesh: Mesh, use_ema: bool = True
@@ -169,24 +286,36 @@ def build_eval_step(config, model, mesh: Mesh, use_ema: bool = True
     """Returns eval_step(state, images, masks) -> (C, C) confusion matrix,
     psum'd over the mesh (replaces torchmetrics' internal sync,
     core/seg_trainer.py:131-137). Runs the EMA weights, like the reference
-    validate (core/seg_trainer.py:130)."""
+    validate (core/seg_trainer.py:130). GSPMD path for spatial meshes (same
+    halo-exchange rationale as build_train_step)."""
+    from ..parallel.mesh import SPATIAL_AXIS
     axes = _mesh_axes(mesh)
     compute_dtype = jnp.dtype(config.compute_dtype)
 
-    def step(state: TrainState, images, masks):
+    def forward_cm(state: TrainState, images, masks):
         params = state.ema_params if use_ema else state.params
         bs = state.ema_batch_stats if use_ema else state.batch_stats
         out = model.apply({'params': params, 'batch_stats': bs},
                           images.astype(compute_dtype), False)
         preds = jnp.argmax(out, axis=-1)
-        cm = confusion_matrix(preds, masks, config.num_class,
-                              config.ignore_index)
-        return lax.psum(cm, axes)
+        return confusion_matrix(preds, masks, config.num_class,
+                                config.ignore_index)
+
+    if SPATIAL_AXIS in mesh.axis_names:
+        from ..parallel import batch_sharding, replicated
+        return _pin_bn_axis(
+            jax.jit(forward_cm,
+                    in_shardings=(replicated(mesh), batch_sharding(mesh),
+                                  batch_sharding(mesh)),
+                    out_shardings=replicated(mesh)), None)
+
+    def step(state: TrainState, images, masks):
+        return lax.psum(forward_cm(state, images, masks), axes)
 
     bspec = batch_spec(mesh)
     sharded = _shard_map(step, mesh, in_specs=(P(), bspec, bspec),
                          out_specs=P())
-    return jax.jit(sharded)
+    return _pin_bn_axis(jax.jit(sharded), None)
 
 
 def build_predict_step(config, model, mesh: Optional[Mesh] = None) -> Callable:

@@ -118,3 +118,33 @@ def test_train_step_detail_stdc(mesh8):
     state, metrics = step(state, images, masks)
     assert np.isfinite(float(metrics['loss']))
     assert np.isfinite(float(metrics['loss_detail']))
+
+
+def test_gspmd_spatial_matches_single_device():
+    """The ('data','spatial') GSPMD step is the SAME program as unsharded
+    execution — XLA inserts halo exchange, so sharded loss must equal the
+    single-device loss (shard_map over spatial would get boundaries wrong)."""
+    from jax.sharding import Mesh
+    from rtseg_tpu.parallel.mesh import DATA_AXIS, SPATIAL_AXIS
+
+    devs = jax.devices()
+    if len(devs) < 4:
+        pytest.skip('needs 4 virtual devices')
+    mesh22 = Mesh(np.array(devs[:4]).reshape(2, 2), (DATA_AXIS, SPATIAL_AXIS))
+    mesh1 = Mesh(np.array(devs[:1]), (DATA_AXIS,))
+
+    cfg = _cfg()
+    model = get_model(cfg)
+    opt = get_optimizer(cfg)
+    state = create_train_state(model, opt, jax.random.PRNGKey(0),
+                               jnp.zeros((1, 32, 64, 3), jnp.float32))
+    images, masks = _batch(b=2, h=64, w=64)
+
+    step_sharded = build_train_step(cfg, model, opt, mesh22)
+    step_single = build_train_step(cfg, model, opt, mesh1)
+    _, m_sharded = step_sharded(state, images, masks)
+    state2 = create_train_state(model, opt, jax.random.PRNGKey(0),
+                                jnp.zeros((1, 32, 64, 3), jnp.float32))
+    _, m_single = step_single(state2, images, masks)
+    np.testing.assert_allclose(float(m_sharded['loss']),
+                               float(m_single['loss']), rtol=1e-4)
