@@ -1,0 +1,112 @@
+"""Dataset preparation tool — equivalent of reference
+utils/check_datasets.py:14-99: converts a folder of labelme-style JSON
+polygon annotations into the Custom dataset layout
+(`out/{train,val}/{imgs,masks}` + data.yaml) with a 95/5 split.
+
+Dependency-light rewrite: reads the labelme JSON schema directly (imageData
+base64 or imagePath) and rasterizes polygons with PIL.ImageDraw instead of
+labelme + cv2 (neither ships in this environment).
+"""
+
+from __future__ import annotations
+
+import argparse
+import base64
+import io
+import json
+import os
+import random
+import shutil
+
+
+def _load_image(label_path: str, data: dict):
+    from PIL import Image
+    if data.get('imageData'):
+        raw = base64.b64decode(data['imageData'])
+        return Image.open(io.BytesIO(raw)).convert('RGB')
+    img_path = os.path.join(os.path.dirname(label_path),
+                            data.get('imagePath', ''))
+    return Image.open(img_path).convert('RGB')
+
+
+def _rasterize(data: dict, class_name_to_id: dict, size):
+    from PIL import Image, ImageDraw
+    mask = Image.new('L', size, 0)
+    draw = ImageDraw.Draw(mask)
+    for shape in data.get('shapes', []):
+        if shape.get('shape_type', '') != 'polygon':
+            continue
+        label = shape.get('label', 'None')
+        cid = class_name_to_id.get(label)
+        if cid is None:
+            continue
+        pts = [(float(x), float(y)) for x, y in shape.get('points', [])]
+        if len(pts) >= 3:
+            draw.polygon(pts, fill=cid)
+    return mask
+
+
+def check_semantic_segmentation_datasets(datasets_path: str,
+                                         train_factor: float = 0.95,
+                                         seed: int = 0) -> None:
+    labels_path = os.path.join(datasets_path, 'labels')
+    if not os.path.exists(labels_path):
+        print(f'Error: {labels_path} not found')
+        return
+    root = os.path.join(datasets_path, 'out')
+    if os.path.exists(root):
+        shutil.rmtree(root)
+    dirs = {}
+    for mode in ('train', 'val'):
+        for sub in ('imgs', 'masks'):
+            d = os.path.join(root, mode, sub)
+            os.makedirs(d)
+            dirs[(mode, sub)] = d
+
+    all_data = sorted(i for i in os.listdir(labels_path)
+                      if os.path.splitext(i)[1] == '.json')
+    print('all_data:', len(all_data))
+    rng = random.Random(seed)
+    rng.shuffle(all_data)
+    train_num = round(train_factor * len(all_data))
+
+    # first pass: discover the label set (reference :47-55)
+    class_name_to_id = {'_background': 0}
+    parsed = {}
+    for name in all_data:
+        with open(os.path.join(labels_path, name)) as f:
+            data = json.load(f)
+        parsed[name] = data
+        for shape in data.get('shapes', []):
+            if shape.get('shape_type', '') == 'polygon':
+                label = shape.get('label', 'None')
+                if label not in class_name_to_id:
+                    class_name_to_id[label] = len(class_name_to_id)
+    print(class_name_to_id)
+
+    # second pass: write imgs + rasterized masks per split
+    for idx, name in enumerate(all_data):
+        mode = 'train' if idx < train_num else 'val'
+        base = os.path.splitext(os.path.basename(name))[0]
+        data = parsed[name]
+        img = _load_image(os.path.join(labels_path, name), data)
+        mask = _rasterize(data, class_name_to_id, img.size)
+        img.save(os.path.join(dirs[(mode, 'imgs')], f'{base}.png'))
+        mask.save(os.path.join(dirs[(mode, 'masks')], f'{base}.png'))
+
+    # data.yaml consumed by datasets/custom (reference datasets/custom.py:19-29)
+    names = {v: k for k, v in class_name_to_id.items()}
+    with open(os.path.join(root, 'data.yaml'), 'w') as f:
+        f.write(f'path: {os.path.abspath(root)}\n')
+        f.write('names:\n')
+        for cid in sorted(names):
+            f.write(f'  {cid}: {names[cid]}\n')
+    print(f'Wrote {train_num} train / {len(all_data) - train_num} val '
+          f'samples to {root}')
+
+
+if __name__ == '__main__':
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--datasets_path', type=str, required=True)
+    args = parser.parse_args()
+    check_semantic_segmentation_datasets(args.datasets_path)

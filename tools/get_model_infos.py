@@ -1,0 +1,47 @@
+"""Model complexity info — equivalent of reference tools/get_model_infos.py:9-27.
+
+Parameter count from the Flax param tree; FLOPs from XLA's own compiled cost
+analysis (replaces ptflops), with a param-only fallback mirroring the
+reference's numel path.
+"""
+
+import sys
+from os import path
+
+sys.path.append(path.dirname(path.dirname(path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rtseg_tpu.config import SegConfig, load_parser
+from rtseg_tpu.models import get_model
+
+
+def cal_model_params(config, imgh=1024, imgw=2048):
+    model = get_model(config)
+    x = jnp.zeros((1, imgh, imgw, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, False)
+    n_params = sum(int(np.prod(p.shape))
+                   for p in jax.tree.leaves(variables['params']))
+    print('\n=========Model Info=========')
+    print(f'Model: {config.model}')
+    print(f'Parameters: {n_params / 1e6:.2f} M ({n_params})')
+    try:
+        lowered = jax.jit(
+            lambda v, x: model.apply(v, x, False)).lower(variables, x)
+        cost = lowered.compile().cost_analysis()
+        flops = cost.get('flops') if isinstance(cost, dict) else None
+        if flops:
+            print(f'Forward FLOPs @ {imgw}x{imgh}: {flops / 1e9:.2f} GFLOPs')
+    except Exception as e:                      # cost analysis is best-effort
+        print(f'(FLOPs unavailable: {type(e).__name__})')
+    return n_params
+
+
+if __name__ == '__main__':
+    config = SegConfig(dataset='synthetic', model='bisenetv2', num_class=19)
+    if len(sys.argv) > 1:
+        config = load_parser(config)
+    config.resolve(num_devices=1)
+    cal_model_params(config)

@@ -1,0 +1,80 @@
+"""FPS benchmark — TPU-native equivalent of reference tools/test_speed.py:9-61.
+
+jit'd forward on the configured model, `block_until_ready` fencing replacing
+torch.cuda.synchronize, same warmup (10 iters) + auto-calibration (~6s worth)
+protocol. Reports latency (ms) and FPS at bs1 plus batched imgs/sec (the
+TPU-relevant throughput number).
+"""
+
+import sys
+import time
+from os import path
+
+sys.path.append(path.dirname(path.dirname(path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from rtseg_tpu.config import SegConfig, load_parser
+from rtseg_tpu.models import get_model
+
+
+def test_model_speed(config, ratio=0.5, imgw=2048, imgh=1024,
+                     iterations=None, batch_size=1):
+    if ratio != 1.0:
+        assert ratio > 0, 'Ratio should be larger than 0.'
+        imgw = int(imgw * ratio)
+        imgh = int(imgh * ratio)
+
+    model = get_model(config)
+    print('\n=========Speed Testing=========')
+    print(f'Model: {config.model}\nEncoder: {config.encoder}\n'
+          f'Decoder: {config.decoder}')
+    print(f'Size (W, H): {imgw}, {imgh} | batch: {batch_size}')
+
+    x = jnp.asarray(np.random.randn(batch_size, imgh, imgw, 3)
+                    .astype(np.float32))
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, imgh, imgw, 3)), False)
+
+    dtype = jnp.dtype(config.compute_dtype)
+
+    @jax.jit
+    def fwd(variables, x):
+        return model.apply(variables, x.astype(dtype), False)
+
+    for _ in range(10):                      # warmup + compile
+        jax.block_until_ready(fwd(variables, x))
+
+    if iterations is None:
+        elapsed = 0.0
+        iterations = 100
+        while elapsed < 1:
+            t0 = time.time()
+            for _ in range(iterations):
+                out = fwd(variables, x)
+            jax.block_until_ready(out)
+            elapsed = time.time() - t0
+            iterations *= 2
+        fps = iterations / elapsed
+        iterations = int(fps * 6)
+
+    t0 = time.time()
+    for _ in range(iterations):
+        out = fwd(variables, x)
+    jax.block_until_ready(out)
+    elapsed = time.time() - t0
+    latency = elapsed / iterations * 1000
+    fps = 1000 / latency
+    print(f'Latency: {latency:.3f} ms | FPS: {fps:.1f} | '
+          f'imgs/sec: {fps * batch_size:.1f}\n')
+    return fps
+
+
+if __name__ == '__main__':
+    config = SegConfig(dataset='synthetic', model='bisenetv2', num_class=19)
+    if len(sys.argv) > 1:
+        config = load_parser(config)
+    config.resolve(num_devices=1)
+    test_model_speed(config)
