@@ -33,6 +33,11 @@ class SegConfig:
     encoder: Optional[str] = None          # for model == 'smp' generic enc-dec
     decoder: Optional[str] = None
     encoder_weights: Optional[str] = 'imagenet'
+    # offline pretrained backbone import: local torchvision .pth mapped onto
+    # the model's 'backbone' scope (replaces the reference's torchvision
+    # download side effect, models/backbone.py:7,16)
+    backbone_ckpt: Optional[str] = None
+    backbone_type: str = 'resnet18'
 
     # ----- Detail head, STDC (base_config.py:15-20) -----
     use_detail_head: bool = False

@@ -40,6 +40,8 @@ def get_parser() -> argparse.ArgumentParser:
     p.add_argument('--encoder', type=str)
     p.add_argument('--decoder', type=str, choices=DECODER_CHOICES)
     p.add_argument('--encoder_weights', type=str)
+    p.add_argument('--backbone_ckpt', type=str)
+    p.add_argument('--backbone_type', type=str)
     # Detail head
     p.add_argument('--use_detail_head', action='store_const', const=True)
     p.add_argument('--detail_thrs', type=float)

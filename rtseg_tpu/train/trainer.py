@@ -71,6 +71,7 @@ class SegTrainer:
         self.state = create_train_state(
             self.model, self.optimizer,
             jax.random.PRNGKey(config.random_seed), sample)
+        self._load_pretrained_backbone()
 
         teacher_model, teacher_vars = None, None
         if config.kd_training:
@@ -88,6 +89,33 @@ class SegTrainer:
         self.eval_step = build_eval_step(config, self.model, self.mesh)
         self._batch_sharding = batch_sharding(self.mesh)
         self.load_ckpt()
+
+    def _load_pretrained_backbone(self) -> None:
+        """Offline ImageNet init: import a local torchvision .pth into the
+        model's 'backbone' (or 'frontend'/'encoder') scope — replaces the
+        reference's pretrained=True download (models/backbone.py:7,16)."""
+        cfg = self.config
+        if not cfg.backbone_ckpt:
+            return
+        from ..utils.torch_import import load_torch_backbone
+        params = jax.tree.map(lambda x: x, self.state.params)
+        bstats = jax.tree.map(lambda x: x, self.state.batch_stats)
+        scope = next((s for s in ('backbone', 'frontend', 'encoder')
+                      if s in params), None)
+        if scope is None:
+            raise ValueError(
+                f'Model {cfg.model} has no backbone scope to load '
+                f'{cfg.backbone_ckpt} into.')
+        p, b = load_torch_backbone(cfg.backbone_ckpt, cfg.backbone_type,
+                                   params[scope], bstats.get(scope, {}))
+        params[scope] = jax.tree.map(jnp.asarray, p)
+        bstats[scope] = jax.tree.map(jnp.asarray, b)
+        self.state = self.state.replace(
+            params=params, batch_stats=bstats,
+            ema_params=jax.tree.map(jnp.copy, params),
+            ema_batch_stats=jax.tree.map(jnp.copy, bstats))
+        self.logger.info(
+            f'Imported pretrained backbone from {cfg.backbone_ckpt}')
 
     # ------------------------------------------------------------------ ckpt
     def load_ckpt(self) -> None:

@@ -1,0 +1,156 @@
+"""Offline torch -> Flax weight import.
+
+The reference gets ImageNet backbones by letting torchvision download them at
+model construction (reference models/backbone.py:7,16,40-44). This
+environment has no egress, so weight import is an explicit offline step: the
+user supplies a local torchvision state_dict (.pth) and this module maps it
+onto the Flax param tree of rtseg_tpu.models.backbone.{ResNet, Mobilenetv2}.
+
+Layout conversions:
+  * conv weights: torch (out, in, kh, kw) -> flax (kh, kw, in, out)
+  * grouped/depthwise: torch (out, in/g, kh, kw) -> flax (kh, kw, in/g, out)
+  * linear: torch (out, in) -> flax (in, out)
+  * BN: weight/bias -> scale/bias (params); running_mean/var -> batch_stats
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import numpy as np
+
+
+def _t2f_conv(w: np.ndarray) -> np.ndarray:
+    return np.transpose(w, (2, 3, 1, 0))
+
+
+def load_torch_state_dict(path: str) -> Dict[str, np.ndarray]:
+    import torch
+    sd = torch.load(path, map_location='cpu', weights_only=True)
+    if 'state_dict' in sd:
+        sd = sd['state_dict']
+    return {k: v.numpy() for k, v in sd.items()}
+
+
+def _set(tree: dict, path: Tuple[str, ...], value: np.ndarray) -> None:
+    node = tree
+    for k in path[:-1]:
+        node = node[k]
+    cur = node[path[-1]]
+    assert tuple(cur.shape) == tuple(value.shape), \
+        f'{"/".join(path)}: {cur.shape} != {value.shape}'
+    node[path[-1]] = value.astype(np.asarray(cur).dtype)
+
+
+def import_resnet(sd: Dict[str, np.ndarray], params: dict,
+                  batch_stats: dict, layers_per_stage) -> Tuple[dict, dict]:
+    """Map a torchvision resnet state_dict onto backbone.ResNet params."""
+    import jax
+    params = jax.tree.map(np.asarray, params)
+    batch_stats = jax.tree.map(np.asarray, batch_stats)
+
+    def bn(torch_prefix, flax_name):
+        _set(params, (flax_name, 'bn', 'scale'), sd[f'{torch_prefix}.weight'])
+        _set(params, (flax_name, 'bn', 'bias'), sd[f'{torch_prefix}.bias'])
+        _set(batch_stats, (flax_name, 'bn', 'mean'),
+             sd[f'{torch_prefix}.running_mean'])
+        _set(batch_stats, (flax_name, 'bn', 'var'),
+             sd[f'{torch_prefix}.running_var'])
+
+    _set(params, ('conv1', 'conv', 'kernel'), _t2f_conv(sd['conv1.weight']))
+    bn('bn1', 'bn1')
+    for i, n_blocks in enumerate(layers_per_stage):
+        for j in range(n_blocks):
+            tp = f'layer{i + 1}.{j}'
+            fp = f'layer{i + 1}_{j}'
+            convs = [k for k in ('conv1', 'conv2', 'conv3')
+                     if f'{tp}.{k}.weight' in sd]
+            for cname in convs:
+                _set(params, (fp, cname, 'conv', 'kernel'),
+                     _t2f_conv(sd[f'{tp}.{cname}.weight']))
+            for cname in convs:
+                bnp = f'{tp}.bn{cname[-1]}'
+                _set(params, (fp, f'bn{cname[-1]}', 'bn', 'scale'),
+                     sd[f'{bnp}.weight'])
+                _set(params, (fp, f'bn{cname[-1]}', 'bn', 'bias'),
+                     sd[f'{bnp}.bias'])
+                _set(batch_stats, (fp, f'bn{cname[-1]}', 'bn', 'mean'),
+                     sd[f'{bnp}.running_mean'])
+                _set(batch_stats, (fp, f'bn{cname[-1]}', 'bn', 'var'),
+                     sd[f'{bnp}.running_var'])
+            if f'{tp}.downsample.0.weight' in sd:
+                _set(params, (fp, 'downsample_conv', 'conv', 'kernel'),
+                     _t2f_conv(sd[f'{tp}.downsample.0.weight']))
+                _set(params, (fp, 'downsample_bn', 'bn', 'scale'),
+                     sd[f'{tp}.downsample.1.weight'])
+                _set(params, (fp, 'downsample_bn', 'bn', 'bias'),
+                     sd[f'{tp}.downsample.1.bias'])
+                _set(batch_stats, (fp, 'downsample_bn', 'bn', 'mean'),
+                     sd[f'{tp}.downsample.1.running_mean'])
+                _set(batch_stats, (fp, 'downsample_bn', 'bn', 'var'),
+                     sd[f'{tp}.downsample.1.running_var'])
+    return params, batch_stats
+
+
+def import_mobilenetv2(sd: Dict[str, np.ndarray], params: dict,
+                       batch_stats: dict) -> Tuple[dict, dict]:
+    """Map torchvision mobilenet_v2 features[0:18] onto backbone.Mobilenetv2."""
+    import jax
+    params = jax.tree.map(np.asarray, params)
+    batch_stats = jax.tree.map(np.asarray, batch_stats)
+
+    def bn(tp, fname, bname):
+        _set(params, (fname, bname, 'bn', 'scale'), sd[f'{tp}.weight'])
+        _set(params, (fname, bname, 'bn', 'bias'), sd[f'{tp}.bias'])
+        _set(batch_stats, (fname, bname, 'bn', 'mean'),
+             sd[f'{tp}.running_mean'])
+        _set(batch_stats, (fname, bname, 'bn', 'var'),
+             sd[f'{tp}.running_var'])
+
+    _set(params, ('stem', 'conv', 'kernel'),
+         _t2f_conv(sd['features.0.0.weight']))
+    _set(params, ('stem_bn', 'bn', 'scale'), sd['features.0.1.weight'])
+    _set(params, ('stem_bn', 'bn', 'bias'), sd['features.0.1.bias'])
+    _set(batch_stats, ('stem_bn', 'bn', 'mean'),
+         sd['features.0.1.running_mean'])
+    _set(batch_stats, ('stem_bn', 'bn', 'var'),
+         sd['features.0.1.running_var'])
+
+    for idx in range(1, 18):
+        tp = f'features.{idx}.conv'
+        fname = f'block{idx}'
+        expand = f'{tp}.0.0.weight' in sd and idx > 1
+        if idx == 1:
+            # t=1 block: [dw ConvBNReLU, project conv, project bn]
+            dw, dwbn, proj, projbn = (f'{tp}.0.0', f'{tp}.0.1',
+                                      f'{tp}.1', f'{tp}.2')
+        else:
+            dw, dwbn, proj, projbn = (f'{tp}.1.0', f'{tp}.1.1',
+                                      f'{tp}.2', f'{tp}.3')
+            _set(params, (fname, 'expand', 'conv', 'kernel'),
+                 _t2f_conv(sd[f'{tp}.0.0.weight']))
+            bn(f'{tp}.0.1', fname, 'expand_bn')
+        _set(params, (fname, 'dw', 'conv', 'kernel'),
+             _t2f_conv(sd[f'{dw}.weight']))
+        bn(dwbn, fname, 'dw_bn')
+        _set(params, (fname, 'project', 'conv', 'kernel'),
+             _t2f_conv(sd[f'{proj}.weight']))
+        bn(projbn, fname, 'project_bn')
+    return params, batch_stats
+
+
+def load_torch_backbone(ckpt_path: str, backbone_type: str, params: dict,
+                        batch_stats: dict) -> Tuple[dict, dict]:
+    """Entry point: import a torchvision .pth into Flax backbone params.
+
+    `params`/`batch_stats` are the backbone-scope subtrees of a freshly
+    initialized model (e.g. variables['params']['backbone']).
+    """
+    from ..models.backbone import RESNET_LAYERS
+    sd = load_torch_state_dict(ckpt_path)
+    if backbone_type in RESNET_LAYERS:
+        return import_resnet(sd, params, batch_stats,
+                             RESNET_LAYERS[backbone_type][1])
+    if backbone_type == 'mobilenet_v2':
+        return import_mobilenetv2(sd, params, batch_stats)
+    raise ValueError(f'Unsupported backbone type: {backbone_type}')

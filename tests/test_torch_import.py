@@ -1,0 +1,147 @@
+"""torch -> Flax backbone weight import: numerical equivalence against a
+minimal torch ResNet-18 written with torchvision's exact module naming."""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn as tnn
+
+import jax
+import jax.numpy as jnp
+
+
+def _torch_resnet18():
+    """BasicBlock ResNet-18 with torchvision state_dict naming."""
+    class BasicBlock(tnn.Module):
+        def __init__(self, cin, cout, stride=1):
+            super().__init__()
+            self.conv1 = tnn.Conv2d(cin, cout, 3, stride, 1, bias=False)
+            self.bn1 = tnn.BatchNorm2d(cout)
+            self.relu = tnn.ReLU()
+            self.conv2 = tnn.Conv2d(cout, cout, 3, 1, 1, bias=False)
+            self.bn2 = tnn.BatchNorm2d(cout)
+            self.downsample = None
+            if stride != 1 or cin != cout:
+                self.downsample = tnn.Sequential(
+                    tnn.Conv2d(cin, cout, 1, stride, bias=False),
+                    tnn.BatchNorm2d(cout))
+
+        def forward(self, x):
+            idt = x
+            y = self.relu(self.bn1(self.conv1(x)))
+            y = self.bn2(self.conv2(y))
+            if self.downsample is not None:
+                idt = self.downsample(x)
+            return self.relu(y + idt)
+
+    class R18(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.conv1 = tnn.Conv2d(3, 64, 7, 2, 3, bias=False)
+            self.bn1 = tnn.BatchNorm2d(64)
+            self.relu = tnn.ReLU()
+            self.maxpool = tnn.MaxPool2d(3, 2, 1)
+            self.layer1 = tnn.Sequential(BasicBlock(64, 64),
+                                         BasicBlock(64, 64))
+            self.layer2 = tnn.Sequential(BasicBlock(64, 128, 2),
+                                         BasicBlock(128, 128))
+            self.layer3 = tnn.Sequential(BasicBlock(128, 256, 2),
+                                         BasicBlock(256, 256))
+            self.layer4 = tnn.Sequential(BasicBlock(256, 512, 2),
+                                         BasicBlock(512, 512))
+
+        def forward(self, x):
+            x = self.maxpool(self.relu(self.bn1(self.conv1(x))))
+            x1 = self.layer1(x)
+            x2 = self.layer2(x1)
+            x3 = self.layer3(x2)
+            x4 = self.layer4(x3)
+            return x1, x2, x3, x4
+
+    return R18()
+
+
+def test_resnet18_import_equivalence(tmp_path):
+    from rtseg_tpu.models.backbone import ResNet
+    from rtseg_tpu.utils.torch_import import load_torch_backbone
+
+    tm = _torch_resnet18().eval()
+    # randomize BN stats so eval-mode normalization is non-trivial
+    with torch.no_grad():
+        for m in tm.modules():
+            if isinstance(m, tnn.BatchNorm2d):
+                m.running_mean.uniform_(-0.5, 0.5)
+                m.running_var.uniform_(0.5, 1.5)
+                m.weight.uniform_(0.5, 1.5)
+                m.bias.uniform_(-0.5, 0.5)
+    pth = str(tmp_path / 'r18.pth')
+    torch.save(tm.state_dict(), pth)
+
+    fm = ResNet('resnet18')
+    x = np.random.RandomState(0).rand(1, 64, 96, 3).astype(np.float32)
+    v = fm.init(jax.random.PRNGKey(0), jnp.asarray(x), False)
+    p, bs = load_torch_backbone(pth, 'resnet18', v['params'],
+                                v['batch_stats'])
+    feats = fm.apply({'params': p, 'batch_stats': bs}, jnp.asarray(x), False)
+
+    with torch.no_grad():
+        tfeats = tm(torch.from_numpy(x).permute(0, 3, 1, 2))
+    for f, tf in zip(feats, tfeats):
+        want = tf.permute(0, 2, 3, 1).numpy()
+        np.testing.assert_allclose(np.asarray(f), want,
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_mobilenetv2_import_shapes(tmp_path):
+    """No offline torch MobileNetV2 to compare against; check that a
+    state_dict with torchvision naming/shapes maps on without error."""
+    from rtseg_tpu.models.backbone import Mobilenetv2
+    from rtseg_tpu.utils.torch_import import (import_mobilenetv2,
+                                              _t2f_conv)
+    fm = Mobilenetv2()
+    v = fm.init(jax.random.PRNGKey(0), jnp.zeros((1, 64, 64, 3)), False)
+
+    # synthesize a torchvision-shaped state_dict from the flax tree
+    sd = {}
+
+    def f2t(w):
+        return np.transpose(np.asarray(w), (3, 2, 0, 1))
+
+    p, b = v['params'], v['batch_stats']
+    sd['features.0.0.weight'] = f2t(p['stem']['conv']['kernel'])
+    for tp, fname, bname in [('features.0.1', 'stem_bn', None)]:
+        sd[f'{tp}.weight'] = np.asarray(p['stem_bn']['bn']['scale'])
+        sd[f'{tp}.bias'] = np.asarray(p['stem_bn']['bn']['bias'])
+        sd[f'{tp}.running_mean'] = np.asarray(b['stem_bn']['bn']['mean'])
+        sd[f'{tp}.running_var'] = np.asarray(b['stem_bn']['bn']['var'])
+    for idx in range(1, 18):
+        fname = f'block{idx}'
+        tp = f'features.{idx}.conv'
+        has_expand = 'expand' in p[fname]
+        if has_expand:
+            sd[f'{tp}.0.0.weight'] = f2t(p[fname]['expand']['conv']['kernel'])
+            for stat, tree, key in (('weight', p, 'scale'), ('bias', p, 'bias')):
+                sd[f'{tp}.0.1.{stat}'] = np.asarray(
+                    tree[fname]['expand_bn']['bn'][key])
+            sd[f'{tp}.0.1.running_mean'] = np.asarray(
+                b[fname]['expand_bn']['bn']['mean'])
+            sd[f'{tp}.0.1.running_var'] = np.asarray(
+                b[fname]['expand_bn']['bn']['var'])
+            dw, dwbn, proj, projbn = (f'{tp}.1.0', f'{tp}.1.1', f'{tp}.2',
+                                      f'{tp}.3')
+        else:
+            dw, dwbn, proj, projbn = (f'{tp}.0.0', f'{tp}.0.1', f'{tp}.1',
+                                      f'{tp}.2')
+        sd[f'{dw}.weight'] = f2t(p[fname]['dw']['conv']['kernel'])
+        sd[f'{proj}.weight'] = f2t(p[fname]['project']['conv']['kernel'])
+        for bnm, pref in ((f'{dwbn}', 'dw_bn'), (f'{projbn}', 'project_bn')):
+            sd[f'{bnm}.weight'] = np.asarray(p[fname][pref]['bn']['scale'])
+            sd[f'{bnm}.bias'] = np.asarray(p[fname][pref]['bn']['bias'])
+            sd[f'{bnm}.running_mean'] = np.asarray(
+                b[fname][pref]['bn']['mean'])
+            sd[f'{bnm}.running_var'] = np.asarray(b[fname][pref]['bn']['var'])
+
+    p2, b2 = import_mobilenetv2(sd, v['params'], v['batch_stats'])
+    # round trip: imported tree equals the source tree
+    for a, c in zip(jax.tree.leaves(v['params']), jax.tree.leaves(p2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(c))
