@@ -27,6 +27,7 @@ def get_logger(config, main_rank: bool) -> logging.Logger:
     stdlib-based (loguru is not in the TPU image)."""
     logger = logging.getLogger(config.logger_name)
     logger.setLevel(logging.INFO if main_rank else logging.ERROR)
+    logger.propagate = False          # avoid duplicate lines via root logger
     if logger.handlers:
         return logger
     fmt = logging.Formatter(

@@ -42,3 +42,14 @@ def mesh8():
     if len(devs) < 8:
         pytest.skip('needs 8 (virtual) devices')
     return Mesh(np.array(devs[:8]).reshape(8), ('data',))
+
+
+@pytest.fixture(autouse=True)
+def _reset_bn_axis():
+    """The collective BN axis is process-global and set by step builders;
+    reset it so bare model.apply(train=True) outside shard_map never sees a
+    stale mesh axis from a previous test."""
+    from rtseg_tpu.nn import set_bn_axis
+    set_bn_axis(None)
+    yield
+    set_bn_axis(None)
