@@ -91,6 +91,11 @@ class SegConfig:
     tb_log_dir: Optional[str] = None
     ckpt_name: Optional[str] = None
     logger_name: str = 'seg_trainer'
+    # jax.profiler trace dump (TPU-native upgrade over the reference's
+    # wall-clock-only FPS harness, tools/test_speed.py:29-58): when set,
+    # profile_steps train steps of epoch 0 are traced into this directory
+    profile_dir: Optional[str] = None
+    profile_steps: int = 5
 
     # ----- Training setting (base_config.py:64-71) -----
     amp_training: bool = False             # on TPU: bf16 compute, no GradScaler

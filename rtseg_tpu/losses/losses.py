@@ -56,6 +56,13 @@ def cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray,
     return nll.sum() / denom
 
 
+# above this many pixels, the exact rank sort is replaced by an O(n)
+# histogram quantile (sorting 8M+ floats costs ~60ms/step on a v5e)
+_OHEM_SORT_LIMIT = 1 << 18
+_OHEM_BINS = 2048
+_OHEM_MAX_LOSS = 18.0
+
+
 def ohem_cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray,
                        thresh: float = 0.7, n_min_divisor: int = 16,
                        ignore_index: int = 255) -> jnp.ndarray:
@@ -63,6 +70,13 @@ def ohem_cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray,
 
     thresh is a probability; pixels with CE loss above -log(thresh) are hard.
     At least n_valid/n_min_divisor hardest pixels are always kept.
+
+    Small inputs use the exact rule (one descending sort). Large inputs
+    (training resolutions) compute the n_min-th largest loss via a
+    fixed-bin histogram instead — O(n), VPU-friendly — and keep every pixel
+    at or above that bin's lower edge. That keeps AT LEAST n_min hardest
+    pixels (the reference's contract) with a quantile resolution of
+    max_loss/bins; the static-threshold branch is unchanged and exact.
     """
     loss_thresh = -jnp.log(jnp.asarray(thresh, jnp.float32))
     valid = (labels != ignore_index).reshape(-1)
@@ -71,11 +85,28 @@ def ohem_cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray,
     n_valid = valid.sum()
     n_min = n_valid // n_min_divisor
 
-    # rank via one descending sort; invalid pixels carry loss 0 so they sort
-    # last and are additionally masked out of both branches.
-    order = jnp.argsort(-pix)
-    rank = jnp.empty_like(order).at[order].set(jnp.arange(pix.shape[0]))
-    keep = valid & ((pix > loss_thresh) | (rank < n_min))
+    if pix.shape[0] <= _OHEM_SORT_LIMIT:
+        # exact: rank via one descending sort; invalid pixels carry loss 0
+        # so they sort last and are additionally masked out of both branches
+        order = jnp.argsort(-pix)
+        rank = jnp.empty_like(order).at[order].set(
+            jnp.arange(pix.shape[0]))
+        hard = rank < n_min
+    else:
+        scale = _OHEM_BINS / _OHEM_MAX_LOSS
+        bins = jnp.clip((pix * scale).astype(jnp.int32), 0, _OHEM_BINS - 1)
+        bins = jnp.where(valid, bins, 0)
+        counts = jnp.zeros((_OHEM_BINS,), jnp.int32).at[bins].add(
+            valid.astype(jnp.int32))
+        # from_top[b] = #valid pixels with bin >= b
+        from_top = jnp.cumsum(counts[::-1])[::-1]
+        # lowest bin whose from-the-top count still reaches n_min
+        reach = from_top >= n_min
+        kth_bin = jnp.max(jnp.where(reach, jnp.arange(_OHEM_BINS), 0))
+        kth_val = kth_bin.astype(jnp.float32) / scale
+        hard = pix >= kth_val
+
+    keep = valid & ((pix > loss_thresh) | hard)
     cnt = jnp.maximum(keep.sum(), 1)
     return jnp.where(keep, pix, 0.0).sum() / cnt
 

@@ -1,0 +1,71 @@
+"""Blocked Pallas TPU kernel for the evaluation confusion matrix.
+
+The framework's default confusion_matrix (utils/metrics.py) is a one-hot
+einsum — already ~8x faster than scatter-add on TPU, but it materializes two
+(n_pixels, C) one-hot tensors in HBM (~600MB at bs16 1024x512). This kernel
+streams pixel blocks through VMEM, builds the one-hots on-chip with iota
+comparisons (classes on sublanes, pixels on lanes) and accumulates the
+(C, C) matrix with MXU dot_generals — zero HBM temporaries, same exact
+counts (verified in tests/test_pallas_metrics.py).
+
+Runs natively on TPU; everywhere else `interpret=True` keeps it usable
+(tests run it on CPU).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+LANES = 8192
+ROWS = 8
+_BLOCK = LANES * ROWS
+
+
+def _cm_kernel(cp: int, t_ref, p_ref, out_ref):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    iota = jax.lax.broadcasted_iota(jnp.int32, (cp, LANES), 0)
+    acc = jnp.zeros((cp, cp), jnp.float32)
+    for j in range(ROWS):
+        t = t_ref[j:j + 1, :]
+        p = p_ref[j:j + 1, :]
+        valid = (t >= 0).astype(jnp.float32)
+        oh_t = (iota == t).astype(jnp.float32) * valid
+        oh_p = (iota == p).astype(jnp.float32)
+        acc += jax.lax.dot_general(
+            oh_t, oh_p, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+    out_ref[:] += acc
+
+
+def confusion_matrix_pallas(preds: jnp.ndarray, labels: jnp.ndarray,
+                            num_class: int, ignore_index: int = 255,
+                            interpret: bool | None = None) -> jnp.ndarray:
+    """(C, C) confusion matrix, rows = true class, cols = predicted."""
+    if interpret is None:
+        interpret = jax.devices()[0].platform != 'tpu'
+    cp = max(8, -(-num_class // 8) * 8)          # sublane-aligned class dim
+    t = labels.reshape(-1).astype(jnp.int32)
+    t = jnp.where(t == ignore_index, -1, t)      # negative = ignored
+    p = preds.reshape(-1).astype(jnp.int32)
+    pad = (-t.size) % _BLOCK
+    t = jnp.pad(t, (0, pad), constant_values=-1)
+    p = jnp.pad(p, (0, pad), constant_values=0)
+    nb = t.size // _BLOCK
+    from functools import partial
+    out = pl.pallas_call(
+        partial(_cm_kernel, cp),
+        grid=(nb,),
+        in_specs=[pl.BlockSpec((ROWS, LANES), lambda i: (i, 0)),
+                  pl.BlockSpec((ROWS, LANES), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((cp, cp), lambda i: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((cp, cp), jnp.float32),
+        interpret=interpret,
+    )(t.reshape(nb * ROWS, LANES), p.reshape(nb * ROWS, LANES))
+    return out[:num_class, :num_class].astype(jnp.int32)

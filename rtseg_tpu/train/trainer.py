@@ -189,9 +189,18 @@ class SegTrainer:
         cfg = self.config
         self.train_loader.set_epoch(self.cur_epoch)
         metrics = None
+        profiling = (cfg.profile_dir is not None and self.cur_epoch == 0
+                     and self.main_rank)
         for i, (images, masks) in enumerate(self.train_loader):
+            if profiling and i == 1:          # skip the compile step
+                jax.profiler.start_trace(cfg.profile_dir)
             imgs, msks = self._put(images, masks)
             self.state, metrics = self.train_step(self.state, imgs, msks)
+            if profiling and i == cfg.profile_steps:
+                jax.block_until_ready(self.state.params)
+                jax.profiler.stop_trace()
+                profiling = False
+                self.logger.info(f'Profiler trace in {cfg.profile_dir}')
             if self.main_rank and cfg.use_tb:
                 # the only per-step host<->device sync; skipped entirely
                 # when TB is off so steps dispatch asynchronously
@@ -205,6 +214,8 @@ class SegTrainer:
                                            metrics['loss_kd'], step)
                     self.writer.add_scalar('train/loss_total',
                                            metrics['loss'], step)
+        if profiling:                         # epoch shorter than the window
+            jax.profiler.stop_trace()
         if metrics is None:
             raise RuntimeError(
                 'Training loader yielded no batches; the dataset is smaller '

@@ -1,0 +1,21 @@
+"""Pallas confusion-matrix kernel vs the default one-hot einsum."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from rtseg_tpu.ops.pallas_metrics import confusion_matrix_pallas
+from rtseg_tpu.utils.metrics import confusion_matrix
+
+
+def test_pallas_cm_matches_default():
+    rng = np.random.RandomState(0)
+    preds = jnp.asarray(rng.randint(0, 19, (2, 64, 128)).astype(np.int32))
+    labels = np.asarray(rng.randint(0, 19, (2, 64, 128)).astype(np.int32))
+    labels[0, :5] = 255
+    labels = jnp.asarray(labels)
+    want = np.asarray(confusion_matrix(preds, labels, 19))
+    got = np.asarray(confusion_matrix_pallas(preds, labels, 19))
+    assert np.array_equal(want, got)
+    assert want.sum() == int((np.asarray(labels) != 255).sum())
