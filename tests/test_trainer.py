@@ -52,3 +52,45 @@ def test_trainer_resume(save_dir):
     assert int(t2.state.step) == step_after_1
     t2.run()
     assert int(t2.state.step) == 2 * step_after_1
+
+
+def test_predict_writes_masks_and_blends(save_dir, tmp_path):
+    """Reference predict path (core/seg_trainer.py:154-191): colormapped PNG
+    masks + alpha blends from a folder of images, weights from best.ckpt."""
+    from PIL import Image
+
+    cfg = _cfg(save_dir, total_epoch=1)
+    SegTrainer(cfg).run()
+
+    img_dir = str(tmp_path / 'imgs')
+    os.makedirs(img_dir)
+    rng = np.random.RandomState(0)
+    for i in range(2):
+        Image.fromarray(
+            (rng.rand(40, 56, 3) * 255).astype(np.uint8)).save(
+            os.path.join(img_dir, f'im{i}.png'))
+
+    pcfg = _cfg(save_dir, is_testing=True, test_data_folder=img_dir,
+                load_ckpt_path=os.path.join(save_dir, 'best.ckpt'))
+    trainer = SegTrainer(pcfg)
+    trainer.predict()
+    for i in range(2):
+        out = os.path.join(save_dir, 'predicts', f'im{i}.png')
+        assert os.path.exists(out)
+        m = np.asarray(Image.open(out))
+        assert m.shape[-1] == 3
+        blend = os.path.join(save_dir, 'predicts_blend', f'im{i}.png')
+        assert os.path.exists(blend)
+        assert np.asarray(Image.open(blend)).shape == (40, 56, 3)
+
+
+def test_predict_missing_ckpt_raises(save_dir, tmp_path):
+    img_dir = str(tmp_path / 'imgs2')
+    os.makedirs(img_dir)
+    from PIL import Image
+    Image.fromarray(np.zeros((16, 16, 3), np.uint8)).save(
+        os.path.join(img_dir, 'a.png'))
+    cfg = _cfg(save_dir, is_testing=True, test_data_folder=img_dir,
+               load_ckpt_path=os.path.join(save_dir, 'nope.ckpt'))
+    with pytest.raises(FileNotFoundError):
+        SegTrainer(cfg)
