@@ -1,0 +1,94 @@
+"""Serving export — TPU-native equivalent of the reference's ONNX export.
+
+The reference exports models by switching the forward to an int8-argmax head
+under ``torch.onnx.is_in_onnx_export()`` (reference models/ddrnet.py:55-58,
+models/stdc.py:90-93). The XLA-native equivalent is :mod:`jax.export`: the
+jitted inference function — weights baked in as constants, exactly like an
+ONNX graph — is lowered to StableHLO and serialized to a portable artifact
+that any JAX/XLA runtime (CPU/TPU) can reload and execute without the
+model-building Python code.
+
+API:
+  * ``export_model(config, ...) -> jax.export.Exported``
+  * ``save_exported / load_exported`` — bytes on disk round-trip
+  * ``Exported.call(images)`` — run the artifact
+
+CLI: ``python tools/export.py --model ddrnet --num_class 19 ...``
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import export as jex
+
+SUFFIX = '.stablehlo'
+
+
+def build_inference_fn(model, variables, compute_dtype, argmax: bool = True):
+    """Inference closure with weights captured as constants.
+
+    ``argmax=True`` matches the reference's ONNX head: channel argmax,
+    int8 (ddrnet.py:56-58). ``argmax=False`` returns fp32 logits.
+    """
+    dtype = jnp.dtype(compute_dtype)
+
+    def fn(images):
+        logits = model.apply(variables, images.astype(dtype), False)
+        logits = logits.astype(jnp.float32)
+        if argmax:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int8)
+        return logits
+
+    return fn
+
+
+def export_model(config, imgh: int = 512, imgw: int = 1024,
+                 batch: Optional[int] = 1, argmax: bool = True,
+                 ckpt_path: Optional[str] = None,
+                 platforms: Tuple[str, ...] = ('cpu', 'tpu')) -> jex.Exported:
+    """Lower the configured model to a serialized-ready StableHLO artifact.
+
+    ``batch=None`` exports with a symbolic batch dimension (shape
+    polymorphism), so one artifact serves any batch size; H/W stay static —
+    TPU-friendly (XLA tiles convs for known spatial extents).
+
+    ``platforms`` lowers for every listed backend so the artifact is truly
+    portable (export on a TPU host, serve on CPU and vice versa).
+    """
+    from .models import get_model
+    from .train.checkpoint import restore_weights
+
+    model = get_model(config)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, imgh, imgw, 3), jnp.float32), False)
+    if ckpt_path:
+        params, batch_stats = restore_weights(
+            ckpt_path, variables['params'], variables.get('batch_stats', {}))
+        variables = dict(variables, params=params, batch_stats=batch_stats)
+
+    fn = build_inference_fn(model, variables, config.compute_dtype, argmax)
+
+    if batch is None:
+        (b,) = jex.symbolic_shape('b')
+        spec = jax.ShapeDtypeStruct((b, imgh, imgw, 3), jnp.float32)
+    else:
+        spec = jax.ShapeDtypeStruct((batch, imgh, imgw, 3), jnp.float32)
+    return jex.export(jax.jit(fn), platforms=tuple(platforms))(spec)
+
+
+def save_exported(exported: jex.Exported, path: str) -> str:
+    if not path.endswith(SUFFIX):
+        path += SUFFIX
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, 'wb') as f:
+        f.write(exported.serialize())
+    return path
+
+
+def load_exported(path: str) -> jex.Exported:
+    with open(path, 'rb') as f:
+        return jex.deserialize(f.read())

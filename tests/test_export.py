@@ -1,0 +1,56 @@
+"""Serving-export round trip (reference ONNX branches, ddrnet.py:55-58).
+
+serialize -> deserialize -> call must reproduce the in-process model, for
+both the int8-argmax head and raw logits, including a symbolic-batch export.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rtseg_tpu.config import SegConfig
+from rtseg_tpu.export import (build_inference_fn, export_model, load_exported,
+                              save_exported)
+from rtseg_tpu.models import get_model
+
+
+@pytest.fixture(scope='module')
+def cfg():
+    c = SegConfig(dataset='synthetic', model='fastscnn', num_class=19,
+                  compute_dtype='float32', save_dir='/tmp/rtseg_export_test')
+    c.resolve(num_devices=1)
+    return c
+
+
+def test_export_roundtrip_argmax(cfg, tmp_path):
+    exported = export_model(cfg, imgh=64, imgw=64, batch=2, argmax=True)
+    path = save_exported(exported, str(tmp_path / 'fastscnn'))
+    assert path.endswith('.stablehlo')
+
+    x = np.random.RandomState(0).rand(2, 64, 64, 3).astype(np.float32)
+    got = np.asarray(load_exported(path).call(jnp.asarray(x)))
+    assert got.shape == (2, 64, 64) and got.dtype == np.int8
+
+    model = get_model(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 64, 64, 3)), False)
+    want = np.asarray(
+        build_inference_fn(model, variables, 'float32', argmax=True)(x))
+    np.testing.assert_array_equal(got, want)
+
+
+def test_export_logits_and_poly_batch(cfg, tmp_path):
+    exported = export_model(cfg, imgh=64, imgw=64, batch=None, argmax=False)
+    path = save_exported(exported, str(tmp_path / 'fastscnn_logits'))
+    reloaded = load_exported(path)
+
+    model = get_model(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 64, 64, 3)), False)
+    for bs in (1, 3):
+        x = np.random.RandomState(bs).rand(bs, 64, 64, 3).astype(np.float32)
+        got = np.asarray(reloaded.call(jnp.asarray(x)))
+        want = np.asarray(model.apply(variables, jnp.asarray(x), False))
+        assert got.shape == (bs, 64, 64, 19)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
