@@ -57,6 +57,7 @@ class SegTrainer:
         self.model = get_model(config)
         self.best_score = 0.0
         self.cur_epoch = 0
+        self.epoch_losses = []             # last-step loss per trained epoch
 
         if config.is_testing:
             self.test_set = get_test_loader(config)
@@ -220,10 +221,11 @@ class SegTrainer:
             raise RuntimeError(
                 'Training loader yielded no batches; the dataset is smaller '
                 'than the global batch size.')
+        self.epoch_losses.append(float(metrics['loss']))
         if self.main_rank:
             self.logger.info(
                 f'Epoch:{self.cur_epoch + 1}/{cfg.total_epoch} | '
-                f"Loss:{float(metrics['loss']):.4g}")
+                f"Loss:{self.epoch_losses[-1]:.4g}")
 
     def validate(self, val_best: bool = False) -> float:
         cfg = self.config

@@ -1,0 +1,236 @@
+"""Data layer: dataset walking, trainId encoding, transforms, sharded loader.
+
+Covers the reference semantics of datasets/cityscapes.py (folder layout +
+LUT encoding), datasets/custom.py (data.yaml layout, square resize, identity
+norm), utils/transforms.py, and the DistributedSampler-replacement loader
+(datasets/__init__.py:21-49, utils/parallel.py:51-53).
+"""
+
+import os
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from rtseg_tpu.config import SegConfig
+from rtseg_tpu.data import Cityscapes, Custom, get_loader
+from rtseg_tpu.data.cityscapes import ID_TO_TRAIN_ID, encode_target
+from rtseg_tpu.data.loader import ShardedLoader
+from rtseg_tpu.data.transforms import (normalize, pad_if_needed,
+                                       resize_to_square, scale)
+
+
+# ---------------------------------------------------------------- transforms
+
+def test_encode_target_lut():
+    # official pairs (reference datasets/cityscapes.py:62-99)
+    raw = np.array([[0, 7, 8, 11], [26, 33, 19, 5]], np.uint8)
+    want = np.array([[255, 0, 1, 2], [13, 18, 6, 255]], np.uint8)
+    np.testing.assert_array_equal(encode_target(raw), want)
+    assert len(ID_TO_TRAIN_ID) == 34
+
+
+def test_pad_if_needed_centers_value_114():
+    img = np.ones((4, 6, 3), np.uint8) * 7
+    mask = np.ones((4, 6), np.uint8)
+    out, msk = pad_if_needed(img, mask, 8, 8)
+    assert out.shape == (8, 8, 3) and msk.shape == (8, 8)
+    assert (out[0] == 114).all() and (out[-1] == 114).all()
+    assert (out[2:6, 1:7] == 7).all()            # centered original
+    assert msk[0].max() == 0 and (msk[2:6, 1:7] == 1).all()
+
+
+def test_scale_and_normalize():
+    img = np.full((8, 8, 3), 128, np.uint8)
+    mask = np.zeros((8, 8), np.uint8)
+    simg, smask = scale(img, mask, 0.5)
+    assert simg.shape == (4, 4, 3) and smask.shape == (4, 4)
+    norm = normalize(img)
+    want = (128 / 255.0 - np.array([0.485, 0.456, 0.406])) / \
+        np.array([0.229, 0.224, 0.225])
+    np.testing.assert_allclose(norm[0, 0], want, rtol=1e-5)
+
+
+def test_resize_to_square():
+    img = np.zeros((4, 8, 3), np.uint8)
+    img[:, :4] = 200
+    mask = np.zeros((4, 8), np.uint8)
+    out, msk = resize_to_square(img, mask, 16)
+    assert out.shape == (16, 16, 3) and msk.shape == (16, 16)
+    # vertical padding (rows near the pad/content boundary blend under
+    # bilinear resize, so only check the pure-padding band)
+    assert (out[:2] == 0).all() and (out[-2:] == 0).all()
+    assert (msk[:4] == 0).all() and (msk[-4:] == 0).all()   # nearest: exact
+
+
+# ------------------------------------------------------------ dataset trees
+
+def _write_png(path, arr):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    Image.fromarray(arr).save(path)
+
+
+@pytest.fixture()
+def cityscapes_root(tmp_path):
+    root = tmp_path / 'cs'
+    rng = np.random.RandomState(0)
+    for mode, cities, n in (('train', ['aachen', 'bochum'], 3), ('val',
+                                                                ['frankfurt'],
+                                                                2)):
+        for city in cities:
+            for i in range(n):
+                stem = f'{city}_{i:06d}_000019'
+                img = rng.randint(0, 255, (64, 128, 3), dtype=np.uint8)
+                ids = rng.randint(0, 34, (64, 128), dtype=np.uint8)
+                _write_png(str(root / 'leftImg8bit' / mode / city /
+                               f'{stem}_leftImg8bit.png'), img)
+                _write_png(str(root / 'gtFine' / mode / city /
+                               f'{stem}_gtFine_labelIds.png'), ids)
+    return str(root)
+
+
+def test_cityscapes_walk_and_encode(cityscapes_root):
+    cfg = SegConfig(dataset='cityscapes', data_root=cityscapes_root,
+                    num_class=19, crop_size=32, scale=1.0,
+                    save_dir='/tmp/rtseg_data_test')
+    cfg.resolve(num_devices=1)
+    train = Cityscapes(cfg, 'train')
+    val = Cityscapes(cfg, 'val')
+    assert len(train) == 6 and len(val) == 2
+    # image/mask pairing: basenames must share the stem
+    for ip, mp in zip(train.images, train.masks):
+        stem = os.path.basename(ip).split('_leftImg8bit')[0]
+        assert os.path.basename(mp) == f'{stem}_gtFine_labelIds.png'
+
+    rng = np.random.default_rng(0)
+    img, mask = train.get(0, rng)
+    assert img.shape == (32, 32, 3) and img.dtype == np.float32
+    assert mask.shape == (32, 32) and mask.dtype == np.int32
+    valid = mask[mask != 255]
+    assert valid.size == 0 or (valid < 19).all()
+
+    vimg, vmask = val.get(0, rng)               # val: full size, no crop
+    assert vimg.shape == (64, 128, 3) and vmask.shape == (64, 128)
+
+
+def test_cityscapes_missing_dir_raises(tmp_path):
+    cfg = SegConfig(dataset='cityscapes', data_root=str(tmp_path / 'nope'),
+                    num_class=19, save_dir='/tmp/rtseg_data_test')
+    cfg.resolve(num_devices=1)
+    with pytest.raises(RuntimeError, match='does not exist'):
+        Cityscapes(cfg, 'train')
+
+
+@pytest.fixture()
+def custom_root(tmp_path):
+    root = tmp_path / 'custom'
+    rng = np.random.RandomState(1)
+    for mode, n in (('train', 4), ('val', 2)):
+        for i in range(n):
+            img = rng.randint(0, 255, (30, 50, 3), dtype=np.uint8)
+            msk = rng.randint(0, 3, (30, 50), dtype=np.uint8)
+            _write_png(str(root / mode / 'imgs' / f'{i}.png'), img)
+            _write_png(str(root / mode / 'masks' / f'{i}.png'), msk)
+    os.makedirs(root, exist_ok=True)
+    with open(root / 'data.yaml', 'w') as f:
+        f.write(f"path: {root}\nnames:\n  0: bg\n  1: a\n  2: b\n")
+    return str(root)
+
+
+def test_custom_dataset(custom_root):
+    cfg = SegConfig(dataset='custom', data_root=custom_root, num_class=3,
+                    train_size=32, test_size=32, crop_size=32,
+                    save_dir='/tmp/rtseg_data_test')
+    cfg.resolve(num_devices=1)
+    train = Custom(cfg, 'train')
+    val = Custom(cfg, 'val')
+    assert len(train) == 4 and len(val) == 2
+    assert train.names == {0: 'bg', 1: 'a', 2: 'b'}
+    rng = np.random.default_rng(0)
+    img, mask = train.get(0, rng)
+    assert img.shape == (32, 32, 3) and mask.shape == (32, 32)
+    assert 0.0 <= img.min() and img.max() <= 1.0     # identity norm: /255
+    assert mask.max() < 3
+
+
+# ------------------------------------------------------------ sharded loader
+
+class _ArangeDataset:
+    """get(i) -> (image filled with i, mask filled with i)."""
+
+    def __init__(self, n, hw=(4, 4)):
+        self.n = n
+        self.hw = hw
+
+    def __len__(self):
+        return self.n
+
+    def get(self, i, rng):
+        h, w = self.hw
+        return (np.full((h, w, 3), i, np.float32),
+                np.full((h, w), i, np.int32))
+
+
+def test_loader_epoch_determinism_and_reshuffle():
+    ds = _ArangeDataset(16)
+    loader = ShardedLoader(ds, global_batch=4, seed=7, shuffle=True)
+
+    def epoch_ids(ep):
+        loader.set_epoch(ep)
+        return [b[1][:, 0, 0].tolist() for b in loader]
+
+    a, b = epoch_ids(0), epoch_ids(0)
+    assert a == b                                   # same (seed, epoch)
+    assert epoch_ids(1) != a                        # reshuffle per epoch
+    assert sorted(sum(a, [])) == list(range(16))    # a full permutation
+
+
+def test_loader_drop_last_and_val_padding():
+    ds = _ArangeDataset(10)
+    train = ShardedLoader(ds, global_batch=4, shuffle=False, drop_last=True)
+    assert len(train) == 2 and sum(1 for _ in train) == 2
+
+    val = ShardedLoader(ds, global_batch=4, shuffle=False, drop_last=False,
+                        ignore_index=255)
+    batches = list(val)
+    assert len(batches) == 3
+    last_imgs, last_masks = batches[-1]
+    assert last_imgs.shape[0] == 4
+    # 2 real samples, 2 padded with ignore_index labels
+    assert last_masks[0, 0, 0] == 8 and last_masks[1, 0, 0] == 9
+    assert (last_masks[2] == 255).all() and (last_masks[3] == 255).all()
+
+
+def test_loader_multiprocess_sharding_partitions_batch():
+    ds = _ArangeDataset(8)
+    shards = [list(ShardedLoader(ds, global_batch=4, shuffle=True, seed=3,
+                                 process_index=pi, process_count=2))
+              for pi in range(2)]
+    # same epoch permutation on both processes; slices are disjoint and
+    # their union is the global batch
+    full = ShardedLoader(ds, global_batch=4, shuffle=True, seed=3)
+    for b, (_, gmask) in enumerate(full):
+        got = np.concatenate([shards[0][b][1], shards[1][b][1]])
+        np.testing.assert_array_equal(got, gmask)
+
+
+def test_loader_propagates_worker_errors():
+    class Exploding(_ArangeDataset):
+        def get(self, i, rng):
+            raise ValueError('boom')
+
+    loader = ShardedLoader(Exploding(8), global_batch=4, shuffle=False)
+    with pytest.raises(ValueError, match='boom'):
+        list(loader)
+
+
+def test_get_loader_schedule_math(cityscapes_root):
+    cfg = SegConfig(dataset='cityscapes', data_root=cityscapes_root,
+                    num_class=19, crop_size=32, train_bs=2, val_bs=2,
+                    total_epoch=3, save_dir='/tmp/rtseg_data_test')
+    cfg.resolve(num_devices=2)                      # gpu_num = 2
+    train_loader, val_loader = get_loader(cfg)
+    # 6 train samples, global batch 4 -> train_num truncated to 4, 1 step
+    assert cfg.train_num == 4 and cfg.val_num == 2
+    assert len(train_loader) == 1
+    assert cfg.iters_per_epoch == 1 and cfg.total_itrs == 3

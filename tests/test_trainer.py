@@ -54,6 +54,21 @@ def test_trainer_resume(save_dir):
     assert int(t2.state.step) == 2 * step_after_1
 
 
+def test_training_converges(save_dir):
+    """Loss falls and mIoU rises on the learnable synthetic task — catches
+    silent training-math regressions (LR schedule, grad sync, EMA, metrics)
+    that a shape-only smoke run would miss."""
+    cfg = _cfg(save_dir, total_epoch=30, val_interval=30, train_bs=4,
+               val_bs=4, num_class=5, crop_size=32, base_lr=0.05,
+               use_ema=False, loss_type='ce')
+    trainer = SegTrainer(cfg)
+    score = trainer.run()
+    assert score > 0.3, f'mIoU after training should beat chance, got {score}'
+    losses = trainer.epoch_losses
+    assert losses[-1] < 0.5 * losses[0], (
+        f'loss did not decrease: first={losses[0]:.4f} last={losses[-1]:.4f}')
+
+
 def test_predict_writes_masks_and_blends(save_dir, tmp_path):
     """Reference predict path (core/seg_trainer.py:154-191): colormapped PNG
     masks + alpha blends from a folder of images, weights from best.ckpt."""
