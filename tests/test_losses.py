@@ -64,6 +64,22 @@ def test_ohem_matches_torch(scale, thresh):
     np.testing.assert_allclose(got, want, rtol=1e-4)
 
 
+@pytest.mark.parametrize('scale', [3.0, 0.01])
+def test_ohem_bisection_path_matches_torch(scale):
+    # large input (> _OHEM_SORT_LIMIT pixels) takes the bisection-quantile
+    # branch; must agree with the reference rule up to quantile resolution
+    rng = np.random.RandomState(11)
+    logits = (rng.randn(2, 384, 384, 6) * scale).astype(np.float32)
+    labels = rng.randint(0, 6, (2, 384, 384)).astype(np.int32)
+    labels[0, :20] = 255
+    from rtseg_tpu.losses.losses import _OHEM_SORT_LIMIT
+    assert logits[..., 0].size > _OHEM_SORT_LIMIT
+    got = float(losses.ohem_cross_entropy(jnp.asarray(logits),
+                                          jnp.asarray(labels), 0.7))
+    want = _torch_ohem(logits, labels, 0.7)
+    np.testing.assert_allclose(got, want, rtol=5e-3)
+
+
 def test_dice_matches_reference_raw_logit_behavior():
     rng = np.random.RandomState(0)
     logits = rng.randn(3, 1, 6, 6).astype(np.float32)

@@ -1,0 +1,157 @@
+"""Multi-model throughput table — the README FPS column, TPU-native
+(reference README.md:133-203 FPS measured via tools/test_speed.py on RTX 2080
+at 1024x512 bs1).
+
+Forward mode measures jit'd inference imgs/sec/chip; --train measures the
+full compiled train step (forward+loss+backward+optimizer+EMA) on synthetic
+data. Dispatch through the axon tunnel is fenced the same way as bench.py:
+calls are queued in blocks and completion is forced by a device-side scalar
+readback.
+
+    python tools/benchmark_all.py --models fastscnn,bisenetv2,ddrnet
+    python tools/benchmark_all.py --train --models bisenetv2
+"""
+
+import argparse
+import json
+import sys
+import time
+from os import path
+
+sys.path.append(path.dirname(path.dirname(path.abspath(__file__))))
+
+import numpy as np
+
+# Reference RTX-2080 FPS at 1024x512 bs1 (README.md:133-203).
+REFERENCE_FPS = {
+    'adscnet': 89, 'aglnet': 61, 'bisenetv1': 88, 'bisenetv2': 142,
+    'canet': 76, 'cfpnet': 64, 'cgnet': 157, 'contextnet': 80,
+    'dabnet': 140, 'ddrnet': 233, 'dfanet': 60, 'edanet': 125,
+    'enet': 140, 'erfnet': 60, 'esnet': 66, 'espnet': 111,
+    'espnetv2': 101, 'farseenet': 130, 'fastscnn': 358, 'fddwnet': 51,
+    'fpenet': 90, 'fssnet': 121, 'icnet': 102, 'lednet': 76,
+    'linknet': 106, 'lite_hrnet': 30, 'liteseg': 117, 'mininet': 254,
+    'mininetv2': 86, 'ppliteseg': 201, 'regseg': 104, 'segnet': 14,
+    'shelfnet': 110, 'sqnet': 69, 'stdc': 163, 'swiftnet': 141,
+}
+
+DEFAULT_MODELS = 'fastscnn,bisenetv2,ddrnet,stdc,ppliteseg,enet'
+
+
+def bench_forward(name, batch, h, w, queue, trials):
+    import jax
+    import jax.numpy as jnp
+    from rtseg_tpu.config import SegConfig
+    from rtseg_tpu.models import get_model
+
+    cfg = SegConfig(dataset='synthetic', model=name, num_class=19,
+                    compute_dtype='bfloat16', save_dir='/tmp/rtseg_bench')
+    cfg.resolve(num_devices=1)
+    model = get_model(cfg)
+    images = jax.device_put(
+        np.random.RandomState(0).rand(batch, h, w, 3).astype(np.float32)
+    ).astype(jnp.bfloat16)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, h, w, 3)), False)
+
+    @jax.jit
+    def fwd(variables, images):
+        return model.apply(variables, images, False).astype(jnp.float32).sum()
+
+    for _ in range(3):
+        float(fwd(variables, images))
+    best = 0.0
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        for _ in range(queue):
+            out = fwd(variables, images)
+        float(out)
+        best = max(best, batch * queue / (time.perf_counter() - t0))
+    return best
+
+
+def bench_train(name, batch, h, w, queue, trials):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+    from rtseg_tpu.config import SegConfig
+    from rtseg_tpu.models import get_model
+    from rtseg_tpu.models.registry import AUX_MODELS
+    from rtseg_tpu.parallel.mesh import DATA_AXIS
+    from rtseg_tpu.train.optim import get_optimizer
+    from rtseg_tpu.train.state import create_train_state
+    from rtseg_tpu.train.step import build_train_step
+
+    cfg = SegConfig(dataset='synthetic', model=name, num_class=19,
+                    train_bs=batch, use_aux=name in AUX_MODELS,
+                    use_ema=True, loss_type='ohem',
+                    compute_dtype='bfloat16', save_dir='/tmp/rtseg_bench')
+    cfg.resolve(num_devices=1)
+    cfg.resolve_schedule(train_num=batch * 1000)
+    model = get_model(cfg)
+    opt = get_optimizer(cfg)
+    mesh = Mesh(np.array(jax.devices()[:1]), (DATA_AXIS,))
+    state = create_train_state(model, opt, jax.random.PRNGKey(0),
+                               jnp.zeros((1, h, w, 3), jnp.float32))
+    step = build_train_step(cfg, model, opt, mesh)
+    rng = np.random.RandomState(0)
+    images = jax.device_put(rng.rand(batch, h, w, 3).astype(np.float32))
+    masks = jax.device_put(
+        rng.randint(0, 19, (batch, h, w)).astype(np.int32))
+
+    state, metrics = step(state, images, masks)   # compile
+    float(metrics['loss'])
+    best = 0.0
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        for _ in range(queue):
+            state, metrics = step(state, images, masks)
+        float(metrics['loss'])                    # device-side fence
+        best = max(best, batch * queue / (time.perf_counter() - t0))
+    return best
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument('--models', type=str, default=DEFAULT_MODELS)
+    ap.add_argument('--batch', type=int, default=32)
+    ap.add_argument('--imgh', type=int, default=512)
+    ap.add_argument('--imgw', type=int, default=1024)
+    ap.add_argument('--queue', type=int, default=20)
+    ap.add_argument('--trials', type=int, default=3)
+    ap.add_argument('--train', action='store_true',
+                    help='benchmark the full train step instead of inference')
+    args = ap.parse_args()
+
+    kind = 'train' if args.train else 'forward'
+    rows = []
+    for name in [m.strip() for m in args.models.split(',') if m.strip()]:
+        fn = bench_train if args.train else bench_forward
+        try:
+            ips = fn(name, args.batch, args.imgh, args.imgw,
+                     args.queue, args.trials)
+        except Exception as e:          # keep the sweep going
+            print(f'| {name} | FAILED: {type(e).__name__}: {e} |',
+                  flush=True)
+            continue
+        base = REFERENCE_FPS.get(name)
+        ratio = f'{ips / base:.1f}x' if base and not args.train else '—'
+        rows.append((name, ips, base, ratio))
+        print(json.dumps({
+            'metric': f'{name} {kind} imgs/sec/chip '
+                      f'({args.imgw}x{args.imgh}, bs{args.batch})',
+            'value': round(ips, 1),
+            'unit': 'imgs/sec',
+            'vs_baseline': round(ips / base, 3) if base else None,
+        }), flush=True)
+
+    print(f'\n| model | {kind} imgs/sec/chip (TPU v5e, bs{args.batch}) | '
+          f'ref FPS (RTX 2080, bs1) | speedup |')
+    print('|---|---|---|---|')
+    for name, ips, base, ratio in rows:
+        print(f'| {name} | {ips:.0f} | {base if base else "—"} | {ratio} |')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
