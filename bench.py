@@ -4,13 +4,9 @@ Jit'd forward on the flagship model at 1024x512 (the reference's FPS
 resolution, README.md:174). Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": "imgs/sec", "vs_baseline": N}
 
-Measurement notes (axon TPU tunnel):
-  * `block_until_ready` returns before device completion through the tunnel,
-    so the forward is fenced by a device-side scalar checksum (out.sum())
-    whose host readback forces full execution of the queued work.
-  * per-call dispatch over the tunnel costs ~70-80ms; calls are queued in
-    blocks of QUEUE so dispatch overhead amortizes, matching how a real
-    input pipeline keeps the device fed.
+Measurement protocol (tunnel-safe fencing, queued dispatch) and the
+reference baseline table live in rtseg_tpu/utils/bench.py, shared with
+tools/benchmark_all.py.
 
 vs_baseline compares against the reference's published RTX-2080 FPS for the
 same architecture (README.md:133-203).
@@ -20,16 +16,8 @@ from __future__ import annotations
 
 import json
 import sys
-import time
 
 import numpy as np
-
-# Reference RTX-2080 FPS at 1024x512 bs1 (README.md:133-203).
-REFERENCE_FPS = {
-    'fastscnn': 358.0,
-    'bisenetv2': 142.0,
-    'ddrnet': 233.0,
-}
 
 BATCH = 128      # measured best on v5e: 64 -> 1400, 128 -> ~1900 imgs/sec
 QUEUE = 20
@@ -52,6 +40,7 @@ def main() -> int:
     import jax.numpy as jnp
     from rtseg_tpu.config import SegConfig
     from rtseg_tpu.models import get_model
+    from rtseg_tpu.utils.bench import REFERENCE_FPS, fenced_throughput
 
     name = _pick_model()
     h, w = 512, 1024
@@ -75,18 +64,8 @@ def main() -> int:
         out = model.apply(variables, images, False)
         return out.astype(jnp.float32).sum()     # device-side fence value
 
-    # warmup / compile (reference test_speed.py:31-32)
-    for _ in range(3):
-        float(fwd(variables, images))
-
-    best = 0.0
-    for _ in range(TRIALS):
-        t0 = time.perf_counter()
-        for _ in range(QUEUE):
-            out = fwd(variables, images)
-        float(out)                                # forces full completion
-        elapsed = time.perf_counter() - t0
-        best = max(best, BATCH * QUEUE / elapsed)
+    best = fenced_throughput(lambda: fwd(variables, images), float, BATCH,
+                             queue=QUEUE, trials=TRIALS)
 
     base = REFERENCE_FPS.get(name)
     print(json.dumps({

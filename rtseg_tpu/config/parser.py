@@ -53,6 +53,7 @@ def get_parser() -> argparse.ArgumentParser:
     p.add_argument('--base_lr', type=float)
     p.add_argument('--train_bs', type=int)
     p.add_argument('--use_aux', action='store_const', const=True)
+    p.add_argument('--aux_coef', type=float, nargs='+')
     # Validation
     p.add_argument('--val_bs', type=int)
     p.add_argument('--begin_val_epoch', type=int)
@@ -61,11 +62,13 @@ def get_parser() -> argparse.ArgumentParser:
     p.add_argument('--is_testing', action='store_const', const=True)
     p.add_argument('--test_bs', type=int)
     p.add_argument('--test_data_folder', type=str)
+    p.add_argument('--colormap', type=str)
     p.add_argument('--save_mask', type=bool)
     p.add_argument('--blend_prediction', type=bool)
     p.add_argument('--blend_alpha', type=float)
     # Loss
     p.add_argument('--loss_type', type=str, choices=['ce', 'ohem'])
+    p.add_argument('--class_weights', type=float, nargs='+')
     p.add_argument('--ohem_thrs', type=float)
     # Scheduler
     p.add_argument('--lr_policy', type=str, choices=['cos_warmup', 'linear', 'step'])
@@ -93,7 +96,7 @@ def get_parser() -> argparse.ArgumentParser:
     p.add_argument('--crop_h', type=int)
     p.add_argument('--crop_w', type=int)
     p.add_argument('--scale', type=float)
-    p.add_argument('--randscale', type=float, nargs='*')
+    p.add_argument('--randscale', type=float, nargs='+')
     p.add_argument('--brightness', type=float)
     p.add_argument('--contrast', type=float)
     p.add_argument('--saturation', type=float)

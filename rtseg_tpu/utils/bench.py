@@ -1,0 +1,45 @@
+"""Shared benchmarking bits: reference baseline numbers + the fenced
+queued-dispatch measurement loop used by bench.py and tools/benchmark_all.py.
+
+Measurement notes (axon TPU tunnel): `block_until_ready` can return before
+device completion through the tunnel, so timed regions end with a host
+readback of a device-side scalar, which forces full execution of the queued
+work; calls are queued in blocks so per-call dispatch (~70-80ms through the
+tunnel) amortizes, matching how a real input pipeline keeps the device fed.
+"""
+
+from __future__ import annotations
+
+import time
+
+# Reference RTX-2080 FPS at 1024x512 bs1 (reference README.md:133-203,
+# measured by its tools/test_speed.py).
+REFERENCE_FPS = {
+    'adscnet': 89, 'aglnet': 61, 'bisenetv1': 88, 'bisenetv2': 142,
+    'canet': 76, 'cfpnet': 64, 'cgnet': 157, 'contextnet': 80,
+    'dabnet': 140, 'ddrnet': 233, 'dfanet': 60, 'edanet': 125,
+    'enet': 140, 'erfnet': 60, 'esnet': 66, 'espnet': 111,
+    'espnetv2': 101, 'farseenet': 130, 'fastscnn': 358, 'fddwnet': 51,
+    'fpenet': 90, 'fssnet': 121, 'icnet': 102, 'lednet': 76,
+    'linknet': 106, 'lite_hrnet': 30, 'liteseg': 117, 'mininet': 254,
+    'mininetv2': 86, 'ppliteseg': 201, 'regseg': 104, 'segnet': 14,
+    'shelfnet': 110, 'sqnet': 69, 'stdc': 163, 'swiftnet': 141,
+}
+
+
+def fenced_throughput(call, readback, items_per_call: int,
+                      queue: int = 20, trials: int = 3,
+                      warmup: int = 3) -> float:
+    """Best items/sec over `trials` blocks of `queue` queued `call()`s, each
+    block fenced by `readback(out)` pulling a scalar from the last result."""
+    for _ in range(warmup):
+        readback(call())
+    best = 0.0
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(queue):
+            out = call()
+        readback(out)
+        best = max(best, items_per_call * queue / (time.perf_counter() - t0))
+    return best

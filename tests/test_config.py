@@ -1,0 +1,56 @@
+"""Config system: CLI overlay semantics + derived-field resolution
+(reference configs/parser.py:4-13, configs/base_config.py:98-109)."""
+
+import pytest
+
+from rtseg_tpu.config import SegConfig, load_parser
+
+
+def _base(**kw):
+    d = dict(dataset='synthetic', model='fastscnn', num_class=5,
+             save_dir='/tmp/rtseg_cfg_test')
+    d.update(kw)
+    return SegConfig(**d)
+
+
+def test_parser_only_overrides_passed_flags():
+    cfg = _base(base_lr=0.02, train_bs=7)
+    cfg = load_parser(cfg, ['--total_epoch', '9'])
+    assert cfg.total_epoch == 9
+    assert cfg.base_lr == 0.02 and cfg.train_bs == 7   # untouched
+
+
+def test_parser_list_and_store_const_flags():
+    cfg = load_parser(_base(), [
+        '--aux_coef', '1.0', '0.5', '--class_weights', '1', '2', '3', '4',
+        '5', '--colormap', 'custom', '--use_aux', '--is_testing'])
+    assert cfg.aux_coef == [1.0, 0.5]
+    assert cfg.class_weights == [1.0, 2.0, 3.0, 4.0, 5.0]
+    assert cfg.colormap == 'custom'
+    assert cfg.use_aux is True and cfg.is_testing is True
+
+
+def test_resolve_derives_paths_and_crops():
+    cfg = _base(crop_size=100)
+    cfg.resolve(num_devices=4)
+    assert cfg.crop_h == 100 and cfg.crop_w == 100
+    assert cfg.gpu_num == 4
+    assert cfg.load_ckpt_path.endswith('last.ckpt')
+    assert cfg.tb_log_dir.startswith(cfg.save_dir)
+
+
+def test_resolve_schedule_matches_reference_math():
+    # reference utils/scheduler.py:6-10: iters = ceil(train_num/bs/gpus),
+    # total = iters * epochs
+    cfg = _base(train_bs=4, total_epoch=10)
+    cfg.resolve(num_devices=2)
+    cfg.resolve_schedule(train_num=64)
+    assert cfg.iters_per_epoch == 8          # 64 / (4*2)
+    assert cfg.total_itrs == 80
+
+
+def test_lr_scales_with_device_count():
+    # reference utils/optimizer.py:9-12: lr = base_lr * gpu_num
+    cfg = _base(base_lr=0.01)
+    cfg.resolve(num_devices=8)
+    assert cfg.lr == pytest.approx(0.08)

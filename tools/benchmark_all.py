@@ -15,25 +15,13 @@ readback.
 import argparse
 import json
 import sys
-import time
 from os import path
 
 sys.path.append(path.dirname(path.dirname(path.abspath(__file__))))
 
 import numpy as np
 
-# Reference RTX-2080 FPS at 1024x512 bs1 (README.md:133-203).
-REFERENCE_FPS = {
-    'adscnet': 89, 'aglnet': 61, 'bisenetv1': 88, 'bisenetv2': 142,
-    'canet': 76, 'cfpnet': 64, 'cgnet': 157, 'contextnet': 80,
-    'dabnet': 140, 'ddrnet': 233, 'dfanet': 60, 'edanet': 125,
-    'enet': 140, 'erfnet': 60, 'esnet': 66, 'espnet': 111,
-    'espnetv2': 101, 'farseenet': 130, 'fastscnn': 358, 'fddwnet': 51,
-    'fpenet': 90, 'fssnet': 121, 'icnet': 102, 'lednet': 76,
-    'linknet': 106, 'lite_hrnet': 30, 'liteseg': 117, 'mininet': 254,
-    'mininetv2': 86, 'ppliteseg': 201, 'regseg': 104, 'segnet': 14,
-    'shelfnet': 110, 'sqnet': 69, 'stdc': 163, 'swiftnet': 141,
-}
+from rtseg_tpu.utils.bench import REFERENCE_FPS, fenced_throughput
 
 DEFAULT_MODELS = 'fastscnn,bisenetv2,ddrnet,stdc,ppliteseg,enet'
 
@@ -58,16 +46,8 @@ def bench_forward(name, batch, h, w, queue, trials):
     def fwd(variables, images):
         return model.apply(variables, images, False).astype(jnp.float32).sum()
 
-    for _ in range(3):
-        float(fwd(variables, images))
-    best = 0.0
-    for _ in range(trials):
-        t0 = time.perf_counter()
-        for _ in range(queue):
-            out = fwd(variables, images)
-        float(out)
-        best = max(best, batch * queue / (time.perf_counter() - t0))
-    return best
+    return fenced_throughput(lambda: fwd(variables, images), float, batch,
+                             queue=queue, trials=trials)
 
 
 def bench_train(name, batch, h, w, queue, trials):
@@ -99,16 +79,14 @@ def bench_train(name, batch, h, w, queue, trials):
     masks = jax.device_put(
         rng.randint(0, 19, (batch, h, w)).astype(np.int32))
 
-    state, metrics = step(state, images, masks)   # compile
-    float(metrics['loss'])
-    best = 0.0
-    for _ in range(trials):
-        t0 = time.perf_counter()
-        for _ in range(queue):
-            state, metrics = step(state, images, masks)
-        float(metrics['loss'])                    # device-side fence
-        best = max(best, batch * queue / (time.perf_counter() - t0))
-    return best
+    carry = {'state': state}
+
+    def call():
+        carry['state'], metrics = step(carry['state'], images, masks)
+        return metrics['loss']
+
+    return fenced_throughput(call, float, batch, queue=queue, trials=trials,
+                             warmup=1)
 
 
 def main() -> int:
@@ -135,14 +113,17 @@ def main() -> int:
                   flush=True)
             continue
         base = REFERENCE_FPS.get(name)
-        ratio = f'{ips / base:.1f}x' if base and not args.train else '—'
+        # the reference has no train-throughput numbers, so a train/inference
+        # ratio would be meaningless — suppress vs_baseline in --train mode
+        comparable = base and not args.train
+        ratio = f'{ips / base:.1f}x' if comparable else '—'
         rows.append((name, ips, base, ratio))
         print(json.dumps({
             'metric': f'{name} {kind} imgs/sec/chip '
                       f'({args.imgw}x{args.imgh}, bs{args.batch})',
             'value': round(ips, 1),
             'unit': 'imgs/sec',
-            'vs_baseline': round(ips / base, 3) if base else None,
+            'vs_baseline': round(ips / base, 3) if comparable else None,
         }), flush=True)
 
     print(f'\n| model | {kind} imgs/sec/chip (TPU v5e, bs{args.batch}) | '
