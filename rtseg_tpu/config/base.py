@@ -99,6 +99,9 @@ class SegConfig:
 
     # ----- Training setting (base_config.py:64-71) -----
     amp_training: bool = False             # on TPU: bf16 compute, no GradScaler
+    # rematerialize activations in backward (jax.checkpoint): trades ~1/3
+    # more FLOPs for a large HBM saving, enabling bigger crops/batches
+    remat: bool = False
     resume_training: bool = True
     load_ckpt: bool = True
     load_ckpt_path: Optional[str] = None

@@ -54,6 +54,7 @@ def get_parser() -> argparse.ArgumentParser:
     p.add_argument('--train_bs', type=int)
     p.add_argument('--use_aux', action='store_const', const=True)
     p.add_argument('--aux_coef', type=float, nargs='+')
+    p.add_argument('--remat', action='store_const', const=True)
     # Validation
     p.add_argument('--val_bs', type=int)
     p.add_argument('--begin_val_epoch', type=int)

@@ -45,11 +45,11 @@ def get_loader(config):
     train_loader = ShardedLoader(
         train_ds, global_train, seed=config.random_seed, shuffle=True,
         drop_last=True, ignore_index=config.ignore_index,
-        process_index=pi, process_count=pc)
+        process_index=pi, process_count=pc, workers=config.base_workers)
     val_loader = ShardedLoader(
         val_ds, global_val, seed=config.random_seed, shuffle=False,
         drop_last=False, ignore_index=config.ignore_index,
-        process_index=pi, process_count=pc)
+        process_index=pi, process_count=pc, workers=config.base_workers)
     return train_loader, val_loader
 
 

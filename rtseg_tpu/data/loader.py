@@ -32,7 +32,7 @@ class ShardedLoader:
                  shuffle: bool = True, drop_last: bool = True,
                  ignore_index: int = 255, pad_labels: bool = True,
                  process_index: int = 0, process_count: int = 1,
-                 prefetch: int = 2):
+                 prefetch: int = 2, workers: int = 0):
         self.dataset = dataset
         self.global_batch = global_batch
         self.local_batch = global_batch // process_count
@@ -45,6 +45,10 @@ class ShardedLoader:
         self.process_index = process_index
         self.process_count = process_count
         self.prefetch = prefetch
+        # intra-batch sample fetch parallelism (the DataLoader num_workers
+        # role, reference datasets/__init__.py:35-41); cv2/PIL/numpy release
+        # the GIL so threads scale. 0/1 = fetch serially in the producer.
+        self.workers = workers
         self.epoch = 0
 
     def __len__(self):
@@ -63,19 +67,25 @@ class ShardedLoader:
             return rng.permutation(n)
         return np.arange(n)
 
-    def _make_batch(self, idxs: np.ndarray, rng: np.random.Generator):
+    def _make_batch(self, idxs: np.ndarray, rngs, pool):
         n_real = len(idxs)
         want = self.local_batch
         if n_real == 0:
             # ragged multi-host tail where this process's slice is empty:
             # emit an all-ignored batch so every host still joins the
             # collectives for this step
-            img0, mask0 = self.dataset.get(0, rng)
+            img0, mask0 = self.dataset.get(0, rngs[0])
             images = np.repeat(img0[None], want, axis=0)
             masks = np.full((want,) + mask0.shape, self.ignore_index,
                             mask0.dtype)
             return images, masks
-        samples = [self.dataset.get(int(i), rng) for i in idxs]
+        if pool is not None:
+            samples = list(pool.map(
+                lambda a: self.dataset.get(int(a[0]), a[1]),
+                zip(idxs, rngs)))
+        else:
+            samples = [self.dataset.get(int(i), r)
+                       for i, r in zip(idxs, rngs)]
         images = np.stack([s[0] for s in samples])
         masks = np.stack([s[1] for s in samples])
         if n_real < want:                       # ragged val tail: pad+ignore
@@ -87,12 +97,21 @@ class ShardedLoader:
             masks = np.concatenate([masks, pad_masks])
         return images, masks
 
+    def _sample_rngs(self, batch_idx: int):
+        """Deterministic per-sample augmentation rng: a fixed function of
+        (seed, epoch, process, batch, slot) so parallel fetch order cannot
+        change the draws (same contract as the reference's seeded workers)."""
+        return [np.random.default_rng(
+            (self.seed, self.epoch, self.process_index, batch_idx, j))
+            for j in range(self.local_batch)]
+
     def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+        from concurrent.futures import ThreadPoolExecutor
         indices = self._epoch_indices()
         n = len(indices)
         nb = len(self)
-        rng = np.random.default_rng(
-            (self.seed, self.epoch, self.process_index))
+        pool = (ThreadPoolExecutor(max_workers=self.workers)
+                if self.workers > 1 else None)
 
         stop = threading.Event()
 
@@ -114,7 +133,9 @@ class ShardedLoader:
                     lo = self.process_index * self.local_batch
                     hi = lo + self.local_batch
                     local_idx = batch_idx[lo:hi]
-                    if not put(q, self._make_batch(local_idx, rng)):
+                    batch = self._make_batch(local_idx,
+                                             self._sample_rngs(b), pool)
+                    if not put(q, batch):
                         return                  # consumer went away
                 put(q, None)
             except BaseException as e:          # surface worker errors
@@ -135,3 +156,5 @@ class ShardedLoader:
             # unblock the producer if the consumer exits early (exception in
             # the train step, early stop, abandoned iterator)
             stop.set()
+            if pool is not None:
+                pool.shutdown(wait=False, cancel_futures=True)

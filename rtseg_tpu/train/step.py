@@ -52,6 +52,19 @@ def _mesh_axes(mesh: Mesh) -> Tuple[str, ...]:
     return tuple(mesh.axis_names)
 
 
+def _make_apply_train(config, model):
+    """Training-mode forward; with config.remat, activations are
+    rematerialized in the backward pass (jax.checkpoint) — the standard
+    TPU trade of FLOPs for HBM, enabling larger crops/batches."""
+    def apply_train(params, batch_stats, x, rng):
+        return model.apply({'params': params, 'batch_stats': batch_stats},
+                           x, True, mutable=['batch_stats'],
+                           rngs={'dropout': rng})
+    if getattr(config, 'remat', False):
+        apply_train = jax.checkpoint(apply_train)
+    return apply_train
+
+
 def build_train_step(config, model, optimizer, mesh: Mesh,
                      teacher_model=None, teacher_variables=None) -> Callable:
     """Returns step(state, images, masks) -> (state, metrics_dict).
@@ -86,17 +99,15 @@ def build_train_step(config, model, optimizer, mesh: Mesh,
     bn_axis = axes if config.sync_bn else None
 
     base_rng = jax.random.PRNGKey(config.random_seed + 1)
+    apply_train = _make_apply_train(config, model)
 
     def forward_loss(params, batch_stats, images, masks, step):
-        variables = {'params': params, 'batch_stats': batch_stats}
         x = images.astype(compute_dtype)
         # per-step, per-shard dropout rng (torch Dropout semantics)
         rng = jax.random.fold_in(base_rng, step)
         for ax in axes:
             rng = jax.random.fold_in(rng, lax.axis_index(ax))
-        out, mutated = model.apply(variables, x, True,
-                                   mutable=['batch_stats'],
-                                   rngs={'dropout': rng})
+        out, mutated = apply_train(params, batch_stats, x, rng)
         metrics = {}
         if config.use_aux:
             preds, preds_aux = out
@@ -204,14 +215,12 @@ def _build_train_step_gspmd(config, model, optimizer, mesh: Mesh,
     total_itrs = max(int(config.total_itrs), 1)
     aux_coef = config.aux_coef
     base_rng = jax.random.PRNGKey(config.random_seed + 1)
+    apply_train = _make_apply_train(config, model)
 
     def forward_loss(params, batch_stats, images, masks, step):
-        variables = {'params': params, 'batch_stats': batch_stats}
         x = images.astype(compute_dtype)
         rng = jax.random.fold_in(base_rng, step)
-        out, mutated = model.apply(variables, x, True,
-                                   mutable=['batch_stats'],
-                                   rngs={'dropout': rng})
+        out, mutated = apply_train(params, batch_stats, x, rng)
         metrics = {}
         if config.use_aux:
             preds, preds_aux = out

@@ -214,6 +214,32 @@ def test_loader_multiprocess_sharding_partitions_batch():
         np.testing.assert_array_equal(got, gmask)
 
 
+class _RngDataset(_ArangeDataset):
+    """get() draws from the rng, to pin augmentation determinism."""
+
+    def get(self, i, rng):
+        h, w = self.hw
+        return (np.full((h, w, 3), i, np.float32) + rng.random(),
+                np.full((h, w), i, np.int32))
+
+
+def test_loader_parallel_fetch_is_deterministic():
+    # workers>1 must yield bit-identical batches to serial fetch: per-sample
+    # rng is a function of (seed, epoch, process, batch, slot), not of
+    # thread scheduling
+    def run(workers):
+        loader = ShardedLoader(_RngDataset(16), global_batch=4, seed=5,
+                               shuffle=True, workers=workers)
+        loader.set_epoch(2)
+        return list(loader)
+
+    serial, threaded = run(0), run(4)
+    assert len(serial) == len(threaded) == 4
+    for (si, sm), (ti, tm) in zip(serial, threaded):
+        np.testing.assert_array_equal(si, ti)
+        np.testing.assert_array_equal(sm, tm)
+
+
 def test_loader_propagates_worker_errors():
     class Exploding(_ArangeDataset):
         def get(self, i, rng):

@@ -1,0 +1,53 @@
+"""Tools layer smoke tests on the CPU mesh (reference tools/test_speed.py:9-61,
+tools/get_model_infos.py:9-27; our tools/ additions)."""
+
+import subprocess
+import sys
+from os import path
+
+import pytest
+
+ROOT = path.dirname(path.dirname(path.abspath(__file__)))
+
+
+def test_get_model_infos_params():
+    sys.path.insert(0, path.join(ROOT, 'tools'))
+    try:
+        from get_model_infos import cal_model_params
+    finally:
+        sys.path.pop(0)
+    from rtseg_tpu.config import SegConfig
+    cfg = SegConfig(dataset='synthetic', model='fastscnn', num_class=19,
+                    save_dir='/tmp/rtseg_tools_test')
+    cfg.resolve(num_devices=1)
+    n = cal_model_params(cfg, imgh=64, imgw=64)
+    # reference README.md:153 repo params 1.02M (exact-count parity vs the
+    # torch model is pinned in tests/test_models.py)
+    assert abs(n / 1e6 - 1.02) < 0.005
+
+
+def test_test_speed_runs():
+    sys.path.insert(0, path.join(ROOT, 'tools'))
+    try:
+        from test_speed import test_model_speed
+    finally:
+        sys.path.pop(0)
+    from rtseg_tpu.config import SegConfig
+    cfg = SegConfig(dataset='synthetic', model='fastscnn', num_class=19,
+                    compute_dtype='float32', save_dir='/tmp/rtseg_tools_test')
+    cfg.resolve(num_devices=1)
+    fps = test_model_speed(cfg, ratio=1.0, imgw=64, imgh=64, iterations=3)
+    assert fps > 0
+
+
+def test_export_cli_smoke(tmp_path):
+    out = str(tmp_path / 'm.stablehlo')
+    r = subprocess.run(
+        [sys.executable, path.join(ROOT, 'tools', 'export.py'),
+         '--model', 'fastscnn', '--num_class', '19', '--imgh', '64',
+         '--imgw', '64', '--compute_dtype', 'float32', '--out', out],
+        capture_output=True, text=True, timeout=540,
+        env={**__import__('os').environ,
+             'XLA_FLAGS': '--xla_force_host_platform_device_count=1'})
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert path.exists(out)

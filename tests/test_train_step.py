@@ -59,6 +59,28 @@ def test_train_step_runs_and_updates(mesh8):
     assert max(diff) == 0
 
 
+def test_train_step_remat_matches(mesh8):
+    """config.remat rematerializes activations in backward (jax.checkpoint)
+    — must change memory, never math: losses and updated params agree with
+    the non-remat step bit-for-bit (same ops, f32)."""
+    images, masks = _batch()
+    states = {}
+    for remat in (False, True):
+        cfg = _cfg(remat=remat)
+        model = get_model(cfg)
+        opt = get_optimizer(cfg)
+        state = create_train_state(model, opt, jax.random.PRNGKey(0),
+                                   jnp.zeros((1, 32, 64, 3), jnp.float32))
+        step = build_train_step(cfg, model, opt, mesh8)
+        state, metrics = step(state, images, masks)
+        states[remat] = (state, float(metrics['loss']))
+    assert states[False][1] == pytest.approx(states[True][1], rel=1e-6)
+    diffs = jax.tree.leaves(jax.tree.map(
+        lambda a, b: float(np.abs(np.asarray(a) - np.asarray(b)).max()),
+        states[False][0].params, states[True][0].params))
+    assert max(diffs) < 1e-6
+
+
 def test_eval_step_confusion_matrix(mesh8):
     cfg = _cfg()
     model = get_model(cfg)
