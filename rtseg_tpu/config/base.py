@@ -99,8 +99,11 @@ class SegConfig:
 
     # ----- Training setting (base_config.py:64-71) -----
     amp_training: bool = False             # on TPU: bf16 compute, no GradScaler
-    # rematerialize activations in backward (jax.checkpoint): trades ~1/3
-    # more FLOPs for a large HBM saving, enabling bigger crops/batches
+    # rematerialize the training forward in backward (jax.checkpoint):
+    # trades recompute FLOPs for HBM. Whole-forward granularity — measured
+    # ~20% temp-HBM saving on bisenetv2 @1024^2 bs16 (12.0 -> 9.6 GiB);
+    # for larger inputs the bigger levers are spatial_partition and
+    # smaller per-device batch
     remat: bool = False
     resume_training: bool = True
     load_ckpt: bool = True
@@ -154,7 +157,6 @@ class SegConfig:
     iters_per_epoch: int = 0
     total_itrs: int = 0
     lr: float = 0.0
-    num_workers: int = 0
     gpu_num: int = 1                       # device count (kept for parity of meaning)
 
     _resolved: bool = False
@@ -186,7 +188,6 @@ class SegConfig:
         else:
             raise NotImplementedError(
                 f'Unsupported optimizer type: {self.optimizer_type}')
-        self.num_workers = self.base_workers * self.gpu_num
         self._resolved = True
         return self
 

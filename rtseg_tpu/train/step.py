@@ -45,6 +45,8 @@ def _pin_bn_axis(fn: Callable, axis) -> Callable:
     def wrapper(*args, **kwargs):
         set_bn_axis(axis)
         return fn(*args, **kwargs)
+    wrapper.jitted = fn          # expose for AOT lower()/compile() analysis
+    wrapper.bn_axis = axis
     return wrapper
 
 
@@ -53,9 +55,11 @@ def _mesh_axes(mesh: Mesh) -> Tuple[str, ...]:
 
 
 def _make_apply_train(config, model):
-    """Training-mode forward; with config.remat, activations are
-    rematerialized in the backward pass (jax.checkpoint) — the standard
-    TPU trade of FLOPs for HBM, enabling larger crops/batches."""
+    """Training-mode forward; with config.remat, the forward is
+    rematerialized in the backward pass (jax.checkpoint), trading one extra
+    forward of FLOPs for temp HBM (measured ~20% on bisenetv2 @1024^2 —
+    whole-forward granularity, so XLA still materializes residuals during
+    the recompute; see config.remat comment for the bigger levers)."""
     def apply_train(params, batch_stats, x, rng):
         return model.apply({'params': params, 'batch_stats': batch_stats},
                            x, True, mutable=['batch_stats'],

@@ -240,14 +240,64 @@ def test_loader_parallel_fetch_is_deterministic():
         np.testing.assert_array_equal(sm, tm)
 
 
-def test_loader_propagates_worker_errors():
+@pytest.mark.parametrize('workers', [0, 4])
+def test_loader_propagates_worker_errors(workers):
     class Exploding(_ArangeDataset):
         def get(self, i, rng):
             raise ValueError('boom')
 
-    loader = ShardedLoader(Exploding(8), global_batch=4, shuffle=False)
+    loader = ShardedLoader(Exploding(8), global_batch=4, shuffle=False,
+                           workers=workers)
     with pytest.raises(ValueError, match='boom'):
         list(loader)
+
+
+def test_check_datasets_labelme_conversion(tmp_path):
+    """labelme JSON -> Custom dataset layout (reference
+    utils/check_datasets.py:14-99): split dirs, rasterized masks, data.yaml
+    loadable by the Custom dataset."""
+    import base64
+    import io
+    import json
+
+    from rtseg_tpu.utils.check_datasets import (
+        check_semantic_segmentation_datasets)
+
+    labels = tmp_path / 'ds' / 'labels'
+    os.makedirs(labels)
+    rng = np.random.RandomState(0)
+    for i in range(4):
+        img = Image.fromarray(
+            rng.randint(0, 255, (40, 60, 3), dtype=np.uint8))
+        buf = io.BytesIO()
+        img.save(buf, format='PNG')
+        ann = {
+            'imageData': base64.b64encode(buf.getvalue()).decode(),
+            'shapes': [{'label': 'cat', 'shape_type': 'polygon',
+                        'points': [[5, 5], [50, 5], [50, 30], [5, 30]]}],
+        }
+        with open(labels / f'im{i}.json', 'w') as f:
+            json.dump(ann, f)
+
+    check_semantic_segmentation_datasets(str(tmp_path / 'ds'),
+                                         train_factor=0.75)
+    out = tmp_path / 'ds' / 'out'
+    assert len(os.listdir(out / 'train' / 'imgs')) == 3
+    assert len(os.listdir(out / 'val' / 'imgs')) == 1
+    # mask rasterized: polygon interior = class 1, outside = background 0
+    a_mask = os.listdir(out / 'train' / 'masks')[0]
+    m = np.asarray(Image.open(out / 'train' / 'masks' / a_mask))
+    assert m[15, 20] == 1 and m[35, 55] == 0
+
+    # round-trip: the produced layout loads through the Custom dataset
+    cfg = SegConfig(dataset='custom', data_root=str(out), num_class=2,
+                    train_size=32, test_size=32, crop_size=32,
+                    save_dir='/tmp/rtseg_data_test')
+    cfg.resolve(num_devices=1)
+    ds = Custom(cfg, 'train')
+    assert len(ds) == 3 and ds.names[1] == 'cat'
+    img, mask = ds.get(0, np.random.default_rng(0))
+    assert img.shape == (32, 32, 3) and mask.max() <= 1
 
 
 def test_get_loader_schedule_math(cityscapes_root):

@@ -1,6 +1,7 @@
 """Tools layer smoke tests on the CPU mesh (reference tools/test_speed.py:9-61,
 tools/get_model_infos.py:9-27; our tools/ additions)."""
 
+import os
 import subprocess
 import sys
 from os import path
@@ -47,7 +48,7 @@ def test_export_cli_smoke(tmp_path):
          '--model', 'fastscnn', '--num_class', '19', '--imgh', '64',
          '--imgw', '64', '--compute_dtype', 'float32', '--out', out],
         capture_output=True, text=True, timeout=540,
-        env={**__import__('os').environ,
+        env={**os.environ,
              'XLA_FLAGS': '--xla_force_host_platform_device_count=1'})
     assert r.returncode == 0, r.stderr[-2000:]
     assert path.exists(out)

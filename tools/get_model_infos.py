@@ -39,9 +39,52 @@ def cal_model_params(config, imgh=1024, imgw=2048):
     return n_params
 
 
+def cal_train_step_memory(config, imgh=1024, imgw=1024, batch=None):
+    """AOT-compile the full train step and report XLA's memory analysis —
+    how much temp HBM a (crop, batch, remat) combination needs, without
+    running anything. No reference equivalent; sizes TPU training runs."""
+    from jax.sharding import Mesh
+    from rtseg_tpu.nn import set_bn_axis
+    from rtseg_tpu.parallel.mesh import DATA_AXIS
+    from rtseg_tpu.train.optim import get_optimizer
+    from rtseg_tpu.train.state import create_train_state
+    from rtseg_tpu.train.step import build_train_step
+
+    batch = batch or config.train_bs
+    if config.total_itrs <= 0:
+        config.resolve_schedule(train_num=batch * 100)
+    model = get_model(config)
+    opt = get_optimizer(config)
+    mesh = Mesh(np.array(jax.devices()[:1]), (DATA_AXIS,))
+    state = create_train_state(model, opt, jax.random.PRNGKey(0),
+                               jnp.zeros((1, imgh, imgw, 3), jnp.float32))
+    step = build_train_step(config, model, opt, mesh)
+    images = jax.ShapeDtypeStruct((batch, imgh, imgw, 3), jnp.float32)
+    masks = jax.ShapeDtypeStruct((batch, imgh, imgw), jnp.int32)
+    set_bn_axis(step.bn_axis)
+    m = step.jitted.lower(jax.device_get(state), images, masks) \
+        .compile().memory_analysis()
+    gib = 2.0 ** 30
+    print(f'\n=========Train-step memory (XLA) @ {imgw}x{imgh} '
+          f'bs{batch} remat={config.remat}=========')
+    print(f'temp:   {m.temp_size_in_bytes / gib:.2f} GiB')
+    print(f'args:   {m.argument_size_in_bytes / gib:.2f} GiB')
+    print(f'output: {m.output_size_in_bytes / gib:.2f} GiB')
+    return m
+
+
 if __name__ == '__main__':
+    argv = sys.argv[1:]
+    train_mem = '--train_memory' in argv
+    if train_mem:
+        argv.remove('--train_memory')
+        sys.argv = sys.argv[:1] + argv
     config = SegConfig(dataset='synthetic', model='bisenetv2', num_class=19)
-    if len(sys.argv) > 1:
+    if argv:
         config = load_parser(config)
     config.resolve(num_devices=1)
-    cal_model_params(config)
+    if train_mem:
+        # memory sizing only — skip the separate FLOPs forward compile
+        cal_train_step_memory(config, imgh=config.crop_h, imgw=config.crop_w)
+    else:
+        cal_model_params(config)
