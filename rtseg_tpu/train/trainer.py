@@ -229,12 +229,29 @@ class SegTrainer:
 
     def validate(self, val_best: bool = False) -> float:
         cfg = self.config
-        cm = np.zeros((cfg.num_class, cfg.num_class), np.int64)
+        # accumulate the confusion matrix on device: a host readback per
+        # batch would fence the async dispatch queue and serialize loader
+        # prefetch against TPU compute; one transfer at the end instead.
+        # The device matrix is int32, so flush to the host int64 accumulator
+        # before the pixel count (an upper bound on any cell) could overflow.
+        cm_host = np.zeros((cfg.num_class, cfg.num_class), np.int64)
+        cm_dev, dev_pixels = None, 0
+        # eval_step psums the matrix over the whole mesh, so each cell is
+        # bounded by the GLOBAL pixel count, not this process's share
+        procs = jax.process_count()
         for images, masks in self.val_loader:
+            if (cm_dev is not None and
+                    dev_pixels + masks.size * procs >= np.iinfo(np.int32).max):
+                cm_host += np.asarray(cm_dev, np.int64)
+                cm_dev, dev_pixels = None, 0
             imgs, msks = self._put(images, masks)
-            cm += np.asarray(self.eval_step(self.state, imgs, msks),
-                             np.int64)
-        iou = np.asarray(iou_from_cm(jnp.asarray(cm)))
+            part = self.eval_step(self.state, imgs, msks)
+            cm_dev = part if cm_dev is None else cm_dev + part
+            dev_pixels += masks.size * procs
+        if cm_dev is None:
+            raise RuntimeError('Validation loader yielded no batches.')
+        cm_host += np.asarray(cm_dev, np.int64)
+        iou = iou_from_cm(cm_host)
         score = float(iou.mean())
         if self.main_rank:
             if val_best:

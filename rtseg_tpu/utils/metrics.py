@@ -21,25 +21,48 @@ def confusion_matrix(preds: jnp.ndarray, labels: jnp.ndarray, num_class: int,
     is ~8x faster than scatter-add at 8M+ pixels (83ms -> 10.6ms on v5e for
     a bs16 1024x512 batch). ops/pallas_metrics.py holds an equivalent
     blocked Pallas kernel that avoids the one-hot HBM materialization.
+
+    Exactness: a float32 accumulator only represents consecutive integers up
+    to 2**24, so pixels are einsum'd in chunks of 2**20 (each chunk's cell
+    counts are exact in f32) and the per-chunk matrices are summed in int32 —
+    exact until a cell of one call's result reaches 2**31 (~2.1e9 pixels per
+    global batch). Callers accumulating across batches must flush to int64
+    before their running total could pass that bound.
     """
     import jax
     valid = (labels != ignore_index).reshape(-1)
     t = jnp.where(valid, labels.reshape(-1), 0).astype(jnp.int32)
     p = preds.astype(jnp.int32).reshape(-1)
+    chunk = 1 << 20
+    n = t.shape[0]
+    if n == 0:
+        return jnp.zeros((num_class, num_class), jnp.int32)
+    k = -(-n // chunk)
+    if k > 1 and n % chunk:
+        pad = k * chunk - n
+        valid = jnp.pad(valid, (0, pad))        # padded rows: valid=False
+        t = jnp.pad(t, (0, pad))
+        p = jnp.pad(p, (0, pad))
     oh_t = jax.nn.one_hot(t, num_class, dtype=jnp.float32) \
         * valid[:, None].astype(jnp.float32)
     oh_p = jax.nn.one_hot(p, num_class, dtype=jnp.float32)
-    cm = jnp.einsum('nc,nd->cd', oh_t, oh_p, precision='highest')
-    return cm.astype(jnp.int32)
+    cm = jnp.einsum('knc,knd->kcd',
+                    oh_t.reshape(k, -1, num_class),
+                    oh_p.reshape(k, -1, num_class),
+                    precision='highest')
+    return cm.astype(jnp.int32).sum(axis=0)
 
 
-def iou_from_cm(cm: jnp.ndarray) -> jnp.ndarray:
-    """Per-class IoU (average='none' JaccardIndex semantics)."""
-    cm = cm.astype(jnp.float64) if cm.dtype == jnp.int64 else cm.astype(jnp.float32)
-    tp = jnp.diagonal(cm)
+def iou_from_cm(cm) -> np.ndarray:
+    """Per-class IoU (average='none' JaccardIndex semantics).
+
+    Host numpy float64 on purpose: the (C, C) matrix is tiny, and jnp would
+    silently truncate int64 counts to float32 without jax_enable_x64."""
+    cm = np.asarray(cm, np.float64)
+    tp = np.diagonal(cm)
     union = cm.sum(axis=0) + cm.sum(axis=1) - tp
-    return jnp.where(union > 0, tp / jnp.maximum(union, 1), 0.0)
+    return np.where(union > 0, tp / np.maximum(union, 1), 0.0)
 
 
 def miou_from_cm(cm) -> float:
-    return float(np.mean(np.asarray(iou_from_cm(jnp.asarray(cm)))))
+    return float(np.mean(iou_from_cm(cm)))

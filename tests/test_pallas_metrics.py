@@ -19,3 +19,28 @@ def test_pallas_cm_matches_default():
     got = np.asarray(confusion_matrix_pallas(preds, labels, 19))
     assert np.array_equal(want, got)
     assert want.sum() == int((np.asarray(labels) != 255).sum())
+
+
+def test_cm_chunk_boundary_and_ignore():
+    """Pixel counts that straddle the 2**20 einsum chunk exercise the padded
+    tail; padded rows must not leak counts and ignore pixels must drop."""
+    rng = np.random.RandomState(1)
+    n = (1 << 20) * 2 + 12345
+    t = rng.randint(0, 5, n).astype(np.int32)
+    t[rng.rand(n) < 0.1] = 255
+    p = rng.randint(0, 5, n).astype(np.int32)
+    got = np.asarray(confusion_matrix(jnp.asarray(p), jnp.asarray(t), 5, 255))
+    want = np.zeros((5, 5), np.int64)
+    m = t != 255
+    np.add.at(want, (t[m], p[m]), 1)
+    assert np.array_equal(got, want)
+
+
+def test_cm_exact_past_f32_integer_limit():
+    """A single cell above 2**24 must stay exact: f32 cannot represent
+    consecutive integers there, so the chunked-einsum + int32 reduction is
+    what guarantees exact counts (a flat f32 einsum silently drops counts)."""
+    n = 20_000_000                    # > 2**24 pixels, all in cell (0, 0)
+    z = jnp.zeros(n, jnp.int32)
+    cm = np.asarray(confusion_matrix(z, z, 2, 255))
+    assert cm[0, 0] == n

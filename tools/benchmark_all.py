@@ -56,7 +56,7 @@ def bench_train(name, batch, h, w, queue, trials):
     from jax.sharding import Mesh
     from rtseg_tpu.config import SegConfig
     from rtseg_tpu.models import get_model
-    from rtseg_tpu.models.registry import AUX_MODELS
+    from rtseg_tpu.models.registry import AUX_MODELS, DETAIL_HEAD_MODELS
     from rtseg_tpu.parallel.mesh import DATA_AXIS
     from rtseg_tpu.train.optim import get_optimizer
     from rtseg_tpu.train.state import create_train_state
@@ -64,6 +64,7 @@ def bench_train(name, batch, h, w, queue, trials):
 
     cfg = SegConfig(dataset='synthetic', model=name, num_class=19,
                     train_bs=batch, use_aux=name in AUX_MODELS,
+                    use_detail_head=name in DETAIL_HEAD_MODELS,
                     use_ema=True, loss_type='ohem',
                     compute_dtype='bfloat16', save_dir='/tmp/rtseg_bench')
     cfg.resolve(num_devices=1)
