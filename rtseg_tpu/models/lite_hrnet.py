@@ -8,6 +8,7 @@ concat representation head. Arch hub litehrnet18/30.
 
 from __future__ import annotations
 
+import itertools
 from typing import List
 
 import jax.numpy as jnp
@@ -89,8 +90,10 @@ class CrossResolutionWeightModule(nn.Module):
         hid = w.shape[-1] // self.ch_reduction
         w = ConvBNAct(hid, 1, act_type=self.act_type)(w, train)
         w = ConvBNAct(sum(ch_r), 1, act_type='sigmoid')(w, train)
-        splits = jnp.cumsum(jnp.array(ch_r))[:-1]
-        return jnp.split(w, list(map(int, splits)), axis=-1)
+        # split points are static channel counts — keep them Python ints
+        # (a jnp.cumsum here becomes a tracer under jit and int() fails)
+        splits = list(itertools.accumulate(ch_r))[:-1]
+        return jnp.split(w, splits, axis=-1)
 
 
 class UpsampleBlock(nn.Module):

@@ -43,13 +43,15 @@ def confusion_matrix(preds: jnp.ndarray, labels: jnp.ndarray, num_class: int,
         valid = jnp.pad(valid, (0, pad))        # padded rows: valid=False
         t = jnp.pad(t, (0, pad))
         p = jnp.pad(p, (0, pad))
-    oh_t = jax.nn.one_hot(t, num_class, dtype=jnp.float32) \
-        * valid[:, None].astype(jnp.float32)
-    oh_p = jax.nn.one_hot(p, num_class, dtype=jnp.float32)
+    # bf16 one-hots halve the HBM materialization and stay exact: 0/1 are
+    # exact in bf16 and the MXU accumulates into f32 (preferred_element_type)
+    oh_t = jax.nn.one_hot(t, num_class, dtype=jnp.bfloat16) \
+        * valid[:, None].astype(jnp.bfloat16)
+    oh_p = jax.nn.one_hot(p, num_class, dtype=jnp.bfloat16)
     cm = jnp.einsum('knc,knd->kcd',
                     oh_t.reshape(k, -1, num_class),
                     oh_p.reshape(k, -1, num_class),
-                    precision='highest')
+                    preferred_element_type=jnp.float32)
     return cm.astype(jnp.int32).sum(axis=0)
 
 

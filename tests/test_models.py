@@ -230,3 +230,25 @@ def test_icnet_aux_forward():
                              mutable=['batch_stats'])
     assert main.shape == (1, H, W, NC)
     assert len(aux) == 2
+
+
+@pytest.mark.parametrize('name', sorted(__import__(
+    'rtseg_tpu.models.registry', fromlist=['MODEL_REGISTRY']
+).MODEL_REGISTRY))
+def test_model_traces_under_jit(name):
+    """Every model must trace under jit (abstract shapes): catches
+    tracer-to-Python leaks like int(jnp.cumsum(...)) that eager forwards
+    hide (lite_hrnet shipped with one). eval_shape traces without
+    compiling, so the whole zoo stays cheap."""
+    from rtseg_tpu.config import SegConfig
+    from rtseg_tpu.models import get_model
+    cfg = SegConfig(dataset='synthetic', model=name, num_class=19,
+                    save_dir='/tmp/rtseg_trace')
+    cfg.resolve(num_devices=1)
+    model = get_model(cfg)
+    x = jnp.zeros((1, 64, 64, 3), jnp.float32)
+    variables = jax.eval_shape(
+        lambda k, x: model.init(k, x, False), jax.random.PRNGKey(0), x)
+    out = jax.eval_shape(lambda v, x: model.apply(v, x, False), variables, x)
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    assert leaf.shape[0] == 1
