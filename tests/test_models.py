@@ -252,3 +252,33 @@ def test_model_traces_under_jit(name):
     out = jax.eval_shape(lambda v, x: model.apply(v, x, False), variables, x)
     leaf = jax.tree_util.tree_leaves(out)[0]
     assert leaf.shape[0] == 1
+
+    # training-mode trace: BN batch-stats mutation + dropout rng plumbing
+    def train_fwd(v, x):
+        return model.apply(v, x, True, mutable=['batch_stats'],
+                           rngs={'dropout': jax.random.PRNGKey(1)})
+    out, mutated = jax.eval_shape(train_fwd, variables, x)
+    assert jax.tree_util.tree_leaves(out)[0].shape[0] == 1
+
+
+@pytest.mark.parametrize('name,flag', [('bisenetv2', 'use_aux'),
+                                       ('ddrnet', 'use_aux'),
+                                       ('icnet', 'use_aux'),
+                                       ('stdc', 'use_detail_head')])
+def test_aux_detail_variants_trace_under_jit(name, flag):
+    """Aux-head / detail-head constructions trace in training mode too."""
+    from rtseg_tpu.config import SegConfig
+    from rtseg_tpu.models import get_model
+    cfg = SegConfig(dataset='synthetic', model=name, num_class=19,
+                    save_dir='/tmp/rtseg_trace', **{flag: True})
+    cfg.resolve(num_devices=1)
+    model = get_model(cfg)
+    x = jnp.zeros((1, 64, 64, 3), jnp.float32)
+    variables = jax.eval_shape(
+        lambda k, x: model.init(k, x, True), jax.random.PRNGKey(0), x)
+
+    def train_fwd(v, x):
+        return model.apply(v, x, True, mutable=['batch_stats'],
+                           rngs={'dropout': jax.random.PRNGKey(1)})
+    (main, heads), _ = jax.eval_shape(train_fwd, variables, x)
+    assert main.shape[0] == 1 and len(heads) >= 1
