@@ -10,6 +10,7 @@ readback.
 
     python tools/benchmark_all.py --models fastscnn,bisenetv2,ddrnet
     python tools/benchmark_all.py --train --models bisenetv2
+    python tools/benchmark_all.py --eval --batch 8 --imgh 1024 --imgw 2048
 """
 
 import argparse
@@ -50,23 +51,21 @@ def bench_forward(name, batch, h, w, queue, trials):
                              queue=queue, trials=trials)
 
 
-def bench_train(name, batch, h, w, queue, trials):
+def _setup_state(name, batch, h, w, **cfg_overrides):
+    """Shared train/eval-step harness: config, model, 1-device mesh, train
+    state, and a synthetic device-resident batch."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh
     from rtseg_tpu.config import SegConfig
     from rtseg_tpu.models import get_model
-    from rtseg_tpu.models.registry import AUX_MODELS, DETAIL_HEAD_MODELS
     from rtseg_tpu.parallel.mesh import DATA_AXIS
     from rtseg_tpu.train.optim import get_optimizer
     from rtseg_tpu.train.state import create_train_state
-    from rtseg_tpu.train.step import build_train_step
 
     cfg = SegConfig(dataset='synthetic', model=name, num_class=19,
-                    train_bs=batch, use_aux=name in AUX_MODELS,
-                    use_detail_head=name in DETAIL_HEAD_MODELS,
-                    use_ema=True, loss_type='ohem',
-                    compute_dtype='bfloat16', save_dir='/tmp/rtseg_bench')
+                    compute_dtype='bfloat16', save_dir='/tmp/rtseg_bench',
+                    **cfg_overrides)
     cfg.resolve(num_devices=1)
     cfg.resolve_schedule(train_num=batch * 1000)
     model = get_model(cfg)
@@ -74,11 +73,35 @@ def bench_train(name, batch, h, w, queue, trials):
     mesh = Mesh(np.array(jax.devices()[:1]), (DATA_AXIS,))
     state = create_train_state(model, opt, jax.random.PRNGKey(0),
                                jnp.zeros((1, h, w, 3), jnp.float32))
-    step = build_train_step(cfg, model, opt, mesh)
     rng = np.random.RandomState(0)
     images = jax.device_put(rng.rand(batch, h, w, 3).astype(np.float32))
     masks = jax.device_put(
         rng.randint(0, 19, (batch, h, w)).astype(np.int32))
+    return cfg, model, opt, mesh, state, images, masks
+
+
+def bench_eval(name, batch, h, w, queue, trials):
+    """Validation-step throughput: EMA-weights forward + on-device
+    confusion matrix (the per-batch work of SegTrainer.validate)."""
+    from rtseg_tpu.train.step import build_eval_step
+
+    cfg, model, _, mesh, state, images, masks = _setup_state(
+        name, batch, h, w)
+    eval_step = build_eval_step(cfg, model, mesh)
+    return fenced_throughput(lambda: eval_step(state, images, masks)[0, 0],
+                             float, batch, queue=queue, trials=trials)
+
+
+def bench_train(name, batch, h, w, queue, trials):
+    from rtseg_tpu.models.registry import AUX_MODELS, DETAIL_HEAD_MODELS
+    from rtseg_tpu.train.step import build_train_step
+
+    cfg, model, opt, mesh, state, images, masks = _setup_state(
+        name, batch, h, w, train_bs=batch,
+        use_aux=name in AUX_MODELS,
+        use_detail_head=name in DETAIL_HEAD_MODELS,
+        use_ema=True, loss_type='ohem')
+    step = build_train_step(cfg, model, opt, mesh)
 
     carry = {'state': state}
 
@@ -98,14 +121,20 @@ def main() -> int:
     ap.add_argument('--imgw', type=int, default=1024)
     ap.add_argument('--queue', type=int, default=20)
     ap.add_argument('--trials', type=int, default=3)
-    ap.add_argument('--train', action='store_true',
-                    help='benchmark the full train step instead of inference')
+    mode = ap.add_mutually_exclusive_group()
+    mode.add_argument('--train', action='store_true',
+                      help='benchmark the full train step instead of '
+                           'inference')
+    mode.add_argument('--eval', action='store_true',
+                      help='benchmark the validation step (EMA forward + '
+                           'on-device confusion matrix)')
     args = ap.parse_args()
 
-    kind = 'train' if args.train else 'forward'
+    kind = 'train' if args.train else 'eval' if args.eval else 'forward'
     rows = []
     for name in [m.strip() for m in args.models.split(',') if m.strip()]:
-        fn = bench_train if args.train else bench_forward
+        fn = (bench_train if args.train
+              else bench_eval if args.eval else bench_forward)
         try:
             ips = fn(name, args.batch, args.imgh, args.imgw,
                      args.queue, args.trials)
@@ -114,9 +143,10 @@ def main() -> int:
                   flush=True)
             continue
         base = REFERENCE_FPS.get(name)
-        # the reference has no train-throughput numbers, so a train/inference
-        # ratio would be meaningless — suppress vs_baseline in --train mode
-        comparable = base and not args.train
+        # the reference has no train- or eval-step throughput numbers (its
+        # FPS is bare forward at 1024x512), so those ratios would be
+        # meaningless — vs_baseline only in forward mode
+        comparable = base and not args.train and not args.eval
         ratio = f'{ips / base:.1f}x' if comparable else '—'
         rows.append((name, ips, base, ratio))
         print(json.dumps({
