@@ -86,6 +86,7 @@ def get_parser() -> argparse.ArgumentParser:
     p.add_argument('--ckpt_name', type=str)
     # Training setting
     p.add_argument('--amp_training', action='store_const', const=True)
+    p.add_argument('--log_interval', type=int)
     p.add_argument('--resume_training', type=bool)
     p.add_argument('--load_ckpt', type=bool)
     p.add_argument('--load_ckpt_path', type=str)

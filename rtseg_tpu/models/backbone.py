@@ -11,7 +11,7 @@ otherwise.
 
 from __future__ import annotations
 
-from typing import Sequence, Tuple
+from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -33,15 +33,20 @@ class BasicBlock(nn.Module):
     channels: int
     stride: int = 1
     dilation: int = 1
+    # conv2's dilation; None = same as conv1. ICNet's surgical rewrite
+    # dilates ONLY the first 3x3 of a stage (reference icnet.py:124-142),
+    # so its ResNet passes dilation2=1 there.
+    dilation2: Optional[int] = None
 
     @nn.compact
     def __call__(self, x, train=False):
         identity = x
+        d2 = self.dilation if self.dilation2 is None else self.dilation2
         y = Conv(self.channels, 3, self.stride, self.dilation,
                  name='conv1')(x)
         y = BatchNorm(name='bn1')(y, train)
         y = jax.nn.relu(y)
-        y = Conv(self.channels, 3, 1, self.dilation, name='conv2')(y)
+        y = Conv(self.channels, 3, 1, d2, name='conv2')(y)
         y = BatchNorm(name='bn2')(y, train)
         if self.stride != 1 or x.shape[-1] != self.channels:
             identity = Conv(self.channels, 1, self.stride,
@@ -101,17 +106,26 @@ class ResNet(nn.Module):
             dil = self.dilations[i]
             stride = 1 if (i == 0 or dil > 1) else 2
             for j in range(n):
-                x = block(c, stride if j == 0 else 1, dil,
-                          name=f'layer{i + 1}_{j}')(x, train)
+                # surgical dilation (reference icnet.py:124-142): only the
+                # FIRST block's first 3x3 carries the dilation; every other
+                # conv in the stage stays dilation 1 (stride already 1)
+                bdil = dil if j == 0 else 1
+                kw = {'dilation2': 1} if (kind == 'basic' and dil > 1) \
+                    else {}
+                x = block(c, stride if j == 0 else 1, bdil,
+                          name=f'layer{i + 1}_{j}', **kw)(x, train)
             feats.append(x)
         return tuple(feats)
 
 
 class MBInvertedResidual(nn.Module):
-    """torchvision MobileNetV2 inverted residual (ReLU6)."""
+    """torchvision MobileNetV2 inverted residual (ReLU6). `dilation` dilates
+    the depth-wise conv (the only spatial kernel) for os8/os16 encoder
+    operation (smp make_dilated semantics)."""
     out_channels: int
     stride: int
     expand_ratio: int
+    dilation: int = 1
 
     @nn.compact
     def __call__(self, x, train=False):
@@ -123,7 +137,8 @@ class MBInvertedResidual(nn.Module):
             y = Conv(hid, 1, name='expand')(y)
             y = BatchNorm(name='expand_bn')(y, train)
             y = jnp.clip(y, 0, 6)
-        y = Conv(hid, 3, self.stride, groups=hid, name='dw')(y)
+        y = Conv(hid, 3, self.stride, dilation=self.dilation, groups=hid,
+                 name='dw')(y)
         y = BatchNorm(name='dw_bn')(y, train)
         y = jnp.clip(y, 0, 6)
         y = Conv(self.out_channels, 1, name='project')(y)

@@ -43,16 +43,20 @@ class GatherExpansionLayer(nn.Module):
     def __call__(self, x, train=False):
         in_c = x.shape[-1]
         hid = int(round(in_c * self.expand_ratio))
+        # left branch fully, then right: mirrors the reference's forward call
+        # order (bisenetv2.py:154-162) so weight transplant aligns 1:1
         y = ConvBNAct(in_c, 3, act_type=self.act_type)(x, train)
         if self.stride == 2:
             y = DWConvBNAct(hid, 3, 2, act_type='none')(y, train)
             y = DWConvBNAct(hid, 3, 1, act_type='none')(y, train)
+        else:
+            y = DWConvBNAct(hid, 3, 1, act_type='none')(y, train)
+        y = PWConvBNAct(self.out_channels, act_type='none')(y, train)
+        if self.stride == 2:
             res = DWConvBNAct(in_c, 3, 2, act_type='none')(x, train)
             res = PWConvBNAct(self.out_channels, act_type='none')(res, train)
         else:
-            y = DWConvBNAct(hid, 3, 1, act_type='none')(y, train)
             res = x
-        y = PWConvBNAct(self.out_channels, act_type='none')(y, train)
         return Activation(self.act_type)(res + y)
 
 

@@ -54,11 +54,12 @@ class DownsamplingBlock(nn.Module):
         c = self.out_channels
         hid = c // 4
         a = self.act_type
+        # pool branch first: reference fssnet.py:116-121 call order
+        p = max_pool(x, 3, 2, 1)
+        p = ConvBNAct(c, 1, act_type='none')(p, train)
         y = ConvBNAct(hid, 2, 2, act_type=a)(x, train)
         y = ConvBNAct(hid, 3, act_type=a)(y, train)
         y = ConvBNAct(c, 1, act_type='none')(y, train)
-        p = max_pool(x, 3, 2, 1)
-        p = ConvBNAct(c, 1, act_type='none')(p, train)
         return Activation(a)(y + p)
 
 

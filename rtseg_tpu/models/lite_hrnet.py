@@ -141,43 +141,41 @@ class FusionBlock(nn.Module):
         chans = list(range(st)) + ([st] if self.extra_output else [])
         chans = [2 ** c * self.base_ch for c in chans]
 
-        # stream1: from feats[0] down to every lower resolution
-        n1 = st + 1 if self.extra_output else st
-        s1 = [feats[0]] + [
-            DownsampleBlock(chans[i], i, a, name=f's1_{i}')(feats[0], train)
-            for i in range(1, n1)]
-        # stream2: feats[1] up to res0, identity, downs
-        n2 = st if self.extra_output else st - 1
-        s2 = [UpsampleBlock(chans[0], 2, a, name='s2_up')(feats[1], train),
-              feats[1]] + [
-            DownsampleBlock(chans[i + 1], i, a, name=f's2_{i}')(
-                feats[1], train) for i in range(1, n2)]
-
+        # Module creation follows the reference's FORWARD call order
+        # (lite_hrnet.py:245-265) — not its ModuleList registration order —
+        # so weight transplant aligns 1:1. Names pin the param tree, so the
+        # order of creation is free to mirror the torch call sequence.
         x3, x4 = None, None
-        x1 = s1[0] + s2[0]
-        x2 = s1[1] + s2[1]
+        x1 = feats[0] + UpsampleBlock(chans[0], 2, a,
+                                      name='s2_up')(feats[1], train)
+        x2 = DownsampleBlock(chans[1], 1, a,
+                             name='s1_1')(feats[0], train) + feats[1]
         if st in (3, 4) or self.extra_output:
-            x3 = s1[2] + s2[2]
+            x3 = (DownsampleBlock(chans[2], 2, a,
+                                  name='s1_2')(feats[0], train)
+                  + DownsampleBlock(chans[2], 1, a,
+                                    name='s2_1')(feats[1], train))
         if st in (3, 4):
-            s3 = [UpsampleBlock(chans[2 - i], 2 ** i, a,
-                                name=f's3_up{i}')(feats[2], train)
-                  for i in range(2, 0, -1)] + [feats[2]]
-            if self.extra_output or st == 4:
-                s3.append(DownsampleBlock(chans[3], 1, a,
-                                          name='s3_down')(feats[2], train))
-            x1 = x1 + s3[0]
-            x2 = x2 + s3[1]
-            x3 = x3 + s3[2]
+            x1 = x1 + UpsampleBlock(chans[0], 4, a,
+                                    name='s3_up2')(feats[2], train)
+            x2 = x2 + UpsampleBlock(chans[1], 2, a,
+                                    name='s3_up1')(feats[2], train)
+            x3 = x3 + feats[2]
             if st == 4 or self.extra_output:
-                x4 = s1[3] + s2[3] + s3[3]
+                x4 = (DownsampleBlock(chans[3], 3, a,
+                                      name='s1_3')(feats[0], train)
+                      + DownsampleBlock(chans[3], 2, a,
+                                        name='s2_2')(feats[1], train)
+                      + DownsampleBlock(chans[3], 1, a,
+                                        name='s3_down')(feats[2], train))
                 if st == 4:
-                    s4 = [UpsampleBlock(chans[3 - i], 2 ** i, a,
-                                        name=f's4_up{i}')(feats[3], train)
-                          for i in range(3, 0, -1)] + [feats[3]]
-                    x1 = x1 + s4[0]
-                    x2 = x2 + s4[1]
-                    x3 = x3 + s4[2]
-                    x4 = x4 + s4[3]
+                    x1 = x1 + UpsampleBlock(chans[0], 8, a,
+                                            name='s4_up3')(feats[3], train)
+                    x2 = x2 + UpsampleBlock(chans[1], 4, a,
+                                            name='s4_up2')(feats[3], train)
+                    x3 = x3 + UpsampleBlock(chans[2], 2, a,
+                                            name='s4_up1')(feats[3], train)
+                    x4 = x4 + feats[3]
         res = [x1, x2]
         if x3 is not None:
             res.append(x3)

@@ -28,7 +28,9 @@ from .backbone import Mobilenetv2, ResNet, RESNET_LAYERS
 SMP_DECODERS = ('deeplabv3', 'deeplabv3p', 'fpn', 'linknet', 'manet', 'pan',
                 'pspnet', 'unet', 'unetpp')
 
-# encoder name -> per-level channels at strides (2, 4, 8, 16, 32)
+# encoder name -> per-level channels at strides (2, 4, 8, 16, 32);
+# MixTransformer has no stride-2 level (channel 0 -> the level is None,
+# mirroring smp's 0-channel dummy feature for mit encoders)
 ENCODER_CHANNELS = {
     'resnet18': (64, 64, 128, 256, 512),
     'resnet34': (64, 64, 128, 256, 512),
@@ -36,7 +38,17 @@ ENCODER_CHANNELS = {
     'resnet101': (64, 256, 512, 1024, 2048),
     'resnet152': (64, 256, 512, 1024, 2048),
     'mobilenet_v2': (16, 24, 32, 96, 320),
+    'mit_b0': (0, 32, 64, 160, 256),
+    'mit_b1': (0, 64, 128, 320, 512),
+    'mit_b2': (0, 64, 128, 320, 512),
+    'mit_b3': (0, 64, 128, 320, 512),
+    'mit_b4': (0, 64, 128, 320, 512),
+    'mit_b5': (0, 64, 128, 320, 512),
 }
+
+# decoders that need encoder levels/dilation modes a MixTransformer cannot
+# provide — same rejection surface as reference models/__init__.py:76-77
+MIT_UNSUPPORTED_DECODERS = ('deeplabv3', 'deeplabv3p', 'linknet', 'unetpp')
 
 
 class Encoder(nn.Module):
@@ -48,11 +60,22 @@ class Encoder(nn.Module):
     @nn.compact
     def __call__(self, x, train=False):
         name = self.encoder_name
-        if name == 'mobilenet_v2':
+        if name.startswith('mit_'):
+            # MixTransformer: strides (4, 8, 16, 32); no stride-2 level
+            # (smp's mit encoders emit a 0-channel dummy there) and no
+            # dilated mode (reference models/__init__.py:76-77 rejects the
+            # combos that would need one)
             if tuple(self.dilations) != (1, 1, 1, 1):
-                raise NotImplementedError(
-                    'Dilated MobileNetV2 encoder is not supported.')
-            # rebuild with an extra tap at stride 2 (after block1, 16ch)
+                raise ValueError(
+                    f'Encoder `{name}` does not support dilated mode.')
+            from .mit import MixTransformer
+            feats = MixTransformer(name, name='mit')(x, train)
+            return (None,) + tuple(feats)
+        if name == 'mobilenet_v2':
+            # extra tap at stride 2 (after block1, 16ch); dilations relax
+            # the stride-16/32 groups for os16/os8 operation exactly like
+            # smp's make_dilated (stride-2 entry block -> stride 1, all
+            # spatial convs in the group get the dilation)
             from .backbone import MBInvertedResidual, _MBV2_SETTING
             x = Conv(32, 3, 2, name='stem')(x)
             x = BatchNorm(name='stem_bn')(x, train)
@@ -60,10 +83,18 @@ class Encoder(nn.Module):
             feats = []
             idx = 0
             taps = {1, 3, 6, 13, 17}
+            # block index -> encoder level of Encoder.dilations (resnet
+            # layer1..4 equivalents): 2-3 @s4, 4-6 @s8, 7-13 @s16, 14-17 @s32
+            def level(i):
+                return 0 if i <= 3 else 1 if i <= 6 else 2 if i <= 13 else 3
             for t, c, n, s in _MBV2_SETTING:
                 for j in range(n):
                     idx += 1
-                    x = MBInvertedResidual(c, s if j == 0 else 1, t,
+                    dil = self.dilations[level(idx)] if idx > 1 else 1
+                    stride = s if j == 0 else 1
+                    if dil > 1:
+                        stride = 1
+                    x = MBInvertedResidual(c, stride, t, dilation=dil,
                                            name=f'block{idx}')(x, train)
                     if idx in taps:
                         feats.append(x)
@@ -340,13 +371,15 @@ class GenericSegModel(nn.Module):
     def __call__(self, x, train: bool = False):
         dec = self.decoder_name
         size = x.shape[1:3]
-        if dec == 'deeplabv3' and self.encoder_name != 'mobilenet_v2':
+        if dec == 'deeplabv3' and not self.encoder_name.startswith('mit_'):
             enc_dil = (1, 1, 2, 4)        # output stride 8
         elif dec in ('deeplabv3p', 'pan') \
-                and self.encoder_name != 'mobilenet_v2':
+                and not self.encoder_name.startswith('mit_'):
             enc_dil = (1, 1, 1, 2)        # output stride 16
         else:
-            # mobilenet_v2 runs at its native stride 32 for all decoders
+            # mit encoders cannot dilate: PAN runs at os32 for them
+            # (reference models/__init__.py:71-75), the dilated decoders
+            # reject them in build_smp_model
             enc_dil = (1, 1, 1, 1)
         feats = Encoder(self.encoder_name, enc_dil, name='encoder')(x, train)
 
@@ -392,5 +425,9 @@ def build_smp_model(encoder, decoder, num_class, encoder_weights=None):
         raise ValueError(f'Unsupported decoder type: {decoder}')
     if encoder not in ENCODER_CHANNELS:
         raise ValueError(f'Unsupported encoder type: {encoder}')
+    if encoder.startswith('mit_') and decoder in MIT_UNSUPPORTED_DECODERS:
+        # reference models/__init__.py:76-77
+        raise ValueError(
+            f'Encoder `{encoder}` is not supported for `{decoder}')
     return GenericSegModel(encoder_name=encoder, decoder_name=decoder,
                            num_class=num_class)

@@ -65,33 +65,54 @@ def avg_pool(x: jnp.ndarray, window: Size2, stride: Optional[Size2] = None,
 # -------------------------------------------------------- argmax pool / unpool
 
 def max_pool_argmax_2x2(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """2x2/stride-2 max pool returning (values, within-window argmax in [0,4)).
+    """2x2/stride-2 max pool returning (values, within-window argmax in [0,4) as int8).
 
     The ENet/SegNet encoders only ever pool 2x2 stride 2, so the general
     return_indices contract collapses to this static-shape special case.
     Odd trailing rows/cols are truncated (torch floor-mode behavior).
+
+    Implemented on strided slices + comparisons, NOT a window-materializing
+    (n,h2,w2,4,c) transpose: the transposed copy was the largest HLO temp in
+    segnet's bs64 program and pushed it past HBM during compile (OOM repro:
+    64x512x1024x64 5-stage chain, 16.00G/15.75G). Slices are views XLA fuses
+    into the max/select lattice, so no window copy is ever materialized.
+    Tie-breaking matches torch (first max in row-major window order).
     """
-    n, h, w, c = x.shape
-    h2, w2 = h // 2, w // 2
-    xw = x[:, :h2 * 2, :w2 * 2, :].reshape(n, h2, 2, w2, 2, c)
-    xw = xw.transpose(0, 1, 3, 2, 4, 5).reshape(n, h2, w2, 4, c)
-    idx = jnp.argmax(xw, axis=3).astype(jnp.int32)          # (n,h2,w2,c)
-    vals = jnp.max(xw, axis=3)
+    h2, w2 = x.shape[1] // 2, x.shape[2] // 2
+    x = x[:, :h2 * 2, :w2 * 2, :]
+    a = x[:, 0::2, 0::2, :]
+    b = x[:, 0::2, 1::2, :]
+    c = x[:, 1::2, 0::2, :]
+    d = x[:, 1::2, 1::2, :]
+    vals = jnp.maximum(jnp.maximum(a, b), jnp.maximum(c, d))
+    # int8 indices: values live in [0,4) and the five encoder stages of
+    # segnet/enet keep every stage's index map alive until its unpool --
+    # int32 maps alone were ~2.7 GiB at bs64 full-res
+    idx = jnp.where(
+        a >= vals, jnp.int8(0),
+        jnp.where(b >= vals, jnp.int8(1),
+                  jnp.where(c >= vals, jnp.int8(2), jnp.int8(3))))
     return vals, idx
 
 
 def max_unpool_2x2(x: jnp.ndarray, idx: jnp.ndarray,
                    out_hw: Optional[Tuple[int, int]] = None) -> jnp.ndarray:
-    """Inverse of max_pool_argmax_2x2: scatter each value to its argmax slot.
+    """Inverse of max_pool_argmax_2x2: place each value in its argmax slot.
 
-    Implemented as one-hot * value (dense, static) instead of scatter — far
-    friendlier to XLA/TPU than gather/scatter with dynamic indices.
+    Dense select + adjacent-dim reshapes (no scatter, no transpose, no
+    (n,h2,w2,c,4) one-hot temp — see max_pool_argmax_2x2's footprint note):
+    four masked planes are interleaved into the 2x upsampled grid purely by
+    stacking along new trailing-adjacent axes, which XLA lowers to cheap
+    concatenates it can fuse the selects into.
     """
     n, h2, w2, c = x.shape
-    onehot = jax.nn.one_hot(idx, 4, dtype=x.dtype)          # (n,h2,w2,c,4)
-    win = onehot * x[..., None]                             # value in argmax slot
-    win = win.transpose(0, 1, 2, 4, 3).reshape(n, h2, w2, 2, 2, c)
-    out = win.transpose(0, 1, 3, 2, 4, 5).reshape(n, h2 * 2, w2 * 2, c)
+    zero = jnp.zeros((), x.dtype)
+    planes = [jnp.where(idx == k, x, zero) for k in range(4)]
+    # width interleave: (n,h2,w2,2,c) -> (n,h2,2*w2,c) merges adjacent dims
+    top = jnp.stack(planes[0:2], axis=3).reshape(n, h2, 2 * w2, c)
+    bot = jnp.stack(planes[2:4], axis=3).reshape(n, h2, 2 * w2, c)
+    # height interleave: (n,h2,2,2w2,c) -> (n,2h2,2w2,c)
+    out = jnp.stack([top, bot], axis=2).reshape(n, 2 * h2, 2 * w2, c)
     if out_hw is not None and out_hw != (h2 * 2, w2 * 2):
         oh, ow = out_hw
         out = jnp.pad(out, ((0, 0), (0, oh - h2 * 2), (0, ow - w2 * 2), (0, 0)))

@@ -30,7 +30,8 @@ import numpy as np
 from ..config import SegConfig
 from ..data import get_loader, get_test_loader
 from ..models import get_model, get_teacher_model
-from ..parallel import (batch_sharding, init_multihost, main_rank, make_mesh)
+from ..parallel import (batch_sharding, init_multihost, main_rank,
+                        make_global_array, make_mesh)
 from ..utils import (TBWriter, get_colormap, get_logger, iou_from_cm,
                      log_config, mkdir, save_config, set_seed)
 from .checkpoint import (load_meta, restore_train_ckpt, restore_weights,
@@ -57,7 +58,7 @@ class SegTrainer:
         self.model = get_model(config)
         self.best_score = 0.0
         self.cur_epoch = 0
-        self.epoch_losses = []             # last-step loss per trained epoch
+        self.epoch_losses = []             # mean loss per trained epoch
 
         if config.is_testing:
             self.test_set = get_test_loader(config)
@@ -158,8 +159,12 @@ class SegTrainer:
 
     # ------------------------------------------------------------------- run
     def _put(self, images: np.ndarray, masks: np.ndarray):
-        imgs = jax.device_put(images, self._batch_sharding)
-        msks = jax.device_put(masks.astype(np.int32), self._batch_sharding)
+        # process-local numpy -> global sharded array; correct under real
+        # multi-process jax.distributed runs, identical to a sharded
+        # device_put when single-process (see parallel.make_global_array)
+        imgs = make_global_array(images, self._batch_sharding)
+        msks = make_global_array(masks.astype(np.int32),
+                                 self._batch_sharding)
         return imgs, msks
 
     def run(self) -> float:
@@ -190,6 +195,12 @@ class SegTrainer:
         cfg = self.config
         self.train_loader.set_epoch(self.cur_epoch)
         metrics = None
+        # on-device running loss sum: lazy adds on the async dispatch queue,
+        # read back exactly once at epoch end -> the epoch summary is a true
+        # mean (reference live-tqdm role, core/seg_trainer.py:115-119)
+        # without any per-step host sync
+        loss_sum, n_steps = None, 0
+        nb = len(self.train_loader)
         profiling = (cfg.profile_dir is not None and self.cur_epoch == 0
                      and self.main_rank)
         for i, (images, masks) in enumerate(self.train_loader):
@@ -197,14 +208,23 @@ class SegTrainer:
                 jax.profiler.start_trace(cfg.profile_dir)
             imgs, msks = self._put(images, masks)
             self.state, metrics = self.train_step(self.state, imgs, msks)
+            loss_sum = metrics['loss'] if loss_sum is None \
+                else loss_sum + metrics['loss']
+            n_steps += 1
             if profiling and i == cfg.profile_steps:
                 jax.block_until_ready(self.state.params)
                 jax.profiler.stop_trace()
                 profiling = False
                 self.logger.info(f'Profiler trace in {cfg.profile_dir}')
+            if (cfg.log_interval > 0 and self.main_rank
+                    and (i + 1) % cfg.log_interval == 0):
+                self.logger.info(
+                    f'Epoch:{self.cur_epoch + 1}/{cfg.total_epoch} | '
+                    f'Iter:{i + 1}/{nb} | Loss:{float(metrics["loss"]):.4g}')
             if self.main_rank and cfg.use_tb:
-                # the only per-step host<->device sync; skipped entirely
-                # when TB is off so steps dispatch asynchronously
+                # the only unconditional per-step host<->device sync;
+                # skipped entirely when TB is off so steps dispatch
+                # asynchronously
                 step = int(self.state.step)
                 self.writer.add_scalar('train/loss', metrics['loss'], step)
                 if 'loss_detail' in metrics:
@@ -221,7 +241,7 @@ class SegTrainer:
             raise RuntimeError(
                 'Training loader yielded no batches; the dataset is smaller '
                 'than the global batch size.')
-        self.epoch_losses.append(float(metrics['loss']))
+        self.epoch_losses.append(float(loss_sum) / n_steps)
         if self.main_rank:
             self.logger.info(
                 f'Epoch:{self.cur_epoch + 1}/{cfg.total_epoch} | '
@@ -239,7 +259,20 @@ class SegTrainer:
         # eval_step psums the matrix over the whole mesh, so each cell is
         # bounded by the GLOBAL pixel count, not this process's share
         procs = jax.process_count()
+        checked_bound = False
         for images, masks in self.val_loader:
+            if not checked_bound:
+                # the cross-batch accumulator is flushed below before int32
+                # could overflow, but a single global batch beyond 2^31 px
+                # would overflow inside confusion_matrix's int32 psum itself
+                # (documented bound, utils/metrics.py) — fail loudly here
+                # instead of silently corrupting counts
+                if masks.size * procs >= np.iinfo(np.int32).max:
+                    raise ValueError(
+                        f'Global val batch has {masks.size * procs} pixels, '
+                        f'>= int32 max: shrink val batch or process count '
+                        f'(per-call bound of the on-device confusion matrix)')
+                checked_bound = True
             if (cm_dev is not None and
                     dev_pixels + masks.size * procs >= np.iinfo(np.int32).max):
                 cm_host += np.asarray(cm_dev, np.int64)

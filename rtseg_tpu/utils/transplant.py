@@ -1,0 +1,591 @@
+"""Full-model torch -> Flax weight transplant.
+
+Purpose (two hats, one mechanism):
+  1. Parity proof: tests transplant a randomly-initialized in-situ reference
+     model's weights onto the Flax twin and assert eval logits match — turning
+     parameter-count parity into numerical behavior parity.
+  2. Migration: users with a reference-trained checkpoint
+     (reference core/base_trainer.py:142-149 `load_ckpt`) can import the .pth
+     into this framework and keep predicting/val-ing with trained weights.
+
+Mechanism: both frameworks are reduced to an ordered list of *leaf units*
+(conv / deconv / bn / dense / prelu) and the lists are zipped.
+
+  * Flax order is exact by construction: an `nn.intercept_methods` interceptor
+    records every parameterized leaf module during `init`, in call order.
+  * Torch order comes in two flavours:
+      - `torch_leaf_order(model, fwd)`: forward hooks fire in call order —
+        exact for any model, needs a live torch module (tests use this with
+        the in-situ reference models).
+      - `sd_leaf_units(state_dict)`: registration order straight from a .pth —
+        no torch model needed, but registration order can differ from call
+        order (e.g. reference bisenetv2.py:136-152 registers `right_branch`
+        before `left_branch` yet calls left first). `SD_REORDER` holds the
+        per-architecture permutation fixups; `tests/test_logit_parity.py`
+        asserts fixed-up registration order == hook call order for every
+        supported model, so the .pth path is proven against the exact one.
+
+Layout conversions (verified numerically in tests/test_torch_import.py and
+tests/test_logit_parity.py):
+  conv    torch (out, in/g, kh, kw)  -> flax (kh, kw, in/g, out)
+  deconv  torch (in, out/g, kh, kw)  -> flax (kh, kw, out/g, in)
+          (flax ConvTranspose(transpose_kernel=True), as in nn/modules.py)
+  dense   torch (out, in)            -> flax (in, out)
+  bn      weight/bias -> scale/bias (params); running_mean/var -> batch_stats
+  prelu   weight -> alpha
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    'FlaxUnit', 'TorchUnit', 'flax_leaf_order', 'torch_leaf_order',
+    'sd_leaf_units', 'apply_units', 'transplant_from_module',
+    'import_reference_state_dict', 'load_reference_pth',
+]
+
+
+@dataclass
+class FlaxUnit:
+    path: Tuple[str, ...]     # scope path into variables['params']
+    kind: str                 # conv | deconv | bn | dense | prelu
+
+
+@dataclass
+class TorchUnit:
+    name: str                 # torch module path ('' for root-level)
+    kind: str                 # conv | deconv | bn | dense | prelu | conv4d
+    arrays: Dict[str, np.ndarray] = field(default_factory=dict)
+
+    def describe(self) -> str:
+        shapes = {k: tuple(v.shape) for k, v in self.arrays.items()}
+        return f'{self.name} [{self.kind}] {shapes}'
+
+
+# --------------------------------------------------------------- flax ordering
+
+def flax_leaf_order(model, *init_args, rngs=None, **init_kwargs):
+    """Init `model` and return (variables, [FlaxUnit]) in call order."""
+    import jax
+    from flax import linen as nn
+    from ..nn.modules import PReLU
+
+    kinds = []
+
+    def interceptor(next_fun, args, kwargs, context):
+        m = context.module
+        if context.method_name == '__call__':
+            kind = None
+            if isinstance(m, nn.Conv):
+                kind = 'conv'
+            elif isinstance(m, nn.ConvTranspose):
+                kind = 'deconv'
+            elif isinstance(m, nn.BatchNorm):
+                kind = 'bn'
+            elif isinstance(m, nn.LayerNorm):
+                kind = 'layernorm'
+            elif isinstance(m, nn.Dense):
+                kind = 'dense'
+            elif isinstance(m, PReLU):
+                kind = 'prelu'
+            if kind is not None:
+                unit = FlaxUnit(tuple(m.path), kind)
+                if unit.path not in {u.path for u in kinds}:
+                    kinds.append(unit)
+        return next_fun(*args, **kwargs)
+
+    if rngs is None:
+        rngs = {'params': jax.random.PRNGKey(0),
+                'dropout': jax.random.PRNGKey(1)}
+    with nn.intercept_methods(interceptor):
+        variables = model.init(rngs, *init_args, **init_kwargs)
+    return variables, kinds
+
+
+# -------------------------------------------------------------- torch ordering
+
+_TORCH_KINDS = None
+
+
+def _torch_kind(mod) -> Optional[str]:
+    import torch.nn as tnn
+    global _TORCH_KINDS
+    if _TORCH_KINDS is None:
+        _TORCH_KINDS = [
+            (tnn.ConvTranspose2d, 'deconv'),   # before Conv2d: both _ConvNd
+            (tnn.Conv2d, 'conv'),
+            (tnn.modules.batchnorm._BatchNorm, 'bn'),
+            (tnn.LayerNorm, 'layernorm'),
+            (tnn.Linear, 'dense'),
+            (tnn.PReLU, 'prelu'),
+        ]
+    for cls, kind in _TORCH_KINDS:
+        if isinstance(mod, cls):
+            return kind
+    return None
+
+
+def _torch_unit(name: str, mod) -> TorchUnit:
+    kind = _torch_kind(mod)
+    if kind is None:
+        own = {n for n, _ in mod.named_parameters(recurse=False)}
+        own |= {n for n, _ in mod.named_buffers(recurse=False)}
+        own.discard('num_batches_tracked')
+        if own:
+            raise NotImplementedError(
+                f'Unsupported parameterized torch leaf {name}: '
+                f'{type(mod).__name__} with {sorted(own)}')
+        return None
+    arrays = {n: p.detach().cpu().numpy()
+              for n, p in mod.named_parameters(recurse=False)}
+    arrays.update({n: b.detach().cpu().numpy()
+                   for n, b in mod.named_buffers(recurse=False)
+                   if n != 'num_batches_tracked'})
+    return TorchUnit(name, kind, arrays)
+
+
+def torch_leaf_order(model, forward: Callable) -> List[TorchUnit]:
+    """Run `forward(model)` under no_grad with hooks on every parameterized
+    leaf; returns units in call order (first call wins for reused modules)."""
+    import torch
+    units: List[TorchUnit] = []
+    seen = set()
+    handles = []
+
+    def make_hook(name, mod):
+        def hook(m, inputs, output):
+            if id(m) not in seen:
+                seen.add(id(m))
+                u = _torch_unit(name, m)
+                if u is not None:
+                    units.append(u)
+        return hook
+
+    uncalled = {}
+    for name, mod in model.named_modules():
+        has_own = (any(True for _ in mod.parameters(recurse=False)) or
+                   any(n != 'num_batches_tracked'
+                       for n, _ in mod.named_buffers(recurse=False)))
+        if has_own:
+            uncalled[id(mod)] = name
+            handles.append(mod.register_forward_hook(make_hook(name, mod)))
+    try:
+        with torch.no_grad():
+            forward(model)
+    finally:
+        for h in handles:
+            h.remove()
+    missing = [n for i, n in uncalled.items() if i not in seen]
+    if missing:
+        raise RuntimeError(
+            f'torch leaves never called by forward (dead params?): {missing}')
+    return units
+
+
+def sd_leaf_units(sd: Dict[str, np.ndarray]) -> List[TorchUnit]:
+    """Group a state_dict into leaf units in registration (key) order.
+
+    Conv vs ConvTranspose is not decidable from a 4-D weight alone; such
+    units get kind 'conv4d' and are resolved against the flax side's
+    expectation in `apply_units`.
+    """
+    groups: Dict[str, Dict[str, np.ndarray]] = {}
+    order: List[str] = []
+    for key, val in sd.items():
+        if key.endswith('num_batches_tracked'):
+            continue
+        prefix, leaf = key.rsplit('.', 1) if '.' in key else ('', key)
+        if prefix not in groups:
+            groups[prefix] = {}
+            order.append(prefix)
+        groups[prefix][leaf] = np.asarray(val)
+    units = []
+    for prefix in order:
+        g = groups[prefix]
+        if 'running_mean' in g:
+            kind = 'bn'
+        elif 'weight' in g and g['weight'].ndim == 4:
+            kind = 'conv4d'
+        elif 'weight' in g and g['weight'].ndim == 2:
+            kind = 'dense'
+        elif 'weight' in g and g['weight'].ndim == 1 and 'bias' in g:
+            kind = 'layernorm'
+        elif 'weight' in g and g['weight'].ndim == 1 and len(g) == 1:
+            kind = 'prelu'
+        else:
+            raise NotImplementedError(
+                f'Cannot classify state_dict group {prefix}: '
+                f'{ {k: v.shape for k, v in g.items()} }')
+        units.append(TorchUnit(prefix, kind, g))
+    return units
+
+
+# --------------------------------------------------------------- the transfer
+
+def _tree_get(tree, path):
+    node = tree
+    for k in path:
+        node = node[k]
+    return node
+
+
+def _tree_set(tree, path, leaf, value):
+    node = _tree_get(tree, path)
+    cur = np.asarray(node[leaf])
+    if tuple(cur.shape) != tuple(value.shape):
+        raise ValueError(f'{"/".join(path)}/{leaf}: flax {cur.shape} != '
+                         f'torch-mapped {value.shape}')
+    node[leaf] = value.astype(cur.dtype)
+
+
+def _context(flax_units, torch_units, i, radius=3) -> str:
+    lines = []
+    for j in range(max(0, i - radius), min(len(flax_units), i + radius + 1)):
+        fu = flax_units[j]
+        tu = torch_units[j].describe() if j < len(torch_units) else '<none>'
+        mark = '>>' if j == i else '  '
+        lines.append(f'{mark} [{j}] flax {"/".join(fu.path)} ({fu.kind})  '
+                     f'<-  torch {tu}')
+    return '\n'.join(lines)
+
+
+def apply_units(variables, flax_units: Sequence[FlaxUnit],
+                torch_units: Sequence[TorchUnit]):
+    """Zip the two unit lists and write torch arrays into a copy of
+    `variables` (params + batch_stats). Raises with aligned context on any
+    count/kind/shape mismatch."""
+    import jax
+    from flax.core import unfreeze
+
+    if len(flax_units) != len(torch_units):
+        dump = '\n'.join(
+            f'[{j}] flax {"/".join(f.path)} ({f.kind})  <-  torch '
+            f'{torch_units[j].describe() if j < len(torch_units) else "<none>"}'
+            for j, f in enumerate(flax_units))
+        extra = '\n'.join(f'[{j}] flax <none>  <-  torch {t.describe()}'
+                          for j, t in enumerate(torch_units)
+                          if j >= len(flax_units))
+        raise ValueError(
+            f'Unit count mismatch: flax {len(flax_units)} vs torch '
+            f'{len(torch_units)}\n{dump}\n{extra}')
+
+    variables = unfreeze(variables)
+    params = jax.tree.map(np.asarray, variables['params'])
+    batch_stats = jax.tree.map(np.asarray, variables.get('batch_stats', {}))
+
+    for i, (fu, tu) in enumerate(zip(flax_units, torch_units)):
+        ok = (fu.kind == tu.kind or
+              (tu.kind == 'conv4d' and fu.kind in ('conv', 'deconv')))
+        if not ok:
+            raise ValueError(f'Kind mismatch at unit {i}:\n'
+                             f'{_context(flax_units, torch_units, i)}')
+        try:
+            a = tu.arrays
+            if fu.kind == 'conv':
+                _tree_set(params, fu.path, 'kernel',
+                          np.transpose(a['weight'], (2, 3, 1, 0)))
+                if 'bias' in a:
+                    _tree_set(params, fu.path, 'bias', a['bias'])
+            elif fu.kind == 'deconv':
+                _tree_set(params, fu.path, 'kernel',
+                          np.transpose(a['weight'], (2, 3, 1, 0)))
+                if 'bias' in a:
+                    _tree_set(params, fu.path, 'bias', a['bias'])
+            elif fu.kind == 'dense':
+                _tree_set(params, fu.path, 'kernel', a['weight'].T)
+                if 'bias' in a:
+                    _tree_set(params, fu.path, 'bias', a['bias'])
+            elif fu.kind == 'bn':
+                _tree_set(params, fu.path, 'scale', a['weight'])
+                _tree_set(params, fu.path, 'bias', a['bias'])
+                _tree_set(batch_stats, fu.path, 'mean', a['running_mean'])
+                _tree_set(batch_stats, fu.path, 'var', a['running_var'])
+            elif fu.kind == 'layernorm':
+                _tree_set(params, fu.path, 'scale', a['weight'])
+                _tree_set(params, fu.path, 'bias', a['bias'])
+            elif fu.kind == 'prelu':
+                _tree_set(params, fu.path, 'alpha', a['weight'])
+            else:
+                raise AssertionError(fu.kind)
+        except (ValueError, KeyError) as e:
+            raise ValueError(
+                f'Transplant failed at unit {i}: {e}\n'
+                f'{_context(flax_units, torch_units, i)}') from e
+
+    variables['params'] = params
+    if batch_stats:
+        variables['batch_stats'] = batch_stats
+    return variables
+
+
+def transplant_from_module(torch_model, flax_model, x_nhwc,
+                           torch_forward: Optional[Callable] = None,
+                           flax_init_kwargs: Optional[dict] = None):
+    """Exact transplant via torch forward hooks (call-order alignment).
+
+    `x_nhwc`: example input for flax init; the torch forward runs on its
+    NCHW transpose unless `torch_forward` is given.
+    Returns (variables_with_torch_weights, flax_units, torch_units).
+    """
+    import torch
+
+    if torch_forward is None:
+        def torch_forward(m):
+            xt = torch.from_numpy(
+                np.transpose(np.asarray(x_nhwc), (0, 3, 1, 2)).copy())
+            m(xt)
+    variables, flax_units = flax_leaf_order(
+        flax_model, x_nhwc, True, **(flax_init_kwargs or {}))
+    torch_units = torch_leaf_order(torch_model, torch_forward)
+    return (apply_units(variables, flax_units, torch_units),
+            flax_units, torch_units)
+
+
+# ----------------------------------------------------- .pth migration surface
+
+def _is_under(name: str, prefix: str) -> bool:
+    return name == prefix or name.startswith(prefix + '.')
+
+
+def swap_sibling_runs(units: List[TorchUnit], first: str,
+                      second: str) -> List[TorchUnit]:
+    """Registration put `<parent>.second` units before `<parent>.first`, but
+    call order is first-then-second: swap every such pair of contiguous runs
+    (e.g. reference bisenetv2.py:136-152 GatherExpansionLayer registers
+    right_branch before left_branch yet calls left first)."""
+    out = list(units)
+    i = 0
+    while i < len(out):
+        name = out[i].name
+        pos = name.find(f'.{second}.')
+        if pos < 0:
+            i += 1
+            continue
+        parent = name[:pos]
+        sec, fst = f'{parent}.{second}', f'{parent}.{first}'
+        j = i
+        while j < len(out) and _is_under(out[j].name, sec):
+            j += 1
+        k = j
+        while k < len(out) and _is_under(out[k].name, fst):
+            k += 1
+        if j > i and k > j:
+            out[i:k] = out[j:k] + out[i:j]
+        i = k if k > i else i + 1
+    return out
+
+
+def order_children(units: List[TorchUnit], parent: str,
+                   children: Sequence[str]) -> List[TorchUnit]:
+    """Reorder the units under `parent` ('' = the whole model) so its direct
+    children appear in the given call order (each child's internal order
+    preserved). Children absent from the list keep their relative position
+    after the listed ones."""
+    def under_parent(name):
+        return True if parent == '' else _is_under(name, parent)
+
+    def child_prefix(c):
+        return c if parent == '' else f'{parent}.{c}'
+
+    idxs = [i for i, u in enumerate(units) if under_parent(u.name)]
+    if not idxs:
+        return list(units)
+    lo, hi = idxs[0], idxs[-1] + 1
+    block = units[lo:hi]
+    assert all(under_parent(u.name) for u in block), \
+        f'units under {parent!r} are not contiguous'
+
+    def rank(u):
+        for ci, c in enumerate(children):
+            if _is_under(u.name, child_prefix(c)):
+                return ci
+        return len(children)
+
+    block = sorted(block, key=rank)          # stable sort
+    return units[:lo] + block + units[hi:]
+
+
+def order_siblings(units: List[TorchUnit],
+                   children: Sequence[str]) -> List[TorchUnit]:
+    """Wherever a contiguous run of units belongs to one parent and each
+    unit's child-component is in `children`, stable-sort the run into the
+    `children` order. Applies at every depth (e.g. every enet Bottleneck's
+    [left_conv, right_init_conv, right_last_conv] run becomes
+    right-then-left, matching the forward call order)."""
+    def split(u):
+        parts = u.name.split('.')
+        for d, comp in enumerate(parts):
+            if comp in children:
+                return '.'.join(parts[:d]), comp
+        return None, None
+
+    out = list(units)
+    i = 0
+    while i < len(out):
+        parent, comp = split(out[i])
+        if comp is None:
+            i += 1
+            continue
+        j = i
+        while j < len(out):
+            p2, c2 = split(out[j])
+            if p2 != parent or c2 is None:
+                break
+            j += 1
+        out[i:j] = sorted(out[i:j],
+                          key=lambda u: children.index(split(u)[1]))
+        i = j
+    return out
+
+
+def _fix_bisenetv2(units):
+    units = order_children(units, 'semantic_branch', [
+        'stage1to2', 'seg_head2', 'stage3', 'seg_head3', 'stage4',
+        'seg_head4', 'stage5_1to4', 'seg_head5', 'stage5_5'])
+    return swap_sibling_runs(units, 'left_branch', 'right_branch')
+
+
+def _fix_ddrnet(units):
+    # aux head runs between conv4 and conv5 (reference ddrnet.py:40-53);
+    # Stage5 runs DAPPM on the low path before the final high blocks
+    # (ddrnet.py:152-163)
+    units = order_children(units, '', [
+        'conv1', 'conv2', 'conv3', 'conv4', 'aux_head', 'conv5', 'seg_head'])
+    return order_children(units, 'conv5', [
+        'low_conv1', 'high_conv1', 'bilateral_fusion', 'low_conv2', 'dappm',
+        'high_conv2'])
+
+
+def _fix_stdc(units):
+    # aux heads interleave with stages; arm/conv pairs run deep-to-shallow;
+    # detail_head after seg_head (reference stdc.py:59-101). detail_conv is
+    # never called by forward (trainer-invoked, seg_trainer.py:74) — the
+    # Flax twin materializes it first during init, so it sorts first here.
+    return order_children(units, '', [
+        'detail_conv', 'stage1', 'stage2', 'stage3', 'aux_head3', 'stage4',
+        'aux_head4', 'stage5', 'aux_head5', 'arm5', 'conv5', 'arm4', 'conv4',
+        'ffm', 'seg_head', 'detail_head'])
+
+
+def _fix_enet(units):
+    # Bottleneck runs its right branch before the left shortcut
+    # (reference enet.py:165-180)
+    return order_siblings(units, ['right_init_conv', 'right_last_conv',
+                                  'left_conv'])
+
+
+def _fix_espnet(units):
+    # DilatedConv reduces with conv_k1 before conv_kn (espnet.py:209-210)
+    return order_siblings(units, ['conv_k1', 'conv_kn'])
+
+
+def _fix_aglnet(units):
+    # GAUM: spatial attention on the low path runs before the up-conv
+    # (aglnet.py:141-143)
+    return order_siblings(units, ['sab', 'up_conv', 'cab'])
+
+
+def _fix_lednet(units):
+    # AttentionPyramidNetwork walks the left ladder top-down then back up
+    # (lednet.py:109-135)
+    return order_siblings(units, [
+        'left_conv1_1', 'left_conv2_1', 'left_conv3', 'left_conv2_2',
+        'left_conv1_2', 'mid_branch', 'right_branch'])
+
+
+def _fix_mininetv2(units):
+    # the refinement branch runs first (mininetv2.py:35-48); the dilated
+    # depth-wise branch runs before the point-wise merge (mininetv2.py:77-82)
+    units = order_children(units, '', [
+        'ref', 'd1_2', 'm1_10', 'd3', 'feature_extractor', 'up1', 'm26_29',
+        'output'])
+    return order_siblings(units, ['dw_conv', 'ddw_conv', 'pw_conv'])
+
+
+def _fix_bisenetv1(units):
+    # ContextPath refines the deepest (1/32) feature before 1/16
+    # (bisenetv1.py:60-71)
+    return order_children(units, 'context_path', [
+        'backbone', 'arm_32', 'conv_32', 'arm_16', 'conv_16'])
+
+
+def _fix_icnet(units):
+    # the shared backbone runs first (low-res branch), then PPM, then the
+    # high-res bottom branch (icnet.py:33-57); the CFF aux classifier runs
+    # before the fusion convs (icnet.py:78-84)
+    units = order_children(units, '', [
+        'backbone', 'ppm', 'bottom_branch', 'cff42', 'cff21', 'seg_head'])
+    return order_siblings(units, ['classifier', 'conv1', 'conv2'])
+
+
+def _fix_canet(units):
+    # FeatureCrossAttention applies spatial/channel attention before the
+    # init conv (canet.py:75-80)
+    return order_siblings(units, ['sa', 'ca', 'conv_init'])
+
+
+def _fix_fssnet(units):
+    # DownsamplingBlock runs its pool branch before the conv branch
+    # (fssnet.py:116-121)
+    return order_siblings(units, ['pool', 'conv'])
+
+
+def _fix_lite_hrnet(units):
+    # FusionBlock ModuleLists register stream-by-stream but the forward
+    # walks output-by-output across streams (lite_hrnet.py:245-265)
+    order = ['stream2.0', 'stream1.1', 'stream1.2', 'stream2.2',
+             'stream3.0', 'stream3.1', 'stream1.3', 'stream2.3', 'stream3.3',
+             'stream4.0', 'stream4.1', 'stream4.2']
+    parents = {u.name[:u.name.index('.stream')]
+               for u in units if '.stream' in u.name}
+    for p in sorted(parents):
+        units = order_children(units, p, order)
+    return units
+
+
+# Architectures whose torch registration order differs from call order need a
+# permutation before zipping. Each entry maps model name -> fn(units)->units.
+# Correctness of every entry (and of every identity default) is pinned by
+# tests/test_logit_parity.py (state_dict order must equal hook call order).
+SD_REORDER: Dict[str, Callable[[List[TorchUnit]], List[TorchUnit]]] = {
+    'bisenetv2': _fix_bisenetv2,
+    'ddrnet': _fix_ddrnet,
+    'stdc': _fix_stdc,
+    'enet': _fix_enet,
+    'espnet': _fix_espnet,
+    'aglnet': _fix_aglnet,
+    'lednet': _fix_lednet,
+    'mininetv2': _fix_mininetv2,
+    'fssnet': _fix_fssnet,
+    'lite_hrnet': _fix_lite_hrnet,
+    'bisenetv1': _fix_bisenetv1,
+    'icnet': _fix_icnet,
+    'canet': _fix_canet,
+}
+
+
+def import_reference_state_dict(sd, model_name: str, flax_model, x_nhwc,
+                                flax_init_kwargs: Optional[dict] = None):
+    """Map a reference-framework state_dict (registration order + per-arch
+    reorder fixups) onto the Flax model. Returns variables."""
+    variables, flax_units = flax_leaf_order(
+        flax_model, x_nhwc, True, **(flax_init_kwargs or {}))
+    units = sd_leaf_units(sd)
+    fix = SD_REORDER.get(model_name)
+    if fix is not None:
+        units = fix(units)
+    return apply_units(variables, flax_units, units)
+
+
+def load_reference_pth(path: str, model_name: str, flax_model, x_nhwc,
+                       flax_init_kwargs: Optional[dict] = None):
+    """Load a reference-trained .pth (reference core/base_trainer.py:142-149
+    stores {'state_dict': ...}) and import it. The .pth migration entry point."""
+    from .torch_import import load_torch_state_dict
+    sd = load_torch_state_dict(path)
+    return import_reference_state_dict(sd, model_name, flax_model, x_nhwc,
+                                       flax_init_kwargs)

@@ -24,13 +24,16 @@ def _load(name, path):
 
 def load_ref_model_module(model_file: str):
     """Import /root/reference/models/<model_file>.py with its intra-package
-    deps stubbed in sys.modules."""
+    deps stubbed in sys.modules. The torchvision stub (tests/tv_stub.py)
+    is installed first so backbone-based reference models construct."""
+    import tv_stub
+    tv_stub.install()
     if 'models' not in sys.modules:
         pkg = type(sys)('models')
         pkg.__path__ = [REF]
         sys.modules['models'] = pkg
     # modules that reference model files import from
-    for dep in ('modules', 'enet', 'lednet', 'bisenetv1'):
+    for dep in ('modules', 'backbone', 'enet', 'lednet', 'bisenetv1'):
         if f'models.{dep}' not in sys.modules and dep != model_file:
             try:
                 _load(f'models.{dep}', f'{REF}/{dep}.py')

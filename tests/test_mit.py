@@ -1,0 +1,146 @@
+"""MixTransformer (mit_b*) encoder parity + smp-family surface tests.
+
+Parity oracle: transformers' SegformerModel — the official MiT
+implementation — constructed from config (random init, no download), weights
+transplanted onto the Flax MixTransformer via the call-order machinery, all
+four stage features compared numerically. Covers the reference's mit_b*
+smp-encoder capability (reference models/__init__.py:71-77).
+"""
+
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, str(Path(__file__).parent))
+
+from rtseg_tpu.models.mit import MIT_SETTINGS, MixTransformer  # noqa: E402
+from rtseg_tpu.utils.transplant import (  # noqa: E402
+    apply_units, flax_leaf_order, sd_leaf_units, torch_leaf_order,
+    transplant_from_module)
+
+H, W = 64, 128
+
+
+def hf_segformer(arch):
+    from transformers import SegformerConfig, SegformerModel
+    dims, depths = MIT_SETTINGS[arch]
+    cfg = SegformerConfig(
+        num_channels=3, num_encoder_blocks=4, depths=list(depths),
+        sr_ratios=[8, 4, 2, 1], hidden_sizes=list(dims),
+        patch_sizes=[7, 3, 3, 3], strides=[4, 2, 2, 2],
+        num_attention_heads=[1, 2, 5, 8], mlp_ratios=[4, 4, 4, 4],
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        drop_path_rate=0.1)
+    return SegformerModel(cfg)
+
+
+@pytest.mark.parametrize('arch', sorted(MIT_SETTINGS))
+def test_mit_param_parity(arch):
+    ref = hf_segformer(arch)
+    want = sum(p.numel() for p in ref.parameters())
+    m = MixTransformer(arch)
+    v = jax.eval_shape(lambda k, x: m.init(k, x, False),
+                       jax.random.PRNGKey(0),
+                       jnp.zeros((1, H, W, 3), jnp.float32))
+    n = sum(int(np.prod(p.shape)) for p in jax.tree.leaves(v['params']))
+    assert n == want, f'{arch}: {n} != {want}'
+
+
+def test_mit_b0_logit_parity():
+    import torch
+    ref = hf_segformer('mit_b0')
+    with torch.no_grad():
+        for p in ref.parameters():
+            p.uniform_(-0.2, 0.2, generator=torch.Generator().manual_seed(0))
+    ref.eval()
+    x = np.random.RandomState(3).uniform(-1, 1, (2, H, W, 3)).astype(
+        np.float32)
+    xt = torch.from_numpy(np.transpose(x, (0, 3, 1, 2)).copy())
+
+    m = MixTransformer('mit_b0')
+    variables, _, torch_units = transplant_from_module(
+        ref, m, jnp.asarray(x),
+        torch_forward=lambda mod: mod(xt, output_hidden_states=True))
+
+    with torch.no_grad():
+        out_t = ref(xt, output_hidden_states=True)
+    with jax.default_matmul_precision('highest'):
+        feats = m.apply(variables, jnp.asarray(x), False)
+    assert len(out_t.hidden_states) == 4 and len(feats) == 4
+    for i, (ht, hf) in enumerate(zip(out_t.hidden_states, feats)):
+        np.testing.assert_allclose(
+            np.transpose(np.asarray(hf), (0, 3, 1, 2)), ht.numpy(),
+            atol=2e-4, rtol=1e-3, err_msg=f'mit_b0 stage {i} diverges')
+
+    # (No sd-order check here: HF registers all patch_embeddings before all
+    # blocks, so its registration order differs from call order — but HF
+    # checkpoints are not the reference's .pth migration surface; the
+    # hook-based path above is the parity oracle.)
+    assert len(torch_units) > 0
+
+
+def test_mit_smp_surface():
+    """PAN at os32 for mit encoders; unsupported combos raise the
+    reference's error (models/__init__.py:71-77); supported generic
+    decoders trace."""
+    from rtseg_tpu.models.smp import build_smp_model
+    x = jnp.zeros((1, 64, 64, 3), jnp.float32)
+
+    m = build_smp_model('mit_b0', 'pan', 19)
+    v = jax.eval_shape(lambda k: m.init(k, x, False), jax.random.PRNGKey(0))
+    out = jax.eval_shape(lambda v: m.apply(v, x, False), v)
+    assert out.shape == (1, 64, 64, 19)
+
+    for dec in ('deeplabv3', 'deeplabv3p', 'linknet', 'unetpp'):
+        with pytest.raises(ValueError, match='is not supported'):
+            build_smp_model('mit_b0', dec, 19)
+
+    for dec in ('unet', 'fpn', 'manet', 'pspnet'):
+        m = build_smp_model('mit_b0', dec, 19)
+        v = jax.eval_shape(lambda k: m.init(k, x, False),
+                           jax.random.PRNGKey(0))
+        out = jax.eval_shape(lambda v: m.apply(v, x, False), v)
+        assert out.shape == (1, 64, 64, 19), dec
+
+
+def test_mit_drop_path_trains():
+    """Stochastic depth needs only the dropout rng; batch-stats-free model
+    trains without mutable collections."""
+    m = MixTransformer('mit_b0')
+    x = jnp.zeros((2, 64, 64, 3), jnp.float32)
+    v = m.init({'params': jax.random.PRNGKey(0),
+                'dropout': jax.random.PRNGKey(1)}, x, True)
+    feats = m.apply(v, x, True, rngs={'dropout': jax.random.PRNGKey(2)})
+    assert feats[-1].shape == (2, 2, 2, 256)
+
+
+def test_dilated_mobilenetv2_strides():
+    """smp make_dilated semantics: deeplabv3 runs MobileNetV2 at os8,
+    deeplabv3p/pan at os16 (VERDICT round-1 missing #3)."""
+    from rtseg_tpu.models.smp import Encoder, build_smp_model
+    x = jnp.zeros((1, 64, 64, 3), jnp.float32)
+
+    enc = Encoder('mobilenet_v2', (1, 1, 2, 4))      # os8
+    v = jax.eval_shape(lambda k: enc.init(k, x, False),
+                       jax.random.PRNGKey(0))
+    feats = jax.eval_shape(lambda v: enc.apply(v, x, False), v)
+    assert [f.shape[1] for f in feats] == [32, 16, 8, 8, 8]
+    assert [f.shape[-1] for f in feats] == [16, 24, 32, 96, 320]
+
+    enc16 = Encoder('mobilenet_v2', (1, 1, 1, 2))    # os16
+    v = jax.eval_shape(lambda k: enc16.init(k, x, False),
+                       jax.random.PRNGKey(0))
+    feats = jax.eval_shape(lambda v: enc16.apply(v, x, False), v)
+    assert [f.shape[1] for f in feats] == [32, 16, 8, 4, 4]
+
+    for dec in ('deeplabv3', 'deeplabv3p'):
+        m = build_smp_model('mobilenet_v2', dec, 19)
+        v = jax.eval_shape(lambda k: m.init(k, x, False),
+                           jax.random.PRNGKey(0))
+        out = jax.eval_shape(lambda v: m.apply(v, x, False), v)
+        assert out.shape == (1, 64, 64, 19), dec

@@ -1,0 +1,205 @@
+"""Minimal structural torchvision stub for offline parity tests.
+
+The reference's backbone wrappers (reference models/backbone.py:4-57,
+models/icnet.py:103-141) construct torchvision resnets / mobilenet_v2 at
+model build time; torchvision is absent in this image, which round 1 used as
+the excuse for shape-only tests on 7 models. This stub provides the two
+architectures with torchvision's exact module structure (attribute names,
+registration order, parameter shapes, strides/dilations) — written from the
+published architectures (He et al. arXiv:1512.03385 §4 / torchvision's
+documented v1.5 stride placement; Sandler et al. arXiv:1801.04381 table 2),
+NOT copied code — so the in-situ reference models construct and full weight
+transplant / logit parity runs offline. `pretrained` is accepted and ignored
+(random init; parity tests randomize and transplant anyway).
+
+Call install() before loading reference model files; it is a no-op when a
+real torchvision is importable.
+"""
+
+import sys
+import types
+
+import torch
+import torch.nn as nn
+
+
+# ------------------------------------------------------------------- resnet
+
+class BasicBlock(nn.Module):
+    expansion = 1
+
+    def __init__(self, in_ch, ch, stride=1, downsample=None, dilation=1):
+        super().__init__()
+        self.conv1 = nn.Conv2d(in_ch, ch, 3, stride, dilation,
+                               dilation=dilation, bias=False)
+        self.bn1 = nn.BatchNorm2d(ch)
+        self.relu = nn.ReLU(inplace=True)
+        self.conv2 = nn.Conv2d(ch, ch, 3, 1, dilation, dilation=dilation,
+                               bias=False)
+        self.bn2 = nn.BatchNorm2d(ch)
+        self.downsample = downsample
+
+    def forward(self, x):
+        # main branch first, downsample last — torchvision's call order
+        # (matters for hook-based transplant alignment)
+        y = self.relu(self.bn1(self.conv1(x)))
+        y = self.bn2(self.conv2(y))
+        idn = x if self.downsample is None else self.downsample(x)
+        return self.relu(y + idn)
+
+
+class Bottleneck(nn.Module):
+    expansion = 4
+
+    def __init__(self, in_ch, ch, stride=1, downsample=None, dilation=1):
+        super().__init__()
+        # v1.5 placement: the stride lives on the 3x3 conv
+        self.conv1 = nn.Conv2d(in_ch, ch, 1, bias=False)
+        self.bn1 = nn.BatchNorm2d(ch)
+        self.conv2 = nn.Conv2d(ch, ch, 3, stride, dilation,
+                               dilation=dilation, bias=False)
+        self.bn2 = nn.BatchNorm2d(ch)
+        self.conv3 = nn.Conv2d(ch, ch * 4, 1, bias=False)
+        self.bn3 = nn.BatchNorm2d(ch * 4)
+        self.relu = nn.ReLU(inplace=True)
+        self.downsample = downsample
+
+    def forward(self, x):
+        y = self.relu(self.bn1(self.conv1(x)))
+        y = self.relu(self.bn2(self.conv2(y)))
+        y = self.bn3(self.conv3(y))
+        idn = x if self.downsample is None else self.downsample(x)
+        return self.relu(y + idn)
+
+
+class ResNet(nn.Module):
+    def __init__(self, block, layers):
+        super().__init__()
+        self.inplanes = 64
+        self.conv1 = nn.Conv2d(3, 64, 7, 2, 3, bias=False)
+        self.bn1 = nn.BatchNorm2d(64)
+        self.relu = nn.ReLU(inplace=True)
+        self.maxpool = nn.MaxPool2d(3, 2, 1)
+        self.layer1 = self._make_layer(block, 64, layers[0], 1)
+        self.layer2 = self._make_layer(block, 128, layers[1], 2)
+        self.layer3 = self._make_layer(block, 256, layers[2], 2)
+        self.layer4 = self._make_layer(block, 512, layers[3], 2)
+        self.avgpool = nn.AdaptiveAvgPool2d(1)
+        self.fc = nn.Linear(512 * block.expansion, 1000)
+
+    def _make_layer(self, block, ch, n, stride):
+        downsample = None
+        if stride != 1 or self.inplanes != ch * block.expansion:
+            downsample = nn.Sequential(
+                nn.Conv2d(self.inplanes, ch * block.expansion, 1, stride,
+                          bias=False),
+                nn.BatchNorm2d(ch * block.expansion))
+        blocks = [block(self.inplanes, ch, stride, downsample)]
+        self.inplanes = ch * block.expansion
+        blocks += [block(self.inplanes, ch) for _ in range(1, n)]
+        return nn.Sequential(*blocks)
+
+    def forward(self, x):
+        x = self.maxpool(self.relu(self.bn1(self.conv1(x))))
+        x = self.layer4(self.layer3(self.layer2(self.layer1(x))))
+        x = torch.flatten(self.avgpool(x), 1)
+        return self.fc(x)
+
+
+def _resnet(block, layers):
+    def ctor(pretrained=False, **kwargs):
+        return ResNet(block, layers)
+    return ctor
+
+
+# -------------------------------------------------------------- mobilenet_v2
+
+class _ConvBNReLU(nn.Sequential):
+    def __init__(self, in_ch, out_ch, kernel=3, stride=1, groups=1):
+        pad = (kernel - 1) // 2
+        super().__init__(
+            nn.Conv2d(in_ch, out_ch, kernel, stride, pad, groups=groups,
+                      bias=False),
+            nn.BatchNorm2d(out_ch),
+            nn.ReLU6(inplace=True))
+
+
+class _InvertedResidual(nn.Module):
+    def __init__(self, in_ch, out_ch, stride, expand):
+        super().__init__()
+        hid = int(round(in_ch * expand))
+        self.use_res_connect = stride == 1 and in_ch == out_ch
+        layers = []
+        if expand != 1:
+            layers.append(_ConvBNReLU(in_ch, hid, kernel=1))
+        layers += [
+            _ConvBNReLU(hid, hid, stride=stride, groups=hid),
+            nn.Conv2d(hid, out_ch, 1, bias=False),
+            nn.BatchNorm2d(out_ch),
+        ]
+        self.conv = nn.Sequential(*layers)
+
+    def forward(self, x):
+        y = self.conv(x)
+        return x + y if self.use_res_connect else y
+
+
+class MobileNetV2(nn.Module):
+    # (t, c, n, s) schedule from the paper, table 2
+    SETTING = ((1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+               (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1))
+
+    def __init__(self):
+        super().__init__()
+        feats = [_ConvBNReLU(3, 32, stride=2)]
+        in_ch = 32
+        for t, c, n, s in self.SETTING:
+            for i in range(n):
+                feats.append(_InvertedResidual(in_ch, c, s if i == 0 else 1,
+                                               t))
+                in_ch = c
+        feats.append(_ConvBNReLU(in_ch, 1280, kernel=1))
+        self.features = nn.Sequential(*feats)
+        self.classifier = nn.Sequential(nn.Dropout(0.2),
+                                        nn.Linear(1280, 1000))
+
+    def forward(self, x):
+        x = self.features(x)
+        x = x.mean([2, 3])
+        return self.classifier(x)
+
+
+def mobilenet_v2(pretrained=False, **kwargs):
+    return MobileNetV2()
+
+
+# ------------------------------------------------------------------ install
+
+def install():
+    """Register the stub as `torchvision(.models)` unless the real thing is
+    importable."""
+    try:
+        import torchvision  # noqa: F401
+        return
+    except ImportError:
+        pass
+    if 'torchvision' in sys.modules:
+        return
+    import importlib.machinery
+    tv = types.ModuleType('torchvision')
+    models = types.ModuleType('torchvision.models')
+    # a real ModuleSpec so importlib.util.find_spec('torchvision') (e.g.
+    # transformers' availability probing) doesn't raise on the stub
+    tv.__spec__ = importlib.machinery.ModuleSpec('torchvision', None)
+    tv.__path__ = []
+    models.__spec__ = importlib.machinery.ModuleSpec('torchvision.models',
+                                                     None)
+    models.resnet18 = _resnet(BasicBlock, (2, 2, 2, 2))
+    models.resnet34 = _resnet(BasicBlock, (3, 4, 6, 3))
+    models.resnet50 = _resnet(Bottleneck, (3, 4, 6, 3))
+    models.resnet101 = _resnet(Bottleneck, (3, 4, 23, 3))
+    models.resnet152 = _resnet(Bottleneck, (3, 8, 36, 3))
+    models.mobilenet_v2 = mobilenet_v2
+    tv.models = models
+    sys.modules['torchvision'] = tv
+    sys.modules['torchvision.models'] = models
