@@ -155,6 +155,10 @@ class SegConfig:
     kd_loss_coefficient: float = 1.0
     kd_temperature: float = 4.0
 
+    # synthetic-dataset size (train split; val = max(16, len // 4)) for
+    # convergence runs and benchmarks without disk data
+    synthetic_len: int = 64
+
     # ----- Numerics (TPU-native additions) -----
     compute_dtype: str = 'bfloat16'        # activations/matmul dtype under jit
     param_dtype: str = 'float32'

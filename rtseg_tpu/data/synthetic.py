@@ -19,10 +19,13 @@ _NOISE = 0.08      # additive image noise amplitude
 
 
 class Synthetic:
-    def __init__(self, config, mode: str = 'train', length: int = 64):
+    def __init__(self, config, mode: str = 'train', length: int = None):
         self.h = config.crop_h
         self.w = config.crop_w
         self.num_class = max(config.num_class, 2)
+        if length is None:
+            base = getattr(config, 'synthetic_len', 64)
+            length = base if mode == 'train' else max(16, base // 4)
         self.length = length
         self.mode = mode
         # fixed palette shared by all samples/modes: what the model learns

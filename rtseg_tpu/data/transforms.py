@@ -111,6 +111,10 @@ def color_jitter(image, brightness: float, contrast: float, saturation: float,
     order = rng.permutation(3)
     for i in order:
         img = fns[i](img)
+    # f32 (not the float64 numpy promotes to): downstream fused
+    # normalize/flip kernels take u8/f32, and f64 precision buys nothing
+    # for 8-bit image data
+    img = img.astype(np.float32, copy=False)
     return np.clip(img, 0, 255)
 
 
@@ -143,6 +147,46 @@ def normalize(image, mean=IMAGENET_MEAN, std=IMAGENET_STD):
     out *= scale_
     out += bias_
     return out
+
+
+def _norm_coeffs(identity_norm: bool):
+    if identity_norm:
+        return (np.full(3, 1.0 / 255.0, np.float32),
+                np.zeros(3, np.float32))
+    return (np.float32(1.0) / (255.0 * IMAGENET_STD),
+            (-IMAGENET_MEAN / IMAGENET_STD).astype(np.float32))
+
+
+def flip_norm_pack(image, mask, do_h: bool, do_v: bool,
+                   identity_norm: bool = False):
+    """Augmentation tail: (flips) + normalize + contiguous f32 copy.
+
+    One native fused pass when rtseg_tpu.native is available (flip folded
+    into the scale/bias copy — flips and the elementwise normalize
+    commute); numpy fallback is numerically identical.
+    """
+    from .. import native
+    if do_v:                               # rare path: numpy view + copy
+        image = np.ascontiguousarray(image[::-1])
+        if mask is not None:
+            mask = mask[::-1]
+    scale_, bias_ = _norm_coeffs(identity_norm)
+    out = native.normalize_hwc(image, scale_, bias_, hflip=do_h) \
+        if image.flags.c_contiguous else None
+    if out is None:
+        if do_h:
+            image = image[:, ::-1]
+        out = image.astype(np.float32)
+        out *= scale_
+        out += bias_
+        out = np.ascontiguousarray(out)
+    if mask is None:
+        return out, None
+    if do_h:
+        flipped = native.hflip_mask(mask) if (
+            mask.dtype == np.int32 and mask.flags.c_contiguous) else None
+        mask = flipped if flipped is not None else mask[:, ::-1]
+    return out, np.ascontiguousarray(mask)
 
 
 def resize_to_square(image, mask, size: int):
@@ -178,13 +222,13 @@ class TrainTransform:
         image, mask = pad_if_needed(image, mask, c.crop_h, c.crop_w)
         image, mask = random_crop(image, mask, c.crop_h, c.crop_w, rng)
         image = color_jitter(image, c.brightness, c.contrast, c.saturation, rng)
-        image, mask = horizontal_flip(image, mask, c.h_flip, rng)
-        image, mask = vertical_flip(image, mask, c.v_flip, rng)
-        if self.identity_norm:
-            image = image.astype(np.float32) / 255.0
-        else:
-            image = normalize(image)
-        return np.ascontiguousarray(image), np.ascontiguousarray(mask)
+        # same rng draw order as horizontal_flip/vertical_flip, but the
+        # flips are folded into the fused normalize pass
+        do_h = c.h_flip > 0 and rng.random() < c.h_flip
+        do_v = c.v_flip > 0 and rng.random() < c.v_flip
+        image, mask = flip_norm_pack(image, mask, do_h, do_v,
+                                     self.identity_norm)
+        return image, mask
 
 
 class EvalTransform:
@@ -201,11 +245,8 @@ class EvalTransform:
         if self.square_size:
             image, mask = resize_to_square(image, mask, self.square_size)
         image, mask = scale(image, mask, c.scale)
-        if self.identity_norm:
-            image = image.astype(np.float32) / 255.0
-        else:
-            image = normalize(image)
-        image = np.ascontiguousarray(image)
+        image, mask = flip_norm_pack(image, mask, False, False,
+                                     self.identity_norm)
         if mask is None:
             return image
-        return image, np.ascontiguousarray(mask)
+        return image, mask

@@ -1,0 +1,140 @@
+"""Native (C) host-side input-pipeline kernels, built on first use.
+
+This is the framework's native runtime component for data loading — the
+counterpart of the reference's C++-backed torch DataLoader workers. The
+kernels (normalize.c) fuse the augmentation tail (flip + normalize +
+contiguous copy) into one pass and release the GIL via ctypes, so
+ShardedLoader's thread pool scales across host cores.
+
+Build: one `cc -O3 -shared -fPIC` at import time, cached next to the source
+(`_build/librtseg_native.so`, rebuilt when normalize.c is newer). No
+pip/pybind11 involved. If no compiler is available the module degrades
+gracefully: `available()` returns False and callers keep the numpy path —
+behavior is identical either way (pinned by tests/test_native.py).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+_HERE = Path(__file__).parent
+_SRC = _HERE / 'normalize.c'
+_SO = _HERE / '_build' / 'librtseg_native.so'
+
+_lib = None
+_tried = False
+_lock = threading.Lock()
+
+
+def _build() -> Optional[Path]:
+    """Compile (or reuse) the shared library; never raises — any failure
+    (no compiler, read-only package dir, ...) degrades to the numpy path."""
+    try:
+        if _SO.exists() and _SO.stat().st_mtime >= _SRC.stat().st_mtime:
+            return _SO
+        _SO.parent.mkdir(exist_ok=True)
+        cc = os.environ.get('CC', 'cc')
+        # compile to a temp name + atomic rename: a concurrent process
+        # must never dlopen a half-written ELF
+        tmp = _SO.with_suffix(f'.{os.getpid()}.tmp.so')
+        cmd = [cc, '-O3', '-shared', '-fPIC', '-o', str(tmp), str(_SRC)]
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    return _SO
+
+
+def _load():
+    global _lib, _tried
+    if _tried:
+        return _lib
+    # loader threads hit first-use concurrently (ShardedLoader's pool):
+    # build+dlopen exactly once
+    with _lock:
+        if _tried:
+            return _lib
+        lib = _load_locked()
+        _lib = lib
+        _tried = True
+    return _lib
+
+
+def _load_locked():
+    so = _build()
+    if so is None:
+        return None
+    try:
+        lib = ctypes.CDLL(str(so))
+    except OSError:
+        return None
+    f32p = ctypes.POINTER(ctypes.c_float)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    lib.normalize_u8_hwc.argtypes = [u8p, f32p, ctypes.c_long,
+                                     ctypes.c_long, ctypes.c_long,
+                                     f32p, f32p, ctypes.c_int]
+    lib.normalize_f32_hwc.argtypes = [f32p, f32p, ctypes.c_long,
+                                      ctypes.c_long, ctypes.c_long,
+                                      f32p, f32p, ctypes.c_int]
+    lib.hflip_i32_hw.argtypes = [i32p, i32p, ctypes.c_long, ctypes.c_long]
+    return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _f32p(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def normalize_hwc(image: np.ndarray, scale: np.ndarray, bias: np.ndarray,
+                  hflip: bool = False) -> Optional[np.ndarray]:
+    """Fused (hflip +) per-channel scale/bias + f32 contiguous copy.
+
+    image: (H, W, C) uint8 or float32, C-contiguous. Returns a fresh f32
+    array, or None when the native library is unavailable or the input is
+    not a supported layout (callers fall back to numpy).
+    """
+    lib = _load()
+    if lib is None or image.ndim != 3 or not image.flags.c_contiguous:
+        return None
+    h, w, c = image.shape
+    scale = np.ascontiguousarray(scale, np.float32)
+    bias = np.ascontiguousarray(bias, np.float32)
+    if scale.shape != (c,) or bias.shape != (c,):
+        return None
+    out = np.empty((h, w, c), np.float32)
+    if image.dtype == np.uint8:
+        lib.normalize_u8_hwc(
+            image.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)), _f32p(out),
+            h, w, c, _f32p(scale), _f32p(bias), int(hflip))
+    elif image.dtype == np.float32:
+        lib.normalize_f32_hwc(
+            _f32p(image), _f32p(out),
+            h, w, c, _f32p(scale), _f32p(bias), int(hflip))
+    else:
+        return None
+    return out
+
+
+def hflip_mask(mask: np.ndarray) -> Optional[np.ndarray]:
+    """(H, W) int32 horizontal-flip into a fresh contiguous array."""
+    lib = _load()
+    if lib is None or mask.ndim != 2 or mask.dtype != np.int32 \
+            or not mask.flags.c_contiguous:
+        return None
+    h, w = mask.shape
+    out = np.empty((h, w), np.int32)
+    lib.hflip_i32_hw(
+        mask.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), h, w)
+    return out

@@ -1,0 +1,73 @@
+/* Native host-side input-pipeline kernels.
+ *
+ * The role the reference fills with torch DataLoader's C++ workers +
+ * albumentations' cv2 internals: the per-sample tail of the augmentation
+ * stack — (flip) + normalize + contiguous-copy — fused into ONE pass over
+ * the image instead of three numpy passes (flip view -> ascontiguousarray
+ * copy -> scale/bias in-place). Called through ctypes, which releases the
+ * GIL for the duration, so the loader's thread pool scales across cores.
+ *
+ * Layout: HWC row-major. `scale`/`bias` are per-channel:
+ *   out[y,x,k] = in[y, x|flip, k] * scale[k] + bias[k]
+ * The c==3 case (every dataset here) is specialized so the compiler can
+ * keep the 6 coefficients in registers and vectorize the row loop.
+ */
+
+#include <stdint.h>
+
+#define NORMALIZE_BODY(T)                                                   \
+    if (c == 3) {                                                           \
+        const float s0 = scale[0], s1 = scale[1], s2 = scale[2];            \
+        const float b0 = bias[0], b1 = bias[1], b2 = bias[2];               \
+        for (long y = 0; y < h; ++y) {                                      \
+            const T *row = src + y * w * 3;                                 \
+            float *out = dst + y * w * 3;                                   \
+            if (!hflip) {                                                   \
+                for (long x = 0; x < w; ++x) {                              \
+                    out[3 * x]     = row[3 * x]     * s0 + b0;              \
+                    out[3 * x + 1] = row[3 * x + 1] * s1 + b1;              \
+                    out[3 * x + 2] = row[3 * x + 2] * s2 + b2;              \
+                }                                                           \
+            } else {                                                        \
+                for (long x = 0; x < w; ++x) {                              \
+                    const T *px = row + 3 * (w - 1 - x);                    \
+                    out[3 * x]     = px[0] * s0 + b0;                       \
+                    out[3 * x + 1] = px[1] * s1 + b1;                       \
+                    out[3 * x + 2] = px[2] * s2 + b2;                       \
+                }                                                           \
+            }                                                               \
+        }                                                                   \
+        return;                                                             \
+    }                                                                       \
+    for (long y = 0; y < h; ++y) {                                          \
+        const T *row = src + y * w * c;                                     \
+        float *out = dst + y * w * c;                                       \
+        for (long x = 0; x < w; ++x) {                                      \
+            const T *px = row + (hflip ? (w - 1 - x) : x) * c;              \
+            float *o = out + x * c;                                         \
+            for (long k = 0; k < c; ++k)                                    \
+                o[k] = px[k] * scale[k] + bias[k];                          \
+        }                                                                   \
+    }
+
+void normalize_u8_hwc(const uint8_t *src, float *dst,
+                      long h, long w, long c,
+                      const float *scale, const float *bias, int hflip) {
+    NORMALIZE_BODY(uint8_t)
+}
+
+void normalize_f32_hwc(const float *src, float *dst,
+                       long h, long w, long c,
+                       const float *scale, const float *bias, int hflip) {
+    NORMALIZE_BODY(float)
+}
+
+/* mask (H, W) int32 horizontal-flip copy */
+void hflip_i32_hw(const int32_t *src, int32_t *dst, long h, long w) {
+    for (long y = 0; y < h; ++y) {
+        const int32_t *row = src + y * w;
+        int32_t *out = dst + y * w;
+        for (long x = 0; x < w; ++x)
+            out[x] = row[w - 1 - x];
+    }
+}

@@ -1,0 +1,99 @@
+"""Native input-pipeline kernel tests: build, numerical equality with the
+numpy path, flip fusion, and the transform-tail integration."""
+
+import numpy as np
+import pytest
+
+from rtseg_tpu import native
+from rtseg_tpu.data.transforms import (IMAGENET_MEAN, IMAGENET_STD,
+                                       flip_norm_pack)
+
+
+def numpy_reference(image, scale, bias, hflip):
+    if hflip:
+        image = image[:, ::-1]
+    return (image.astype(np.float32) * scale + bias).astype(np.float32)
+
+
+def test_native_builds():
+    # the baked toolchain has cc; if this fails the fallback still works,
+    # but we want to KNOW the native path is exercised in CI
+    assert native.available()
+
+
+@pytest.mark.parametrize('dtype', [np.uint8, np.float32])
+@pytest.mark.parametrize('hflip', [False, True])
+def test_normalize_hwc_matches_numpy(dtype, hflip):
+    rng = np.random.RandomState(0)
+    if dtype == np.uint8:
+        img = rng.randint(0, 256, (37, 53, 3)).astype(np.uint8)
+    else:
+        img = rng.rand(37, 53, 3).astype(np.float32) * 255.0
+    scale = (1.0 / (255.0 * IMAGENET_STD)).astype(np.float32)
+    bias = (-IMAGENET_MEAN / IMAGENET_STD).astype(np.float32)
+    out = native.normalize_hwc(img, scale, bias, hflip=hflip)
+    assert out is not None and out.dtype == np.float32
+    assert out.flags.c_contiguous
+    np.testing.assert_allclose(out, numpy_reference(img, scale, bias, hflip),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_normalize_rejects_unsupported():
+    scale = np.ones(3, np.float32)
+    bias = np.zeros(3, np.float32)
+    # non-contiguous input -> caller falls back
+    img = np.zeros((8, 8, 3), np.uint8)[:, ::-1]
+    assert native.normalize_hwc(img, scale, bias) is None
+    # wrong dtype
+    assert native.normalize_hwc(np.zeros((8, 8, 3), np.float64),
+                                scale, bias) is None
+
+
+def test_hflip_mask():
+    m = np.arange(12, dtype=np.int32).reshape(3, 4)
+    out = native.hflip_mask(m)
+    assert out is not None
+    np.testing.assert_array_equal(out, m[:, ::-1])
+
+
+@pytest.mark.parametrize('identity', [False, True])
+@pytest.mark.parametrize('do_h,do_v', [(False, False), (True, False),
+                                       (False, True), (True, True)])
+def test_flip_norm_pack_tail(identity, do_h, do_v):
+    """The transform tail must equal the pre-fusion reference semantics:
+    hflip -> vflip -> normalize (elementwise ops commute with flips)."""
+    rng = np.random.RandomState(1)
+    img = rng.randint(0, 256, (16, 24, 3)).astype(np.uint8)
+    mask = rng.randint(0, 19, (16, 24)).astype(np.int32)
+    out, m = flip_norm_pack(img, mask, do_h, do_v, identity)
+
+    ref_img, ref_mask = img, mask
+    if do_h:
+        ref_img, ref_mask = ref_img[:, ::-1], ref_mask[:, ::-1]
+    if do_v:
+        ref_img, ref_mask = ref_img[::-1], ref_mask[::-1]
+    if identity:
+        want = ref_img.astype(np.float32) / 255.0
+    else:
+        want = (ref_img.astype(np.float32) / 255.0 - IMAGENET_MEAN) \
+            / IMAGENET_STD
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-5)
+    np.testing.assert_array_equal(m, ref_mask)
+    assert out.flags.c_contiguous and m.flags.c_contiguous
+
+
+def test_threaded_native_calls():
+    """ctypes releases the GIL: concurrent calls from the loader pool must
+    be race-free (fresh output buffers per call)."""
+    from concurrent.futures import ThreadPoolExecutor
+    rng = np.random.RandomState(2)
+    imgs = [rng.randint(0, 256, (64, 64, 3)).astype(np.uint8)
+            for _ in range(32)]
+    scale = np.full(3, 1 / 255.0, np.float32)
+    bias = np.zeros(3, np.float32)
+    with ThreadPoolExecutor(8) as pool:
+        outs = list(pool.map(
+            lambda im: native.normalize_hwc(im, scale, bias), imgs))
+    for im, out in zip(imgs, outs):
+        np.testing.assert_allclose(out, im.astype(np.float32) / 255.0,
+                                   rtol=1e-6, atol=1e-6)
