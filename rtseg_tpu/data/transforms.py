@@ -171,8 +171,13 @@ def flip_norm_pack(image, mask, do_h: bool, do_v: bool,
         if mask is not None:
             mask = mask[::-1]
     scale_, bias_ = _norm_coeffs(identity_norm)
-    out = native.normalize_hwc(image, scale_, bias_, hflip=do_h) \
-        if image.flags.c_contiguous else None
+    out = None
+    if native.available():
+        # random_crop yields strided views: a u8 contiguous copy is ~1/4
+        # the f32 fallback's traffic, so the fused pass still wins
+        img_n = image if image.flags.c_contiguous \
+            else np.ascontiguousarray(image)
+        out = native.normalize_hwc(img_n, scale_, bias_, hflip=do_h)
     if out is None:
         if do_h:
             image = image[:, ::-1]

@@ -34,7 +34,7 @@ def test_two_process_global_batch_assembly():
     outs = []
     try:
         for p in procs:
-            out, _ = p.communicate(timeout=300)
+            out, _ = p.communicate(timeout=600)
             outs.append(out)
     except subprocess.TimeoutExpired:
         for p in procs:
@@ -44,6 +44,9 @@ def test_two_process_global_batch_assembly():
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f'worker {i} failed:\n{out}'
         assert f'MP_WORKER_OK {i}' in out, f'worker {i} output:\n{out}'
+        # the REAL compiled train step ran cross-process (grad pmean +
+        # sync-BN over both processes) with replicated state identical
+        assert f'MP_TRAIN_OK {i}' in out, f'worker {i} output:\n{out}'
 
 
 def test_make_global_array_single_process_is_sharded_device_put(mesh8):

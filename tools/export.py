@@ -19,6 +19,14 @@ from rtseg_tpu.export import export_model, save_exported
 
 
 def main() -> int:
+    # Export is pure lowering: the serving targets come from --platforms,
+    # not from the process's runtime backend. Pin the host backend to CPU
+    # so exporting works on machines with no (or unreachable) accelerator.
+    import jax
+    try:
+        jax.config.update('jax_platforms', 'cpu')
+    except Exception:
+        pass
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument('--model', type=str, default='bisenetv2')
     ap.add_argument('--encoder', type=str, default=None)
