@@ -139,6 +139,32 @@ def test_fastscnn_logit_parity():
                         'fastscnn')
 
 
+def test_load_reference_pth_end_to_end(tmp_path):
+    """The production migration entry: a reference-trainer-style .pth file
+    ({'state_dict': ...}, reference core/base_trainer.py:155-163) loads
+    onto the Flax model and predicts like the torch original."""
+    import torch
+    from rtseg_tpu.models.fastscnn import FastSCNN
+    from rtseg_tpu.utils.transplant import load_reference_pth
+
+    ref = load_ref_model_module('fastscnn').FastSCNN(num_class=NC)
+    randomize_torch(ref)
+    ref.eval()
+    pth = tmp_path / 'best.pth'
+    torch.save({'state_dict': ref.state_dict(), 'cur_epoch': 3}, pth)
+
+    x = example_input()
+    flax_model = FastSCNN(num_class=NC)
+    variables = load_reference_pth(str(pth), 'fastscnn', flax_model,
+                                   jnp.asarray(x))
+    with torch.no_grad():
+        yt = ref(torch.from_numpy(to_nchw(x).copy()))
+    with jax.default_matmul_precision('highest'):
+        yf = flax_model.apply(variables, jnp.asarray(x), False)
+    np.testing.assert_allclose(to_nchw(yf), np.asarray(yt),
+                               atol=1e-4, rtol=1e-4)
+
+
 @pytest.mark.parametrize('use_aux', [True, False])
 def test_bisenetv2_logit_parity(use_aux):
     ref = load_ref_model_module('bisenetv2')

@@ -1,0 +1,89 @@
+"""Unit tests for the transplant reorder helpers — the registration-order →
+call-order permutation machinery behind the .pth migration path. The
+per-model SD_REORDER entries are pinned end-to-end by test_logit_parity.py;
+these pin the helpers' contracts in isolation."""
+
+import numpy as np
+
+from rtseg_tpu.utils.transplant import (TorchUnit, apply_units, order_children,
+                                        order_siblings, sd_leaf_units,
+                                        swap_sibling_runs)
+
+
+def U(name, kind='conv'):
+    return TorchUnit(name, kind, {})
+
+
+def names(units):
+    return [u.name for u in units]
+
+
+def test_order_children_root():
+    units = [U('d1.0'), U('d1.1'), U('ref.0'), U('m.0')]
+    out = order_children(units, '', ['ref', 'd1', 'm'])
+    assert names(out) == ['ref.0', 'd1.0', 'd1.1', 'm.0']
+
+
+def test_order_children_nested_scope_only():
+    units = [U('pre.0'), U('s.b.0'), U('s.a.0'), U('s.a.1'), U('post.0')]
+    out = order_children(units, 's', ['a', 'b'])
+    assert names(out) == ['pre.0', 's.a.0', 's.a.1', 's.b.0', 'post.0']
+
+
+def test_order_children_unlisted_children_sort_last_stable():
+    units = [U('s.z.0'), U('s.y.0'), U('s.a.0')]
+    out = order_children(units, 's', ['a'])
+    assert names(out) == ['s.a.0', 's.z.0', 's.y.0']
+
+
+def test_order_siblings_every_parent():
+    units = [U('b1.conv.0'), U('b1.pool.0'), U('x.0'),
+             U('b2.conv.0'), U('b2.pool.0')]
+    out = order_siblings(units, ['pool', 'conv'])
+    assert names(out) == ['b1.pool.0', 'b1.conv.0', 'x.0',
+                          'b2.pool.0', 'b2.conv.0']
+
+
+def test_order_siblings_breaks_runs_on_other_components():
+    # 'act' is not listed: it splits the run, so only contiguous listed
+    # children reorder
+    units = [U('b.conv.0'), U('b.act.0', 'prelu'), U('b.pool.0')]
+    out = order_siblings(units, ['pool', 'conv'])
+    assert names(out) == ['b.conv.0', 'b.act.0', 'b.pool.0']
+
+
+def test_order_siblings_single_member_noop():
+    units = [U('m.conv.0'), U('m.bn', 'bn'), U('m.conv.1')]
+    assert names(order_siblings(units, ['pool', 'conv'])) == names(units)
+
+
+def test_swap_sibling_runs():
+    units = [U('g.right_branch.0'), U('g.right_branch.1'),
+             U('g.left_branch.0'), U('tail.0')]
+    out = swap_sibling_runs(units, 'left_branch', 'right_branch')
+    assert names(out) == ['g.left_branch.0', 'g.right_branch.0',
+                          'g.right_branch.1', 'tail.0']
+
+
+def test_sd_leaf_units_grouping_and_kinds():
+    sd = {
+        'a.conv.weight': np.zeros((4, 3, 3, 3)),
+        'a.bn.weight': np.zeros(4), 'a.bn.bias': np.zeros(4),
+        'a.bn.running_mean': np.zeros(4), 'a.bn.running_var': np.zeros(4),
+        'a.bn.num_batches_tracked': np.zeros(()),
+        'head.weight': np.zeros((10, 4)), 'head.bias': np.zeros(10),
+        'act.weight': np.zeros(1),
+        'ln.weight': np.zeros(8), 'ln.bias': np.zeros(8),
+    }
+    units = sd_leaf_units(sd)
+    assert [(u.name, u.kind) for u in units] == [
+        ('a.conv', 'conv4d'), ('a.bn', 'bn'), ('head', 'dense'),
+        ('act', 'prelu'), ('ln', 'layernorm')]
+    assert 'num_batches_tracked' not in units[1].arrays
+
+
+def test_apply_units_count_mismatch_raises_with_context():
+    from rtseg_tpu.utils.transplant import FlaxUnit
+    import pytest
+    with pytest.raises(ValueError, match='count mismatch'):
+        apply_units({'params': {}}, [FlaxUnit(('x',), 'conv')], [])
