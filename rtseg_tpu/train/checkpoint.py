@@ -44,16 +44,23 @@ def save_train_ckpt(path: str, state: TrainState, cur_epoch: int,
                    'kind': 'train'}, f)
 
 
+def save_weights_ckpt(path: str, params, batch_stats, **meta) -> None:
+    """Weights-only ('best'-style) checkpoint: the one format
+    restore_weights/load_meta understand. Shared by the trainer's best-ckpt
+    path and tools/import_reference.py so the layout can't drift apart."""
+    path = os.path.abspath(path)
+    _ckptr().save(path, jax.device_get({'params': params,
+                                        'batch_stats': batch_stats}),
+                  force=True)
+    with open(os.path.join(path, _META), 'w') as f:
+        json.dump({'kind': 'best', **meta}, f)
+
+
 def save_best_ckpt(path: str, state: TrainState, cur_epoch: int,
                    best_score: float) -> None:
     """EMA weights only (reference base_trainer.py:155,161-162)."""
-    path = os.path.abspath(path)
-    state = jax.device_get(state)
-    _ckptr().save(path, {'params': state.ema_params,
-                         'batch_stats': state.ema_batch_stats}, force=True)
-    with open(os.path.join(path, _META), 'w') as f:
-        json.dump({'cur_epoch': cur_epoch, 'best_score': float(best_score),
-                   'kind': 'best'}, f)
+    save_weights_ckpt(path, state.ema_params, state.ema_batch_stats,
+                      cur_epoch=cur_epoch, best_score=float(best_score))
 
 
 def load_meta(path: str) -> Optional[Dict[str, Any]]:

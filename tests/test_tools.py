@@ -52,3 +52,50 @@ def test_export_cli_smoke(tmp_path):
              'XLA_FLAGS': '--xla_force_host_platform_device_count=1'})
     assert r.returncode == 0, r.stderr[-2000:]
     assert path.exists(out)
+
+
+def test_import_reference_cli(tmp_path):
+    """Full migration workflow: reference-style .pth -> import CLI -> orbax
+    ckpt -> restore_weights -> Flax forward equals the torch original."""
+    import numpy as np
+    import torch
+    sys.path.insert(0, path.dirname(path.abspath(__file__)))
+    try:
+        from reference_loader import load_ref_model_module
+    finally:
+        sys.path.pop(0)
+
+    ref = load_ref_model_module('fastscnn').FastSCNN(num_class=7)
+    ref.eval()
+    pth = tmp_path / 'ref_best.pth'
+    torch.save({'state_dict': ref.state_dict()}, pth)
+    out = tmp_path / 'imported.ckpt'
+
+    r = subprocess.run(
+        [sys.executable, path.join(ROOT, 'tools', 'import_reference.py'),
+         '--model', 'fastscnn', '--num_class', '7',
+         '--pth', str(pth), '--out', str(out)],
+        capture_output=True, text=True, timeout=540,
+        env={**os.environ,
+             'XLA_FLAGS': '--xla_force_host_platform_device_count=1'})
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert out.exists()
+
+    import jax
+    import jax.numpy as jnp
+    from rtseg_tpu.models.fastscnn import FastSCNN
+    from rtseg_tpu.train.checkpoint import load_meta, restore_weights
+    assert load_meta(str(out))['kind'] == 'best'
+
+    m = FastSCNN(num_class=7)
+    x = np.random.RandomState(0).rand(1, 64, 64, 3).astype(np.float32)
+    v = m.init(jax.random.PRNGKey(0), jnp.asarray(x), False)
+    params, bstats = restore_weights(str(out), v['params'],
+                                     v.get('batch_stats', {}))
+    with torch.no_grad():
+        yt = ref(torch.from_numpy(x.transpose(0, 3, 1, 2).copy()))
+    with jax.default_matmul_precision('highest'):
+        yf = m.apply({'params': params, 'batch_stats': bstats},
+                     jnp.asarray(x), False)
+    np.testing.assert_allclose(np.transpose(np.asarray(yf), (0, 3, 1, 2)),
+                               yt.numpy(), atol=1e-4, rtol=1e-4)

@@ -1,0 +1,73 @@
+"""Import a reference-framework checkpoint (.pth) into an rtseg_tpu ckpt.
+
+One-command migration for users carrying weights trained with
+`acai66/realtime-semantic-segmentation-pytorch` (reference
+core/base_trainer.py:142-163 save format — {'state_dict': ...}):
+
+    python tools/import_reference.py --model bisenetv2 --num_class 19 \
+        --pth reference_best.pth --out save/imported.ckpt
+
+The output is a weights checkpoint in this framework's orbax format
+('best'-style: params + batch_stats) that `--load_ckpt_path` accepts for
+predict / validate / fine-tune. The state_dict -> Flax mapping is the
+call-order transplant machinery (rtseg_tpu/utils/transplant.py), whose
+per-architecture correctness is pinned by tests/test_logit_parity.py.
+"""
+
+import argparse
+import sys
+from os import path
+
+sys.path.append(path.dirname(path.dirname(path.abspath(__file__))))
+
+
+def main() -> int:
+    # pure host-side work: no accelerator needed
+    import jax
+    try:
+        jax.config.update('jax_platforms', 'cpu')
+    except Exception:
+        pass
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument('--model', type=str, required=True)
+    ap.add_argument('--num_class', type=int, required=True)
+    ap.add_argument('--use_aux', action='store_true')
+    ap.add_argument('--use_detail_head', action='store_true')
+    ap.add_argument('--pth', type=str, required=True,
+                    help='reference .pth checkpoint')
+    ap.add_argument('--out', type=str, required=True,
+                    help='output orbax checkpoint directory')
+    ap.add_argument('--imgh', type=int, default=64,
+                    help='init trace height (any valid size works)')
+    ap.add_argument('--imgw', type=int, default=64)
+    args = ap.parse_args()
+
+    import jax.numpy as jnp
+    from rtseg_tpu.config import SegConfig
+    from rtseg_tpu.models import get_model
+    from rtseg_tpu.train.checkpoint import save_weights_ckpt
+    from rtseg_tpu.utils.transplant import load_reference_pth
+
+    cfg = SegConfig(dataset='synthetic', model=args.model,
+                    num_class=args.num_class, use_aux=args.use_aux,
+                    use_detail_head=args.use_detail_head,
+                    save_dir='/tmp/rtseg_import')
+    cfg.resolve(num_devices=1)
+    model = get_model(cfg)
+    variables = load_reference_pth(
+        args.pth, args.model, model,
+        jnp.zeros((1, args.imgh, args.imgw, 3), jnp.float32))
+
+    out = path.abspath(args.out)
+    save_weights_ckpt(out, variables['params'],
+                      variables.get('batch_stats', {}),
+                      cur_epoch=0, best_score=0.0,
+                      imported_from=path.abspath(args.pth))
+    n = sum(int(p.size) for p in jax.tree.leaves(variables['params']))
+    print(f'Imported {args.pth} -> {out} ({n / 1e6:.2f}M params). '
+          f'Use --load_ckpt_path {args.out} for predict/val/fine-tune.')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
