@@ -51,9 +51,12 @@ def test_mit_param_parity(arch):
     assert n == want, f'{arch}: {n} != {want}'
 
 
-def test_mit_b0_logit_parity():
+@pytest.mark.parametrize('arch', ['mit_b0', 'mit_b2'])
+def test_mit_logit_parity(arch):
+    # b0: the headline small variant; b2: non-uniform depths (3,4,6,3)
+    # exercising the per-stage block indexing + drop-path schedule layout
     import torch
-    ref = hf_segformer('mit_b0')
+    ref = hf_segformer(arch)
     with torch.no_grad():
         for p in ref.parameters():
             p.uniform_(-0.2, 0.2, generator=torch.Generator().manual_seed(0))
@@ -62,7 +65,7 @@ def test_mit_b0_logit_parity():
         np.float32)
     xt = torch.from_numpy(np.transpose(x, (0, 3, 1, 2)).copy())
 
-    m = MixTransformer('mit_b0')
+    m = MixTransformer(arch)
     variables, _, torch_units = transplant_from_module(
         ref, m, jnp.asarray(x),
         torch_forward=lambda mod: mod(xt, output_hidden_states=True))
@@ -75,7 +78,7 @@ def test_mit_b0_logit_parity():
     for i, (ht, hf) in enumerate(zip(out_t.hidden_states, feats)):
         np.testing.assert_allclose(
             np.transpose(np.asarray(hf), (0, 3, 1, 2)), ht.numpy(),
-            atol=2e-4, rtol=1e-3, err_msg=f'mit_b0 stage {i} diverges')
+            atol=2e-4, rtol=1e-3, err_msg=f'{arch} stage {i} diverges')
 
     # (No sd-order check here: HF registers all patch_embeddings before all
     # blocks, so its registration order differs from call order — but HF

@@ -41,6 +41,14 @@ def main() -> int:
                     help='init trace height (any valid size works)')
     ap.add_argument('--imgw', type=int, default=64)
     args = ap.parse_args()
+    if args.model == 'smp':
+        # the reference's smp family delegates to the external
+        # segmentation_models_pytorch library, whose state_dict layout this
+        # importer has no call-order mapping for (SD_REORDER covers the 36
+        # in-repo architectures); fail clearly instead of deep in get_model
+        ap.error("--model smp (reference's segmentation_models_pytorch "
+                 'family) is not supported by the importer; only the 36 '
+                 'in-repo architectures are.')
 
     import jax.numpy as jnp
     from rtseg_tpu.config import SegConfig
