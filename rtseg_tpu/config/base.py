@@ -160,7 +160,10 @@ class SegConfig:
     synthetic_len: int = 64
 
     # ----- Numerics (TPU-native additions) -----
-    compute_dtype: str = 'bfloat16'        # activations/matmul dtype under jit
+    # activations/matmul dtype under jit; None = unset, resolved to
+    # 'bfloat16' (the TPU default) unless amp_training overrides — the
+    # sentinel lets resolve() tell "explicitly set" from "left at default"
+    compute_dtype: Optional[str] = None
     param_dtype: str = 'float32'
 
     # ----- Derived fields (filled by resolve(); never set by hand) -----
@@ -192,8 +195,18 @@ class SegConfig:
         if self.amp_training is not None:
             # migrated reference configs behave predictably: AMP on -> bf16
             # compute, AMP off -> full fp32 (see field comment)
-            self.compute_dtype = ('bfloat16' if self.amp_training
-                                  else 'float32')
+            amp_dtype = 'bfloat16' if self.amp_training else 'float32'
+            if self.compute_dtype is not None \
+                    and self.compute_dtype != amp_dtype:
+                import warnings
+                warnings.warn(
+                    f'amp_training={self.amp_training} overrides explicitly '
+                    f'set compute_dtype={self.compute_dtype!r} -> '
+                    f'{amp_dtype!r}; set only one of the two.',
+                    stacklevel=2)
+            self.compute_dtype = amp_dtype
+        elif self.compute_dtype is None:
+            self.compute_dtype = 'bfloat16'
 
         if num_devices is not None:
             self.gpu_num = num_devices

@@ -27,6 +27,17 @@ DECODER_CHOICES = ['deeplabv3', 'deeplabv3p', 'fpn', 'linknet', 'manet',
                    'pan', 'pspnet', 'unet', 'unetpp']
 
 
+def _bool(s: str) -> bool:
+    """Strict CLI boolean: unlike type=bool (where 'False' -> True) both
+    states are expressible and typos fail loudly."""
+    low = s.strip().lower()
+    if low in ('1', 'true', 'yes', 'on'):
+        return True
+    if low in ('0', 'false', 'no', 'off'):
+        return False
+    raise argparse.ArgumentTypeError(f'expected a boolean, got {s!r}')
+
+
 def get_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(description='rtseg_tpu: TPU-native realtime '
                                 'semantic segmentation')
@@ -64,8 +75,8 @@ def get_parser() -> argparse.ArgumentParser:
     p.add_argument('--test_bs', type=int)
     p.add_argument('--test_data_folder', type=str)
     p.add_argument('--colormap', type=str)
-    p.add_argument('--save_mask', type=bool)
-    p.add_argument('--blend_prediction', type=bool)
+    p.add_argument('--save_mask', type=_bool)
+    p.add_argument('--blend_prediction', type=_bool)
     p.add_argument('--blend_alpha', type=float)
     # Loss
     p.add_argument('--loss_type', type=str, choices=['ce', 'ohem'])
@@ -79,16 +90,19 @@ def get_parser() -> argparse.ArgumentParser:
     p.add_argument('--momentum', type=float)
     p.add_argument('--weight_decay', type=float)
     # Monitoring
-    p.add_argument('--save_ckpt', type=bool)
+    p.add_argument('--save_ckpt', type=_bool)
     p.add_argument('--save_dir', type=str)
-    p.add_argument('--use_tb', type=bool)
+    p.add_argument('--use_tb', type=_bool)
     p.add_argument('--tb_log_dir', type=str)
     p.add_argument('--ckpt_name', type=str)
     # Training setting
-    p.add_argument('--amp_training', action='store_const', const=True)
+    # tri-state: absent -> None (defer to compute_dtype), true -> bf16,
+    # false -> force fp32 (reachable from the CLI, unlike store_const)
+    p.add_argument('--amp_training', nargs='?', const=True, default=None,
+                   type=_bool)
     p.add_argument('--log_interval', type=int)
-    p.add_argument('--resume_training', type=bool)
-    p.add_argument('--load_ckpt', type=bool)
+    p.add_argument('--resume_training', type=_bool)
+    p.add_argument('--load_ckpt', type=_bool)
     p.add_argument('--load_ckpt_path', type=str)
     p.add_argument('--base_workers', type=int)
     p.add_argument('--random_seed', type=int)
@@ -105,7 +119,7 @@ def get_parser() -> argparse.ArgumentParser:
     p.add_argument('--h_flip', type=float)
     p.add_argument('--v_flip', type=float)
     # Parallel
-    p.add_argument('--sync_bn', type=bool)
+    p.add_argument('--sync_bn', type=_bool)
     p.add_argument('--spatial_partition', type=int)
     p.add_argument('--multihost', action='store_const', const=True)
     p.add_argument('--coordinator_address', type=str)

@@ -192,6 +192,13 @@ def sd_leaf_units(sd: Dict[str, np.ndarray]) -> List[TorchUnit]:
     Conv vs ConvTranspose is not decidable from a 4-D weight alone; such
     units get kind 'conv4d' and are resolved against the flax side's
     expectation in `apply_units`.
+
+    Assumption: any {1-d weight + bias} group with no running stats is a
+    LayerNorm. An affine BatchNorm with track_running_stats=False or a
+    GroupNorm has the same state_dict shape and would be mis-kinded here —
+    no reference model uses either, and a future one surfaces as a loud
+    kind-mismatch in `apply_units` (flax side expects scale/bias under a
+    BatchNorm/GroupNorm scope), never as silent corruption.
     """
     groups: Dict[str, Dict[str, np.ndarray]] = {}
     order: List[str] = []

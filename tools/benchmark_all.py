@@ -26,9 +26,48 @@ from rtseg_tpu.utils.bench import REFERENCE_FPS, fenced_throughput
 
 DEFAULT_MODELS = 'fastscnn,bisenetv2,ddrnet,stdc,ppliteseg,enet'
 
-# TPU v5e (v5 lite) peak: 197 TFLOP/s bf16 per chip. MFU below is measured
-# against this bf16 peak; fp32 programs would halve the denominator.
-PEAK_BF16_FLOPS = 197e12
+# Per-chip bf16 peaks by device kind (public TPU specs). MFU is measured
+# against the bf16 peak of the *detected* device; unknown kinds need
+# --peak-flops or MFU is omitted rather than silently wrong.
+PEAK_BF16_BY_KIND = {
+    'TPU v4 lite': 138e12,  # v4i
+    'TPU v4': 275e12,
+    'TPU v5 lite': 197e12,
+    'TPU v5e': 197e12,
+    'TPU v5p': 459e12,
+    'TPU v5': 459e12,       # v5p reports plain 'TPU v5'
+    'TPU v6 lite': 918e12,  # v6e / Trillium
+    'TPU v6e': 918e12,
+}
+
+# every bench path below fixes the program dtype to this (SegConfig
+# compute_dtype + input casts); peak_flops halves the denominator if it is
+# ever switched to float32
+BENCH_COMPUTE_DTYPE = 'bfloat16'
+
+
+def peak_flops(override=None, compute_dtype=BENCH_COMPUTE_DTYPE):
+    """(peak FLOP/s for the MFU denominator, device kind), peak from the
+    detected device kind (halved for fp32 programs, which run the MXU at
+    half rate); peak is None when the kind is unknown and no --peak-flops
+    override is given."""
+    import jax
+    kind = jax.devices()[0].device_kind
+    if override:
+        return override, kind
+    # longest-prefix match so 'TPU v4 lite' never falls into 'TPU v4'
+    peak = None
+    for k in sorted(PEAK_BF16_BY_KIND, key=len, reverse=True):
+        if kind.lower().startswith(k.lower()):
+            peak = PEAK_BF16_BY_KIND[k]
+            break
+    if peak is None:
+        print(f'# unknown device kind {kind!r}: pass --peak-flops to get '
+              f'MFU', flush=True)
+        return None, kind
+    if compute_dtype == 'float32':
+        peak /= 2
+    return peak, kind
 
 
 def _compiled_flops(compiled) -> float:
@@ -50,12 +89,12 @@ def bench_forward(name, batch, h, w, queue, trials):
     from rtseg_tpu.models import get_model
 
     cfg = SegConfig(dataset='synthetic', model=name, num_class=19,
-                    compute_dtype='bfloat16', save_dir='/tmp/rtseg_bench')
+                    compute_dtype=BENCH_COMPUTE_DTYPE, save_dir='/tmp/rtseg_bench')
     cfg.resolve(num_devices=1)
     model = get_model(cfg)
     images = jax.device_put(
         np.random.RandomState(0).rand(batch, h, w, 3).astype(np.float32)
-    ).astype(jnp.bfloat16)
+    ).astype(jnp.dtype(BENCH_COMPUTE_DTYPE))
     variables = model.init(jax.random.PRNGKey(0),
                            jnp.zeros((1, h, w, 3)), False)
 
@@ -84,7 +123,7 @@ def _setup_state(name, batch, h, w, **cfg_overrides):
     from rtseg_tpu.train.state import create_train_state
 
     cfg = SegConfig(dataset='synthetic', model=name, num_class=19,
-                    compute_dtype='bfloat16', save_dir='/tmp/rtseg_bench',
+                    compute_dtype=BENCH_COMPUTE_DTYPE, save_dir='/tmp/rtseg_bench',
                     **cfg_overrides)
     cfg.resolve(num_devices=1)
     cfg.resolve_schedule(train_num=batch * 1000)
@@ -164,8 +203,13 @@ def main() -> int:
     mode.add_argument('--eval', action='store_true',
                       help='benchmark the validation step (EMA forward + '
                            'on-device confusion matrix)')
+    ap.add_argument('--peak-flops', type=float, default=None,
+                    help='override the per-chip peak FLOP/s used for MFU '
+                         '(required on device kinds not in '
+                         'PEAK_BF16_BY_KIND)')
     args = ap.parse_args()
 
+    peak, device_kind = peak_flops(args.peak_flops)
     kind = 'train' if args.train else 'eval' if args.eval else 'forward'
     rows = []
     for name in [m.strip() for m in args.models.split(',') if m.strip()]:
@@ -181,7 +225,8 @@ def main() -> int:
         base = REFERENCE_FPS.get(name)
         # model FLOPs x images/sec over the chip's bf16 peak — how much of
         # the MXU the shape actually uses (VERDICT round-1 weak #3)
-        mfu = flops_per_img * ips / PEAK_BF16_FLOPS if flops_per_img else None
+        mfu = (flops_per_img * ips / peak
+               if flops_per_img and peak else None)
         # the reference has no train- or eval-step throughput numbers (its
         # FPS is bare forward at 1024x512), so those ratios would be
         # meaningless — vs_baseline only in forward mode
@@ -197,8 +242,8 @@ def main() -> int:
             'mfu': round(mfu, 4) if mfu is not None else None,
         }), flush=True)
 
-    print(f'\n| model | {kind} imgs/sec/chip (TPU v5e, bs{args.batch}) | '
-          f'ref FPS (RTX 2080, bs1) | speedup | MFU |')
+    print(f'\n| model | {kind} imgs/sec/chip ({device_kind}, '
+          f'bs{args.batch}) | ref FPS (RTX 2080, bs1) | speedup | MFU |')
     print('|---|---|---|---|---|')
     for name, ips, base, ratio, mfu in rows:
         mfu_s = f'{100 * mfu:.1f}%' if mfu is not None else '—'
