@@ -165,6 +165,10 @@ class SegConfig:
     # sentinel lets resolve() tell "explicitly set" from "left at default"
     compute_dtype: Optional[str] = None
     param_dtype: str = 'float32'
+    # space-to-depth stem packing: compute 3-channel k3/s2 stem convs as
+    # k2/s1 over 12 packed lanes (exact weight-space rewrite, checkpoint-
+    # compatible; see nn/modules.py _PackedStemConv)
+    s2d_stem: bool = False
 
     # ----- Derived fields (filled by resolve(); never set by hand) -----
     train_num: int = 0
@@ -207,6 +211,16 @@ class SegConfig:
             self.compute_dtype = amp_dtype
         elif self.compute_dtype is None:
             self.compute_dtype = 'bfloat16'
+
+        if self.spatial_partition > 1 and self.crop_h is not None \
+                and self.crop_h % self.spatial_partition:
+            # GSPMD input shardings need the sharded dim divisible by the
+            # shard count; fail here with a clear message instead of deep
+            # inside pjit
+            raise ValueError(
+                f'crop_h={self.crop_h} must be divisible by '
+                f'spatial_partition={self.spatial_partition} (the spatial '
+                f'mesh axis shards image rows)')
 
         if num_devices is not None:
             self.gpu_num = num_devices

@@ -69,6 +69,8 @@ def model_class(name: str):
 
 def get_model(config):
     """Build the (uninitialized) Flax module for config.model."""
+    from ..nn import set_stem_packing
+    set_stem_packing(getattr(config, 's2d_stem', False))
     name = config.model
     if name == 'smp':
         from .smp import build_smp_model

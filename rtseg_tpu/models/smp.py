@@ -4,12 +4,38 @@ models/__init__.py:42-44,66-81: decoder_hub of 9 decoders x torchvision-style
 encoders). Used for `config.model == 'smp'` and the frozen KD teacher
 (reference models/__init__.py:102-122).
 
-Decoders follow the published smp architectures (Unet, Unet++, LinkNet, FPN,
-PSPNet, DeepLabV3, DeepLabV3+, MAnet, PAN); encoders are the Flax backbones
-from .backbone (ResNet-18/34/50/101/152, MobileNetV2). Deviation from smp:
-MobileNetV2's deepest feature is 320ch (no 1280 1x1 head) and pretrained
-ImageNet weights load via utils/torch_import from a local .pth instead of a
-download.
+Decoders are faithful re-implementations of the smp architectures the
+reference instantiates with default arguments (Unet, Unet++, LinkNet, FPN,
+PSPNet, DeepLabV3, DeepLabV3+, MAnet, PAN), down to the quirks that matter
+for `.pth` weight migration:
+
+  * per-decoder segmentation-head kernel (3x3 for unet/unetpp/manet/pan/
+    pspnet, 1x1 for linknet/fpn/deeplabv3/deeplabv3p) and bilinear
+    align_corners=True final upsampling (smp SegmentationHead uses
+    nn.UpsamplingBilinear2d);
+  * FPN's GroupNorm(32) segmentation blocks (not BatchNorm);
+  * PSPNet's encoder_depth=3 (decoder reads the stride-8 feature; the full
+    encoder is still built and counted, exactly like smp which keeps
+    layer3/4 as dead modules — XLA dead-code-eliminates their compute);
+  * the PSP pool-size-1 branch carries no BatchNorm (smp can't batch-norm a
+    1x1 map) and concatenates branches-then-input;
+  * separable ASPP convs in DeepLabV3+ (depthwise + pointwise with a single
+    BatchNorm after the pointwise), non-separable in DeepLabV3;
+  * LinkNet's k4/s2/p1 transposed convs and 32-channel prefinal block;
+  * MAnet's PAB (64 attention channels, softmax over the flattened hw*hw
+    map, torch's channel-scrambling reshape replicated bit-for-bit) and
+    MFAB SE gates;
+  * PAN's max-pool pyramid ladder and align_corners=True upsampling;
+  * smp's uniform make_dilated scheme (every conv in a dilated stage gets
+    stride 1 + the stage dilation — unlike torchvision's
+    replace_stride_with_dilation, smp applies the same rate to the first
+    block too).
+
+The per-decoder parameter counts reproduce the reference's published table
+(reference README.md:183-195) exactly; see tests/test_smp_parity.py.
+
+Encoders are the Flax backbones from .backbone (ResNet-18/34/50/101/152,
+MobileNetV2 with smp's 1280-channel head conv, MiT-b0..b5).
 """
 
 from __future__ import annotations
@@ -20,13 +46,17 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-from ..nn import BatchNorm, Conv, ConvBNAct, DeConvBNAct
+from ..nn import (BatchNorm, Conv, ConvBNAct, DeConvBNAct, Dropout,
+                  Dropout2d)
 from ..ops import (adaptive_avg_pool, global_avg_pool, max_pool,
                    resize_bilinear, resize_nearest)
 from .backbone import Mobilenetv2, ResNet, RESNET_LAYERS
 
 SMP_DECODERS = ('deeplabv3', 'deeplabv3p', 'fpn', 'linknet', 'manet', 'pan',
                 'pspnet', 'unet', 'unetpp')
+
+# decoders whose smp SegmentationHead uses a 3x3 conv; the rest use 1x1
+HEAD_K3_DECODERS = ('unet', 'unetpp', 'manet', 'pan', 'pspnet')
 
 # encoder name -> per-level channels at strides (2, 4, 8, 16, 32);
 # MixTransformer has no stride-2 level (channel 0 -> the level is None,
@@ -37,7 +67,7 @@ ENCODER_CHANNELS = {
     'resnet50': (64, 256, 512, 1024, 2048),
     'resnet101': (64, 256, 512, 1024, 2048),
     'resnet152': (64, 256, 512, 1024, 2048),
-    'mobilenet_v2': (16, 24, 32, 96, 320),
+    'mobilenet_v2': (16, 24, 32, 96, 1280),
     'mit_b0': (0, 32, 64, 160, 256),
     'mit_b1': (0, 64, 128, 320, 512),
     'mit_b2': (0, 64, 128, 320, 512),
@@ -53,7 +83,8 @@ MIT_UNSUPPORTED_DECODERS = ('deeplabv3', 'deeplabv3p', 'linknet', 'unetpp')
 
 class Encoder(nn.Module):
     """Returns features at strides (2, 4, 8, 16, 32); `dilations` relaxes
-    the deepest stages for os8/os16 operation (DeepLab family)."""
+    the deepest stages for os8/os16 operation (DeepLab family) using smp's
+    uniform replace_strides_with_dilation semantics."""
     encoder_name: str = 'resnet18'
     dilations: Sequence[int] = (1, 1, 1, 1)
 
@@ -75,14 +106,16 @@ class Encoder(nn.Module):
             # extra tap at stride 2 (after block1, 16ch); dilations relax
             # the stride-16/32 groups for os16/os8 operation exactly like
             # smp's make_dilated (stride-2 entry block -> stride 1, all
-            # spatial convs in the group get the dilation)
+            # spatial convs in the group get the dilation). The deepest
+            # feature is the 1280-channel 1x1 head conv, as in smp's
+            # MobileNetV2Encoder (out_channels[-1] = 1280).
             from .backbone import MBInvertedResidual, _MBV2_SETTING
             x = Conv(32, 3, 2, name='stem')(x)
             x = BatchNorm(name='stem_bn')(x, train)
             x = jnp.clip(x, 0, 6)
             feats = []
             idx = 0
-            taps = {1, 3, 6, 13, 17}
+            taps = {1, 3, 6, 13}
             # block index -> encoder level of Encoder.dilations (resnet
             # layer1..4 equivalents): 2-3 @s4, 4-6 @s8, 7-13 @s16, 14-17 @s32
             def level(i):
@@ -98,6 +131,9 @@ class Encoder(nn.Module):
                                            name=f'block{idx}')(x, train)
                     if idx in taps:
                         feats.append(x)
+            x = Conv(1280, 1, name='head')(x)
+            x = BatchNorm(name='head_bn')(x, train)
+            feats.append(jnp.clip(x, 0, 6))
             return tuple(feats)
         if name in RESNET_LAYERS:
             kind, layers = RESNET_LAYERS[name]
@@ -122,6 +158,7 @@ class Encoder(nn.Module):
 # --------------------------------------------------------------------- blocks
 
 class Conv2ReLU(nn.Module):
+    """smp Conv2dReLU: 3x3 conv (bias-free) + BN + ReLU."""
     out_channels: int
 
     @nn.compact
@@ -129,7 +166,25 @@ class Conv2ReLU(nn.Module):
         return ConvBNAct(self.out_channels, 3, act_type='relu')(x, train)
 
 
+class SeparableConvBNReLU(nn.Module):
+    """smp SeparableConv2d + BN + ReLU (ASPPSeparableConv / DeepLabV3+
+    blocks): depthwise 3x3 then pointwise 1x1, both bias-free, one BN after
+    the pointwise only."""
+    out_channels: int
+    dilation: int = 1
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        x = Conv(x.shape[-1], 3, 1, self.dilation, groups=x.shape[-1],
+                 name='dw')(x)
+        x = Conv(self.out_channels, 1, name='pw')(x)
+        x = BatchNorm()(x, train)
+        return jax.nn.relu(x)
+
+
 class UnetBlock(nn.Module):
+    """smp unet DecoderBlock: nearest x2 up, concat skip, two Conv2dReLU
+    (attention=None -> identity gates)."""
     out_channels: int
 
     @nn.compact
@@ -142,8 +197,12 @@ class UnetBlock(nn.Module):
 
 
 class ASPP(nn.Module):
+    """smp ASPP: [1x1, three rate convs, pooled 1x1] -> 1x1 projection with
+    Dropout(0.5). `separable` switches the rate convs to depthwise-separable
+    (DeepLabV3+)."""
     out_channels: int = 256
     atrous_rates: Sequence[int] = (12, 24, 36)
+    separable: bool = False
 
     @nn.compact
     def __call__(self, x, train=False):
@@ -151,14 +210,22 @@ class ASPP(nn.Module):
         size = x.shape[1:3]
         feats = [ConvBNAct(c, 1)(x, train)]
         for r in self.atrous_rates:
-            feats.append(ConvBNAct(c, 3, dilation=r)(x, train))
+            if self.separable:
+                feats.append(SeparableConvBNReLU(c, r)(x, train))
+            else:
+                feats.append(ConvBNAct(c, 3, dilation=r)(x, train))
         g = ConvBNAct(c, 1)(global_avg_pool(x), train)
         feats.append(resize_bilinear(g, size, align_corners=False))
         x = jnp.concatenate(feats, axis=-1)
-        return ConvBNAct(c, 1)(x, train)
+        x = ConvBNAct(c, 1)(x, train)
+        return Dropout(0.5)(x, train)
 
 
 class PSPModule(nn.Module):
+    """smp PSPModule: branches at pool sizes (1,2,3,6); the size-1 branch is
+    a bare biased conv + ReLU (BatchNorm cannot run on a 1x1 map), the rest
+    Conv2dReLU; branch upsampling is bilinear align_corners=True; concat is
+    branches-then-input."""
     out_channels: int = 512
     pool_sizes: Sequence[int] = (1, 2, 3, 6)
 
@@ -167,12 +234,15 @@ class PSPModule(nn.Module):
         in_c = x.shape[-1]
         size = x.shape[1:3]
         hid = in_c // len(self.pool_sizes)
-        feats = [x]
+        feats = []
         for ps in self.pool_sizes:
             y = adaptive_avg_pool(x, ps)
-            y = ConvBNAct(hid, 1)(y, train)
+            if ps == 1:
+                y = jax.nn.relu(Conv(hid, 1, use_bias=True)(y))
+            else:
+                y = ConvBNAct(hid, 1)(y, train)
             feats.append(resize_bilinear(y, size, align_corners=True))
-        x = jnp.concatenate(feats, axis=-1)
+        x = jnp.concatenate(feats + [x], axis=-1)
         return ConvBNAct(self.out_channels, 1)(x, train)
 
 
@@ -191,32 +261,48 @@ class UnetDecoder(nn.Module):
 
 
 class UnetPPDecoder(nn.Module):
-    """Nested Unet++ grid (smp UnetPlusPlus semantics, depth 5)."""
+    """smp UnetPlusPlus grid. Node x_{d}_{l} (depth d, dense layer l) takes
+    x_{d}_{l-1} as its up-input and concatenates the deeper same-layer nodes
+    plus the encoder skip; channels follow smp's rule (out = decoder channel
+    on the d==l diagonal path down column 0, encoder skip channel elsewhere).
+    Call order is the diagonal-major order of smp's forward."""
     channels: Sequence[int] = (256, 128, 64, 32, 16)
 
     @nn.compact
     def __call__(self, feats, train=False):
-        # feats strides: 2,4,8,16,32 -> rows 0..4; dense nodes X[i][j]
-        depth = len(feats) - 1                      # 4 up levels in the grid
-        X = {(i, 0): feats[i] for i in range(len(feats))}
-        for j in range(1, depth + 1):
-            for i in range(len(feats) - j):
-                ups = resize_nearest(
-                    X[(i + 1, j - 1)],
-                    X[(i, 0)].shape[1:3])
-                cat = [X[(i, k)] for k in range(j)] + [ups]
-                y = jnp.concatenate(cat, axis=-1)
-                c = self.channels[depth - 1 - i] if j == depth - i \
-                    else X[(i, 0)].shape[-1]
-                y = Conv2ReLU(c, name=f'x_{i}_{j}a')(y, train)
-                X[(i, j)] = Conv2ReLU(c, name=f'x_{i}_{j}b')(y, train)
-        x = X[(0, depth)]
-        # final x2 up block to full resolution
-        x = UnetBlock(self.channels[-1], name='final')(x, None, train)
-        return x
+        # rev[0] = deepest (head), rev[1..4] = skips; matches smp's
+        # features[::-1] after dropping the identity feature
+        rev = list(feats)[::-1]
+        depth = len(rev) - 1                              # 4
+        skip_ch = [f.shape[-1] for f in rev[1:]]          # [256,128,64,64]
+        dense = {}
+
+        def block(d, l, x_in, skip):
+            # out channels: smp unetplusplus/decoder.py channel rule
+            out_c = self.channels[l] if d == 0 else skip_ch[l]
+            return UnetBlock(out_c, name=f'x_{d}_{l}')(x_in, skip, train)
+
+        # layer 0: the plain-unet diagonal x_d_d
+        for d in range(depth):
+            dense[(d, d)] = block(d, d, rev[d], rev[d + 1])
+        # dense layers: x_{d}_{dl} consumes x_{d}_{dl-1}; skip = deeper
+        # same-layer nodes + encoder feature
+        for layer in range(1, depth):
+            for d in range(depth - layer):
+                dl = d + layer
+                cat = [dense[(i, dl)] for i in range(d + 1, dl + 1)]
+                skip = jnp.concatenate(cat + [rev[dl + 1]], axis=-1)
+                dense[(d, dl)] = block(d, dl, dense[(d, dl - 1)], skip)
+        # final full-resolution node x_0_depth (no skip)
+        return UnetBlock(self.channels[-1], name=f'x_0_{depth}')(
+            dense[(0, depth - 1)], None, train)
 
 
 class LinkNetDecoder(nn.Module):
+    """smp LinknetDecoder: 1x1 reduce -> ConvTranspose(k4,s2,p1) -> 1x1
+    expand, residual skip add, prefinal 32 channels."""
+    prefinal_channels: int = 32
+
     @nn.compact
     def __call__(self, feats, train=False):
         skips = list(feats[:-1])[::-1]
@@ -224,13 +310,31 @@ class LinkNetDecoder(nn.Module):
         for i, s in enumerate(skips):
             x = self._block(x, s.shape[-1], train, f'dec{i}')
             x = x + s
-        return self._block(x, 16, train, 'dec_last')
+        return self._block(x, self.prefinal_channels, train, 'dec_last')
 
     def _block(self, x, out_c, train, name):
         hid = x.shape[-1] // 4
         x = ConvBNAct(hid, 1, name=f'{name}_c1')(x, train)
-        x = DeConvBNAct(hid, name=f'{name}_up')(x, train)
+        x = DeConvBNAct(hid, kernel_size=4, output_padding=0,
+                        name=f'{name}_up')(x, train)
         return ConvBNAct(out_c, 1, name=f'{name}_c2')(x, train)
+
+
+class Conv3x3GNReLU(nn.Module):
+    """smp FPN Conv3x3GNReLU: bias-free 3x3 conv + GroupNorm(32) + ReLU,
+    optional nearest x2 upsample."""
+    out_channels: int
+    upsample: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        x = Conv(self.out_channels, 3)(x)
+        x = nn.GroupNorm(num_groups=32, epsilon=1e-5, dtype=x.dtype,
+                         param_dtype=jnp.float32, name='gn')(x)
+        x = jax.nn.relu(x)
+        if self.upsample:
+            x = resize_nearest(x, (x.shape[1] * 2, x.shape[2] * 2))
+        return x
 
 
 class FPNDecoder(nn.Module):
@@ -251,69 +355,90 @@ class FPNDecoder(nn.Module):
             resize_nearest(p3, c2.shape[1:3])
         outs = []
         for i, (p, n_up) in enumerate(((p5, 3), (p4, 2), (p3, 1), (p2, 0))):
-            y = p
-            for j in range(max(n_up, 1)):
-                y = ConvBNAct(self.segmentation_channels, 3,
-                              name=f'seg{i}_{j}')(y, train)
-                if j < n_up:
-                    y = resize_nearest(y, (y.shape[1] * 2, y.shape[2] * 2))
+            y = Conv3x3GNReLU(self.segmentation_channels, bool(n_up),
+                              name=f'seg{i}_0')(p)
+            for j in range(1, n_up):
+                y = Conv3x3GNReLU(self.segmentation_channels, True,
+                                  name=f'seg{i}_{j}')(y)
             outs.append(y)
-        return outs[0] + outs[1] + outs[2] + outs[3]     # merge: sum at 1/4
+        x = outs[0] + outs[1] + outs[2] + outs[3]        # merge: sum at 1/4
+        return Dropout2d(0.2)(x, train)
 
 
-class MAnetDecoder(nn.Module):
-    """smp MAnet: PAB on the deepest feature, MFAB fusion blocks upward."""
-    channels: Sequence[int] = (256, 128, 64, 32, 16)
+class PABlock(nn.Module):
+    """smp MAnet PAB: 64-channel top/center attention maps, 3x3 bottom and
+    out convs (all biased), softmax over the *flattened* hw*hw map, and
+    torch's reshape of the (b, hw, c) result straight to (b, c, h, w) —
+    a channel/position scramble that trained weights depend on, replicated
+    exactly."""
+    pab_channels: int = 64
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        n, h, w, c = x.shape
+        top = Conv(self.pab_channels, 1, use_bias=True, name='top')(x)
+        center = Conv(self.pab_channels, 1, use_bias=True, name='center')(x)
+        bottom = Conv(c, 3, use_bias=True, name='bottom')(x)
+        hw = h * w
+        att = jnp.einsum('npk,nqk->npq', center.reshape(n, hw, -1),
+                         top.reshape(n, hw, -1))
+        att = jax.nn.softmax(att.reshape(n, hw * hw).astype(jnp.float32),
+                             axis=-1).reshape(n, hw, hw).astype(x.dtype)
+        out = jnp.einsum('npq,nqc->npc', att, bottom.reshape(n, hw, c))
+        # torch: (b, hw, c).reshape(b, c, h, w) with row-major strides; then
+        # back to NHWC for the residual add
+        out = out.reshape(n, c, h, w).transpose(0, 2, 3, 1)
+        x = x + out
+        return Conv(c, 3, use_bias=True, name='out')(x)
+
+
+class MFABlock(nn.Module):
+    """smp MAnet MFAB: 3x3+1x1 high-level conv pair, nearest x2 up, SE gate
+    on the upsampled high path and on the skip, concat, two Conv2dReLU."""
+    skip_channels: int
+    out_channels: int
     reduction: int = 16
 
     @nn.compact
+    def __call__(self, x, skip, train=False):
+        in_c = x.shape[-1]
+        x = Conv2ReLU(in_c, name='hl_a')(x, train)
+        x = ConvBNAct(self.skip_channels, 1, name='hl_b')(x, train)
+        x = resize_nearest(x, (x.shape[1] * 2, x.shape[2] * 2))
+        x = x * self._se(x, 'se_hl')
+        skip = skip * self._se(skip, 'se_ll')
+        x = jnp.concatenate([x, skip], axis=-1)
+        x = Conv2ReLU(self.out_channels, name='c1')(x, train)
+        return Conv2ReLU(self.out_channels, name='c2')(x, train)
+
+    def _se(self, x, name):
+        c = x.shape[-1]
+        g = global_avg_pool(x)
+        g = jax.nn.relu(Conv(max(1, c // self.reduction), 1, use_bias=True,
+                             name=f'{name}_a')(g))
+        return jax.nn.sigmoid(Conv(c, 1, use_bias=True, name=f'{name}_b')(g))
+
+
+class MAnetDecoder(nn.Module):
+    channels: Sequence[int] = (256, 128, 64, 32, 16)
+
+    @nn.compact
     def __call__(self, feats, train=False):
-        x = self._pab(feats[-1], train)
+        x = PABlock(name='pab')(feats[-1], train)
         skips = list(feats[:-1])[::-1] + [None]
         for i, c in enumerate(self.channels):
             if skips[i] is not None:
-                x = self._mfab(x, skips[i], c, train, f'mfab{i}')
+                x = MFABlock(skips[i].shape[-1], c, name=f'mfab{i}')(
+                    x, skips[i], train)
             else:
                 x = UnetBlock(c, name=f'up{i}')(x, None, train)
         return x
 
-    def _pab(self, x, train):
-        c = x.shape[-1]
-        top = Conv(c // 4, 1, name='pab_top')(x)
-        center = Conv(c // 4, 1, name='pab_center')(x)
-        bottom = Conv(c // 4, 1, name='pab_bottom')(x)
-        n, h, w, ck = top.shape
-        att = jnp.einsum('nhwc,nijc->nhwij', top, center)
-        att = jax.nn.softmax(att.reshape(n, h, w, h * w), axis=-1)
-        att = att.reshape(n, h, w, h, w)
-        out = jnp.einsum('nhwij,nijc->nhwc', att, bottom)
-        return Conv(x.shape[-1], 1, name='pab_out')(out) + x
-
-    def _mfab(self, x, skip, out_c, train, name):
-        in_c = x.shape[-1]
-        hi = ConvBNAct(in_c, 3, name=f'{name}_hi')(x, train)
-        # two SE gates (high + skip)
-        g1 = global_avg_pool(hi)
-        g1 = jax.nn.relu(Conv(in_c // self.reduction, 1,
-                              use_bias=True, name=f'{name}_se1a')(g1))
-        g1 = jax.nn.sigmoid(Conv(in_c, 1, use_bias=True,
-                                 name=f'{name}_se1b')(g1))
-        hi = hi * g1
-        sk = skip
-        g2 = global_avg_pool(sk)
-        g2 = jax.nn.relu(Conv(max(1, sk.shape[-1] // self.reduction), 1,
-                              use_bias=True, name=f'{name}_se2a')(g2))
-        g2 = jax.nn.sigmoid(Conv(sk.shape[-1], 1, use_bias=True,
-                                 name=f'{name}_se2b')(g2))
-        sk = sk * g2
-        hi = resize_nearest(hi, sk.shape[1:3])
-        x = jnp.concatenate([hi, sk], axis=-1)
-        x = Conv2ReLU(out_c, name=f'{name}_c1')(x, train)
-        return Conv2ReLU(out_c, name=f'{name}_c2')(x, train)
-
 
 class PANDecoder(nn.Module):
-    """smp PAN: feature pyramid attention on the deepest level + GAU blocks."""
+    """smp PAN: feature pyramid attention on the deepest level + GAU blocks;
+    bilinear upsampling is align_corners=True throughout (smp pan decoder
+    upscale_mode='bilinear')."""
     decoder_channels: int = 32
 
     @nn.compact
@@ -328,41 +453,42 @@ class PANDecoder(nn.Module):
 
     def _fpa(self, x, out_c, train):
         size = x.shape[1:3]
-        # global branch
-        g = ConvBNAct(out_c, 1, name='fpa_glob')(global_avg_pool(x), train)
-        g = resize_bilinear(g, size, align_corners=False)
-        # mid 1x1
-        mid = ConvBNAct(out_c, 1, name='fpa_mid')(x, train)
-        # pyramid 7/5/3 ladder over progressively pooled maps; pooled sizes
-        # clamp to >=1 so tiny inputs (tests, dry runs) still trace
-        def half(t):
-            return (max(1, t[0] // 2), max(1, t[1] // 2))
-
-        s1, s2, s3 = half(size), half(half(size)), half(half(half(size)))
-        y1 = ConvBNAct(1, 7, name='fpa_y1')(adaptive_avg_pool(x, s1), train)
-        y2 = ConvBNAct(1, 5, name='fpa_y2')(adaptive_avg_pool(y1, s2), train)
-        y3 = ConvBNAct(1, 3, name='fpa_y3')(adaptive_avg_pool(y2, s3), train)
-        y3 = ConvBNAct(1, 3, name='fpa_y3b')(y3, train)
-        y3 = resize_bilinear(y3, y2.shape[1:3], align_corners=False)
-        y2 = ConvBNAct(1, 5, name='fpa_y2b')(y2, train) + y3
-        y2 = resize_bilinear(y2, y1.shape[1:3], align_corners=False)
-        y1 = ConvBNAct(1, 7, name='fpa_y1b')(y1, train) + y2
-        y1 = resize_bilinear(y1, size, align_corners=False)
-        return mid * y1 + g
+        # branch1: global pool + 1x1; upsampled back (align_corners=True)
+        g = ConvBNAct(out_c, 1, bias=True, name='fpa_glob')(
+            global_avg_pool(x), train)
+        g = resize_bilinear(g, size, align_corners=True)
+        mid = ConvBNAct(out_c, 1, bias=True, name='fpa_mid')(x, train)
+        # pyramid 7/5/3 ladder over max-pooled maps (smp uses MaxPool2d(2))
+        x1 = ConvBNAct(1, 7, bias=True, name='fpa_down1')(
+            max_pool(x, 2, 2), train)
+        x2 = ConvBNAct(1, 5, bias=True, name='fpa_down2')(
+            max_pool(x1, 2, 2), train)
+        x3 = ConvBNAct(1, 3, bias=True, name='fpa_down3a')(
+            max_pool(x2, 2, 2), train)
+        x3 = ConvBNAct(1, 3, bias=True, name='fpa_down3b')(x3, train)
+        x3 = resize_bilinear(x3, x2.shape[1:3], align_corners=True)
+        x2 = ConvBNAct(1, 5, bias=True, name='fpa_conv2')(x2, train) + x3
+        x2 = resize_bilinear(x2, x1.shape[1:3], align_corners=True)
+        x1 = ConvBNAct(1, 7, bias=True, name='fpa_conv1')(x1, train) + x2
+        x1 = resize_bilinear(x1, size, align_corners=True)
+        return mid * x1 + g
 
     def _gau(self, x_high, x_low, out_c, train, name):
-        low = ConvBNAct(out_c, 3, name=f'{name}_low')(x_low, train)
+        up = resize_bilinear(x_high, x_low.shape[1:3], align_corners=True)
+        low = ConvBNAct(out_c, 3, bias=True, name=f'{name}_low')(x_low, train)
         g = global_avg_pool(x_high)
-        g = ConvBNAct(out_c, 1, act_type='sigmoid', name=f'{name}_g')(
-            g, train)
-        up = resize_bilinear(x_high, x_low.shape[1:3], align_corners=False)
+        # gate: 1x1 conv + BN + sigmoid (ConvBnRelu with add_relu=False
+        # wrapped in Sigmoid)
+        g = ConvBNAct(out_c, 1, bias=True, act_type='sigmoid',
+                      name=f'{name}_g')(g, train)
         return up + low * g
 
 
 # --------------------------------------------------------------------- model
 
 class GenericSegModel(nn.Module):
-    """encoder + decoder + seg head, bilinear to input size."""
+    """encoder + decoder + seg head, bilinear align_corners=True to input
+    size (smp SegmentationHead's nn.UpsamplingBilinear2d)."""
     encoder_name: str = 'resnet18'
     decoder_name: str = 'unet'
     num_class: int = 1
@@ -396,24 +522,28 @@ class GenericSegModel(nn.Module):
         elif dec == 'pan':
             y = PANDecoder()(feats, train)
         elif dec == 'pspnet':
-            y = PSPModule(512)(feats[2], train)          # os8 features
-            y = ConvBNAct(512, 3)(y, train)
+            # smp PSPNet: encoder_depth=3 -> the decoder reads the stride-8
+            # feature; deeper encoder stages stay as dead weight (XLA DCEs
+            # their compute, smp keeps the dead modules in the state_dict)
+            y = PSPModule(512)(feats[2], train)
+            y = Dropout2d(0.2)(y, train)
         elif dec == 'deeplabv3':
             y = ASPP(256)(feats[-1], train)
             y = ConvBNAct(256, 3)(y, train)
         elif dec == 'deeplabv3p':
-            y = ASPP(256)(feats[-1], train)
-            y = resize_bilinear(y, feats[1].shape[1:3], align_corners=False)
-            low = ConvBNAct(48, 1)(feats[1], train)
+            y = ASPP(256, separable=True)(feats[-1], train)
+            y = SeparableConvBNReLU(256, name='aspp_post')(y, train)
+            y = resize_bilinear(y, feats[1].shape[1:3], align_corners=True)
+            low = ConvBNAct(48, 1, name='block1')(feats[1], train)
             y = jnp.concatenate([y, low], axis=-1)
-            y = ConvBNAct(256, 3)(y, train)
-            y = ConvBNAct(256, 3)(y, train)
+            y = SeparableConvBNReLU(256, name='block2')(y, train)
         else:
             raise ValueError(f'Unsupported decoder type: {dec}')
 
-        y = Conv(self.num_class, 1, use_bias=True, name='seg_head')(y)
+        k = 3 if dec in HEAD_K3_DECODERS else 1
+        y = Conv(self.num_class, k, use_bias=True, name='seg_head')(y)
         if y.shape[1:3] != tuple(size):
-            y = resize_bilinear(y, size, align_corners=False)
+            y = resize_bilinear(y, size, align_corners=True)
         return y
 
 

@@ -1,11 +1,13 @@
 from .modules import (ACTIVATIONS, Activation, BatchNorm, Conv, ConvBNAct,
-                      DSConvBNAct, DWConvBNAct, DeConvBNAct, Dropout, PReLU,
+                      DSConvBNAct, DWConvBNAct, DeConvBNAct, Dropout, Dropout2d,
+                      PReLU,
                       PWConvBNAct, PyramidPoolingModule, SegHead, conv1x1,
-                      conv3x3, get_bn_axis, set_bn_axis)
+                      conv3x3, get_bn_axis, get_stem_packing, set_bn_axis,
+                      set_stem_packing)
 
 __all__ = [
     'ACTIVATIONS', 'Activation', 'BatchNorm', 'Conv', 'ConvBNAct',
-    'DSConvBNAct', 'DWConvBNAct', 'DeConvBNAct', 'Dropout', 'PReLU',
+    'DSConvBNAct', 'DWConvBNAct', 'DeConvBNAct', 'Dropout', 'Dropout2d', 'PReLU',
     'PWConvBNAct', 'PyramidPoolingModule', 'SegHead', 'conv1x1', 'conv3x3',
-    'get_bn_axis', 'set_bn_axis',
+    'get_bn_axis', 'set_bn_axis', 'get_stem_packing', 'set_stem_packing',
 ]

@@ -38,6 +38,24 @@ def get_bn_axis() -> Optional[str]:
     return _BN_AXIS['name']
 
 
+# Module-level stem-packing switch (config.s2d_stem). When on, every conv
+# that consumes the 3-channel input with kernel 3 / stride 2 computes via
+# space-to-depth: S2D(2) packs the input to (H/2, W/2, 12) and the conv
+# becomes kernel-2 / stride-1 over 12 lanes — 3/128 -> 12/128 MXU lane
+# occupancy on the stem, with a weight-space scatter that is mathematically
+# exact (tests/test_ops.py::test_s2d_stem_equivalence). Param shape/path are
+# unchanged, so checkpoints and transplant parity are unaffected.
+_S2D_STEM: dict = {'on': False}
+
+
+def set_stem_packing(on: bool) -> None:
+    _S2D_STEM['on'] = bool(on)
+
+
+def get_stem_packing() -> bool:
+    return _S2D_STEM['on']
+
+
 def _pair(v: Size2) -> Tuple[int, int]:
     return (v, v) if isinstance(v, int) else (int(v[0]), int(v[1]))
 
@@ -123,6 +141,46 @@ class BatchNorm(nn.Module):
 
 # ------------------------------------------------------------------ conv cores
 
+class _PackedStemConv(nn.Module):
+    """nn.Conv(features, 3x3, stride 2, pad 1) on a 3-channel input,
+    computed space-to-depth packed (see _S2D_STEM above). The parameter is
+    the ORIGINAL (3, 3, in, features) kernel under the same 'conv' scope —
+    the packed (2, 2, 4*in, features) kernel is derived inside the program
+    by a weight scatter (constant-folded by XLA): for output row i the k3/s2
+    conv reads input rows 2i-1..2i+1, which live in packed rows i-1..i at
+    sub-row a with di = 2t + a - 1 — a kernel-2/stride-1 conv with causal
+    (1, 0) padding. Exact, not approximate."""
+    features: int
+    use_bias: bool
+
+    @nn.compact
+    def __call__(self, x):
+        c = x.shape[-1]
+        kernel = self.param('kernel', nn.initializers.lecun_normal(),
+                            (3, 3, c, self.features), jnp.float32)
+        n, h, w, _ = x.shape
+        xp = x.reshape(n, h // 2, 2, w // 2, 2, c)
+        xp = xp.transpose(0, 1, 3, 2, 4, 5).reshape(n, h // 2, w // 2,
+                                                    4 * c)
+        wp = jnp.zeros((2, 2, 2, 2, c, self.features), kernel.dtype)
+        for t in range(2):
+            for u in range(2):
+                for a in range(2):
+                    for b in range(2):
+                        di, dj = 2 * t + a - 1, 2 * u + b - 1
+                        if 0 <= di <= 2 and 0 <= dj <= 2:
+                            wp = wp.at[t, u, a, b].set(kernel[di, dj])
+        wp = wp.reshape(2, 2, 4 * c, self.features)
+        y = jax.lax.conv_general_dilated(
+            xp, wp.astype(x.dtype), (1, 1), ((1, 0), (1, 0)),
+            dimension_numbers=('NHWC', 'HWIO', 'NHWC'))
+        if self.use_bias:
+            bias = self.param('bias', nn.initializers.zeros,
+                              (self.features,), jnp.float32)
+            y = y + bias.astype(y.dtype)
+        return y
+
+
 class Conv(nn.Module):
     """Conv2d wrapper: torch-style symmetric padding from (kernel, dilation),
     grouped/dilated/asymmetric kernels, NHWC, fp32 params."""
@@ -146,6 +204,13 @@ class Conv(nn.Module):
                        (self.padding, self.padding))
         else:
             padding = self.padding
+        if (get_stem_packing() and x.ndim == 4 and x.shape[-1] == 3
+                and (kh, kw) == (3, 3) and _pair(self.stride) == (2, 2)
+                and (dh, dw) == (1, 1) and self.groups == 1
+                and padding == ((1, 1), (1, 1))
+                and x.shape[1] % 2 == 0 and x.shape[2] % 2 == 0):
+            return _PackedStemConv(self.out_channels, self.use_bias,
+                                   name='conv')(x)
         return nn.Conv(
             features=self.out_channels,
             kernel_size=(kh, kw),
@@ -237,18 +302,22 @@ class DeConvBNAct(nn.Module):
 
     Matches torch ConvTranspose2d geometry: kernel 2*scale-1, stride=scale,
     padding=(k-1)//2, output_padding=scale-1 => exact scale× upsampling.
+    output_padding overrides the default scale-1 (e.g. torch's k4/s2/p1
+    blocks use output_padding 0 and still produce exactly 2x).
     """
     out_channels: int
     scale_factor: int = 2
     kernel_size: Optional[int] = None
     act_type: str = 'relu'
+    output_padding: Optional[int] = None
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         scale = self.scale_factor
         k = self.kernel_size if self.kernel_size is not None else 2 * scale - 1
         pad = (k - 1) // 2
-        out_pad = scale - 1
+        out_pad = (self.output_padding if self.output_padding is not None
+                   else scale - 1)
         # torch output size: (H-1)*s - 2p + k + out_pad = H*s for defaults.
         # lax.conv_transpose padding spec: amount of padding on the *output*
         # grid: lo = k - 1 - p, hi = k - 1 - p + out_pad.
@@ -279,6 +348,17 @@ class Dropout(nn.Module):
     def __call__(self, x, train: bool = False):
         return nn.Dropout(self.rate, deterministic=not train,
                           name='drop')(x)
+
+
+class Dropout2d(nn.Module):
+    """torch nn.Dropout2d equivalent: drops whole channels (broadcast over
+    H, W). Same rng contract as Dropout."""
+    rate: float = 0.2
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        return nn.Dropout(self.rate, broadcast_dims=(1, 2),
+                          deterministic=not train, name='drop')(x)
 
 
 # ------------------------------------------------------------- composite heads

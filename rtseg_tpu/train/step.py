@@ -38,15 +38,24 @@ def _shard_map(fn, mesh, in_specs, out_specs):
                          out_specs=out_specs, check_vma=False)
 
 
-def _pin_bn_axis(fn: Callable, axis) -> Callable:
+def _pin_bn_axis(fn: Callable, axis, config=None) -> Callable:
     """jit traces lazily (on first call), but BN modules read the global
-    collective axis at trace time — pin this builder's value right before
-    every call so builders with different strategies can coexist."""
+    collective axis — and Conv the s2d_stem switch — at trace time: pin
+    this builder's values right before every call so builders with
+    different strategies/configs can coexist (a later get_model for an
+    unrelated config cannot silently flip this step's stem packing)."""
+    from ..nn import set_stem_packing
+    s2d = bool(getattr(config, 's2d_stem', False)) if config is not None \
+        else None
+
     def wrapper(*args, **kwargs):
         set_bn_axis(axis)
+        if s2d is not None:
+            set_stem_packing(s2d)
         return fn(*args, **kwargs)
     wrapper.jitted = fn          # expose for AOT lower()/compile() analysis
     wrapper.bn_axis = axis
+    wrapper.s2d_stem = s2d
     return wrapper
 
 
@@ -200,7 +209,8 @@ def build_train_step(config, model, optimizer, mesh: Mesh,
     sharded = _shard_map(step, mesh,
                          in_specs=(P(), bspec, bspec),
                          out_specs=(P(), P()))
-    return _pin_bn_axis(jax.jit(sharded, donate_argnums=(0,)), bn_axis)
+    return _pin_bn_axis(jax.jit(sharded, donate_argnums=(0,)), bn_axis,
+                        config)
 
 
 def _build_train_step_gspmd(config, model, optimizer, mesh: Mesh,
@@ -291,7 +301,7 @@ def _build_train_step_gspmd(config, model, optimizer, mesh: Mesh,
     return _pin_bn_axis(jax.jit(step,
                                 in_shardings=(rep, bsh, bsh),
                                 out_shardings=(rep, rep),
-                                donate_argnums=(0,)), None)
+                                donate_argnums=(0,)), None, config)
 
 
 def build_eval_step(config, model, mesh: Mesh, use_ema: bool = True
@@ -320,7 +330,7 @@ def build_eval_step(config, model, mesh: Mesh, use_ema: bool = True
             jax.jit(forward_cm,
                     in_shardings=(replicated(mesh), batch_sharding(mesh),
                                   batch_sharding(mesh)),
-                    out_shardings=replicated(mesh)), None)
+                    out_shardings=replicated(mesh)), None, config)
 
     def step(state: TrainState, images, masks):
         return lax.psum(forward_cm(state, images, masks), axes)
@@ -328,7 +338,7 @@ def build_eval_step(config, model, mesh: Mesh, use_ema: bool = True
     bspec = batch_spec(mesh)
     sharded = _shard_map(step, mesh, in_specs=(P(), bspec, bspec),
                          out_specs=P())
-    return _pin_bn_axis(jax.jit(sharded), None)
+    return _pin_bn_axis(jax.jit(sharded), None, config)
 
 
 def build_predict_step(config, model, mesh: Optional[Mesh] = None) -> Callable:

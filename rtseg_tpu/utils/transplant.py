@@ -88,6 +88,8 @@ def flax_leaf_order(model, *init_args, rngs=None, **init_kwargs):
                 kind = 'bn'
             elif isinstance(m, nn.LayerNorm):
                 kind = 'layernorm'
+            elif isinstance(m, nn.GroupNorm):
+                kind = 'groupnorm'
             elif isinstance(m, nn.Dense):
                 kind = 'dense'
             elif isinstance(m, PReLU):
@@ -120,6 +122,7 @@ def _torch_kind(mod) -> Optional[str]:
             (tnn.Conv2d, 'conv'),
             (tnn.modules.batchnorm._BatchNorm, 'bn'),
             (tnn.LayerNorm, 'layernorm'),
+            (tnn.GroupNorm, 'groupnorm'),
             (tnn.Linear, 'dense'),
             (tnn.PReLU, 'prelu'),
         ]
@@ -285,8 +288,13 @@ def apply_units(variables, flax_units: Sequence[FlaxUnit],
     batch_stats = jax.tree.map(np.asarray, variables.get('batch_stats', {}))
 
     for i, (fu, tu) in enumerate(zip(flax_units, torch_units)):
+        # both LayerNorm and GroupNorm are a bare {1-d weight, bias} pair in
+        # a state_dict (sd_leaf_units can't tell them apart), and both map to
+        # flax {scale, bias}; accept either naming on the torch side
         ok = (fu.kind == tu.kind or
-              (tu.kind == 'conv4d' and fu.kind in ('conv', 'deconv')))
+              (tu.kind == 'conv4d' and fu.kind in ('conv', 'deconv')) or
+              (fu.kind in ('layernorm', 'groupnorm') and
+               tu.kind in ('layernorm', 'groupnorm')))
         if not ok:
             raise ValueError(f'Kind mismatch at unit {i}:\n'
                              f'{_context(flax_units, torch_units, i)}')
@@ -311,7 +319,7 @@ def apply_units(variables, flax_units: Sequence[FlaxUnit],
                 _tree_set(params, fu.path, 'bias', a['bias'])
                 _tree_set(batch_stats, fu.path, 'mean', a['running_mean'])
                 _tree_set(batch_stats, fu.path, 'var', a['running_var'])
-            elif fu.kind == 'layernorm':
+            elif fu.kind in ('layernorm', 'groupnorm'):
                 _tree_set(params, fu.path, 'scale', a['weight'])
                 _tree_set(params, fu.path, 'bias', a['bias'])
             elif fu.kind == 'prelu':
@@ -554,11 +562,47 @@ def _fix_lite_hrnet(units):
     return units
 
 
+def _fix_regseg(units):
+    # Decoder registers conv_d4_stage1 before conv_d8_stage2 but the forward
+    # finishes the d8 path first (reference regseg.py:147-157)
+    return order_children(units, 'decoder', [
+        'conv_d16', 'conv_d8_stage1', 'conv_d8_stage2', 'conv_d4_stage1',
+        'conv_d4_stage2'])
+
+
+def _fix_smp_unetpp(units):
+    # smp UnetPlusPlusDecoder registers the dense grid ModuleDict
+    # column-major (x_0_0; x_0_1, x_1_1; x_0_2, ...) but the forward walks it
+    # diagonal-major (x_d_d first, then each dense layer)
+    call = ['x_0_0', 'x_1_1', 'x_2_2', 'x_3_3', 'x_0_1', 'x_1_2', 'x_2_3',
+            'x_0_2', 'x_1_3', 'x_0_3', 'x_0_4']
+    return order_children(units, 'decoder.blocks', call)
+
+
+def _fix_smp_manet(units):
+    # MFAB registers SE_ll before SE_hl but gates the (upsampled) high path
+    # first
+    return order_siblings(units, ['SE_hl', 'SE_ll'])
+
+
+def _fix_smp_pan(units):
+    # GAUBlock registers conv1 (the gate) before conv2 (the low-path conv)
+    # but the forward runs conv2 first
+    for g in ('gau3', 'gau2', 'gau1'):
+        units = order_children(units, f'decoder.{g}', ['conv2', 'conv1'])
+    return units
+
+
 # Architectures whose torch registration order differs from call order need a
 # permutation before zipping. Each entry maps model name -> fn(units)->units.
 # Correctness of every entry (and of every identity default) is pinned by
-# tests/test_logit_parity.py (state_dict order must equal hook call order).
+# tests/test_logit_parity.py (state_dict order must equal hook call order);
+# the smp_* entries by tests/test_smp_parity.py.
 SD_REORDER: Dict[str, Callable[[List[TorchUnit]], List[TorchUnit]]] = {
+    'regseg': _fix_regseg,
+    'smp_unetpp': _fix_smp_unetpp,
+    'smp_manet': _fix_smp_manet,
+    'smp_pan': _fix_smp_pan,
     'bisenetv2': _fix_bisenetv2,
     'ddrnet': _fix_ddrnet,
     'stdc': _fix_stdc,

@@ -44,3 +44,36 @@ def load_ref_model_module(model_file: str):
 
 def torch_param_count(model) -> int:
     return sum(p.numel() for p in model.parameters())
+
+
+def load_ref_regseg():
+    """Load reference regseg with the one-line construction bug patched.
+
+    The reference file cannot construct as-is: DBlock passes `groups=` into
+    ConvBNAct, which has no such parameter, so it lands in **kwargs and is
+    forwarded to Activation -> nn.ReLU(groups=...) TypeError (reference
+    modules.py:73-84, regseg.py:74-79). The paper (arXiv:2111.09957) and the
+    surrounding code make the intent unambiguous — grouped 3x3 convs — so
+    the minimal fix is a ConvBNAct variant that routes `groups` to the
+    Conv2d. Nothing else is changed: we rebind the `ConvBNAct` global inside
+    the loaded module so every other line of the reference file runs
+    verbatim from /root/reference.
+    """
+    import torch.nn as tnn
+
+    mod = load_ref_model_module('regseg')
+    ref_modules = sys.modules['models.modules']
+
+    class GroupedConvBNAct(tnn.Sequential):
+        def __init__(self, in_channels, out_channels, kernel_size=3,
+                     stride=1, dilation=1, groups=1, bias=False,
+                     act_type='relu', **kwargs):
+            padding = (kernel_size - 1) // 2 * dilation
+            super().__init__(
+                tnn.Conv2d(in_channels, out_channels, kernel_size, stride,
+                           padding, dilation, groups=groups, bias=bias),
+                tnn.BatchNorm2d(out_channels),
+                ref_modules.Activation(act_type, **kwargs))
+
+    mod.ConvBNAct = GroupedConvBNAct
+    return mod

@@ -1,0 +1,185 @@
+"""Golden-value tests for the augmentation math.
+
+The transforms in rtseg_tpu/data/transforms.py claim albumentations /
+torchvision sampling semantics (reference datasets/cityscapes.py:114-131,
+utils/transforms.py:12-68). Neither library is installed in this image, so
+these tests freeze input/output vectors derived BY HAND from the documented
+formulas — pinning the transforms to external semantics instead of to
+themselves. Every expected number's derivation is shown in comments.
+
+External formula sources:
+  * torchvision.transforms.functional adjust_brightness/contrast/saturation
+    (albumentations ColorJitter implements the same blend equations):
+      brightness: out = img * f
+      contrast:   out = img * f + mean(gray(img)) * (1 - f)
+      saturation: out = img * f + gray(img) * (1 - f)
+    gray = 0.299 R + 0.587 G + 0.114 B  (ITU-R BT.601, what cv2's RGB2GRAY
+    and torchvision's rgb_to_grayscale use)
+  * cv2 INTER_LINEAR: half-pixel mapping src = (dst + 0.5) / scale - 0.5,
+    clamped, linear blend of the two neighbours
+  * cv2 INTER_NEAREST: src = floor(dst / scale)  (cv2's nearest is NOT
+    half-pixel aligned — it floors dst * inv_scale)
+"""
+
+import numpy as np
+import pytest
+
+from rtseg_tpu.data.transforms import (color_jitter, random_scale,
+                                       resize_to_square)
+
+
+class ScriptedRng:
+    """Stand-in for np.random.Generator that returns pre-scripted draws and
+    asserts the sampling ranges the transform is supposed to use."""
+
+    def __init__(self, uniforms=(), perm=(0, 1, 2), expect_ranges=None):
+        self._u = list(uniforms)
+        self._perm = list(perm)
+        self._ranges = list(expect_ranges) if expect_ranges else None
+
+    def uniform(self, lo, hi):
+        if self._ranges:
+            elo, ehi = self._ranges.pop(0)
+            assert (lo, hi) == (elo, ehi), \
+                f'sampling range ({lo}, {hi}) != documented ({elo}, {ehi})'
+        return self._u.pop(0)
+
+    def permutation(self, n):
+        assert n == 3
+        return np.array(self._perm)
+
+
+IMG = np.array([[[10., 20., 30.], [40., 50., 60.]]], np.float32)  # 1x2x3
+
+# per-pixel BT.601 gray of IMG:
+#   p0: .299*10 + .587*20 + .114*30 = 2.99 + 11.74 + 3.42 = 18.15
+#   p1: .299*40 + .587*50 + .114*60 = 11.96 + 29.35 + 6.84 = 48.15
+GRAY = np.array([18.15, 48.15], np.float32)
+
+
+def test_brightness_alone():
+    # brightness=0.5 -> f ~ U(0.5, 1.5); scripted f = 1.5
+    # out = img * 1.5 exactly
+    out = color_jitter(IMG, 0.5, 0.0, 0.0,
+                       ScriptedRng([1.5], perm=(0, 1, 2),
+                                   expect_ranges=[(0.5, 1.5)]))
+    np.testing.assert_allclose(out, IMG * 1.5, atol=1e-4)
+
+
+def test_contrast_alone():
+    # contrast=0.5 -> f ~ U(0.5, 1.5); scripted f = 0.5
+    # mean gray = (18.15 + 48.15) / 2 = 33.15
+    # out = img * 0.5 + 33.15 * 0.5:
+    #   p0: [5, 10, 15]  + 16.575 = [21.575, 26.575, 31.575]
+    #   p1: [20, 25, 30] + 16.575 = [36.575, 41.575, 46.575]
+    out = color_jitter(IMG, 0.0, 0.5, 0.0,
+                       ScriptedRng([0.5], perm=(0, 1, 2),
+                                   expect_ranges=[(0.5, 1.5)]))
+    want = np.array([[[21.575, 26.575, 31.575],
+                      [36.575, 41.575, 46.575]]], np.float32)
+    np.testing.assert_allclose(out, want, atol=2e-3)
+
+
+def test_saturation_alone():
+    # saturation=1.0 -> f ~ U(0, 2); scripted f = 2.0
+    # out = img * 2 - gray(px):
+    #   p0: [20, 40, 60]   - 18.15 = [ 1.85, 21.85, 41.85]
+    #   p1: [80, 100, 120] - 48.15 = [31.85, 51.85, 71.85]
+    out = color_jitter(IMG, 0.0, 0.0, 1.0,
+                       ScriptedRng([2.0], perm=(0, 1, 2),
+                                   expect_ranges=[(0.0, 2.0)]))
+    want = np.array([[[1.85, 21.85, 41.85],
+                      [31.85, 51.85, 71.85]]], np.float32)
+    np.testing.assert_allclose(out, want, atol=2e-3)
+
+
+def test_jitter_fixed_order_composite():
+    # permutation (2, 0, 1): saturation -> brightness -> contrast, with
+    # f_sat = 0.5, f_bright = 1.2, f_contrast = 1.5 (uniform draws pop in
+    # call order). Hand composition:
+    #  1) saturation 0.5: img*.5 + gray*.5
+    #     p0: [5,10,15] + 9.075  = [14.075, 19.075, 24.075]
+    #     p1: [20,25,30] + 24.075 = [44.075, 49.075, 54.075]
+    #  2) brightness 1.2: * 1.2
+    #     p0: [16.89, 22.89, 28.89]
+    #     p1: [52.89, 58.89, 64.89]
+    #  3) contrast 1.5 on the CURRENT image:
+    #     gray p0: .299*16.89 + .587*22.89 + .114*28.89
+    #            = 5.05011 + 13.436430 + 3.293460 = 21.780001 -> 21.78
+    #     gray p1: .299*52.89 + .587*58.89 + .114*64.89
+    #            = 15.814110 + 34.568430 + 7.397460 = 57.78
+    #     mean = (21.78 + 57.78)/2 = 39.78
+    #     out = img*1.5 - 39.78*0.5 = img*1.5 - 19.89
+    #     p0: [25.335, 34.335, 43.335] - 19.89 = [ 5.445, 14.445, 23.445]
+    #     p1: [79.335, 88.335, 97.335] - 19.89 = [59.445, 68.445, 77.445]
+    out = color_jitter(IMG, 0.2, 0.5, 0.5,
+                       ScriptedRng([0.5, 1.2, 1.5], perm=(2, 0, 1),
+                                   expect_ranges=[(0.5, 1.5), (0.8, 1.2),
+                                                  (0.5, 1.5)]))
+    want = np.array([[[5.445, 14.445, 23.445],
+                      [59.445, 68.445, 77.445]]], np.float32)
+    np.testing.assert_allclose(out, want, atol=5e-3)
+
+
+def test_random_scale_bilinear_upx2():
+    # scale_limit (1.0, 1.0) -> factor = 1 + U(1,1) = 2.0
+    # cv2 INTER_LINEAR, 2 -> 4 in each axis: src = (d + 0.5)/2 - 0.5
+    #   d0: -0.25 (clamped)  -> v0
+    #   d1:  0.25            -> 0.75 v0 + 0.25 v1
+    #   d2:  0.75            -> 0.25 v0 + 0.75 v1
+    #   d3:  1.25 (clamped)  -> v1
+    # columns (v0, v1) = (0, 100): [0, 25, 75, 100]
+    img = np.zeros((2, 2, 3), np.float32)
+    img[:, 1, :] = 100.0
+    mask = np.array([[0, 1], [2, 3]], np.uint8)
+    out, mout = random_scale(img, mask, (1.0, 1.0), ScriptedRng([1.0]))
+    assert out.shape == (4, 4, 3)
+    np.testing.assert_allclose(out[0, :, 0], [0, 25, 75, 100], atol=1e-4)
+    # cv2 INTER_NEAREST up x2: src = floor(d * 0.5) -> [0, 0, 1, 1]
+    np.testing.assert_array_equal(mout[0], [0, 0, 1, 1])
+    np.testing.assert_array_equal(mout[:, 0], [0, 0, 2, 2])
+
+
+def test_random_scale_bilinear_downx2():
+    # factor = 1 + U(-0.5, -0.5) = 0.5; 4 -> 2: src = (d + 0.5)*2 - 0.5
+    #   d0: 0.5 -> (v0 + v1)/2;  d1: 2.5 -> (v2 + v3)/2
+    # row ramp [0, 10, 20, 30] -> [5, 25]
+    img = np.tile(np.array([0., 10., 20., 30.], np.float32)[None, :, None],
+                  (4, 1, 3))
+    mask = np.tile(np.array([0, 1, 2, 3], np.uint8)[None, :], (4, 1))
+    out, mout = random_scale(img, mask, (-0.5, -0.5), ScriptedRng([-0.5]))
+    assert out.shape == (2, 2, 3)
+    np.testing.assert_allclose(out[0, :, 0], [5, 25], atol=1e-4)
+    # nearest down x2: src = floor(d * 2) -> [0, 2]
+    np.testing.assert_array_equal(mout[0], [0, 2])
+
+
+def test_resize_to_square_pad_then_identity():
+    # 2x4 -> zero-pad to 4x4 (vp = (4-2)//2 = 1 row top+bottom, hp = 0),
+    # then resize 4x4 -> 4x4 is identity
+    img = np.arange(2 * 4 * 3, dtype=np.float32).reshape(2, 4, 3)
+    mask = np.arange(8, dtype=np.uint8).reshape(2, 4) + 1
+    out, mout = resize_to_square(img, mask, 4)
+    assert out.shape == (4, 4, 3)
+    np.testing.assert_array_equal(out[0], np.zeros((4, 3)))
+    np.testing.assert_array_equal(out[3], np.zeros((4, 3)))
+    np.testing.assert_array_equal(out[1], img[0])
+    np.testing.assert_array_equal(out[2], img[1])
+    np.testing.assert_array_equal(mout[1], mask[0])
+    np.testing.assert_array_equal(mout[0], np.zeros(4))
+
+
+def test_resize_to_square_downscale():
+    # 2x4 -> pad to 4x4 with rows [0, r0, r1, 0] -> bilinear 4 -> 2:
+    # rows: src = (d + 0.5)*2 - 0.5 -> d0: 0.5 -> (0 + r0)/2,
+    #                                  d1: 2.5 -> (r1 + 0)/2
+    # within a row the same mapping blends columns c0..c3 -> (c0+c1)/2 etc.
+    img = np.zeros((2, 4, 3), np.float32)
+    img[0, :, 0] = [8, 16, 24, 32]
+    img[1, :, 0] = [40, 48, 56, 64]
+    out, _ = resize_to_square(img, None, 2)
+    assert out.shape == (2, 2, 3)
+    # d(0,0): rows (0, r0)/2, cols (c0, c1)/2 -> ((0+0)/2 + (8+16)/2)/2 = 6
+    # d(0,1): ((0+0)/2 + (24+32)/2)/2 = 14
+    # d(1,0): ((40+48)/2 + 0)/2 = 22;  d(1,1): ((56+64)/2 + 0)/2 = 30
+    np.testing.assert_allclose(out[:, :, 0], [[6, 14], [22, 30]], atol=1e-4)

@@ -261,10 +261,22 @@ def test_bisenetv1_logit_parity():
                         'bisenetv1')
 
 
+def test_regseg_logit_parity():
+    """36/36: the one previously-excused model. The reference file throws at
+    construction (groups -> Activation TypeError, reference
+    modules.py:73-84); reference_loader.load_ref_regseg patches exactly that
+    one class (routing `groups` to the Conv2d, as the paper intends) and
+    every other reference line runs verbatim."""
+    from reference_loader import load_ref_regseg
+    ref = load_ref_regseg()
+    from rtseg_tpu.models.regseg import RegSeg
+    assert_logits_match(ref.RegSeg(num_class=NC), RegSeg(num_class=NC),
+                        'regseg')
+
+
 # Backbone models whose reference builds a torchvision resnet/mobilenet_v2:
 # constructable offline through tests/tv_stub.py (structural stub). Ends the
-# round-1 shape-only excuse for all of them; regseg stays excused (reference
-# unconstructable, modules.py:73-84 Activation TypeError).
+# round-1 shape-only excuse for all of them.
 BACKBONE_PARITY = [
     ('linknet', 'LinkNet'),
     ('swiftnet', 'SwiftNet'),

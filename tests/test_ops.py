@@ -102,3 +102,61 @@ def test_adaptive_max_pool_matches_torch(out):
     t = F.adaptive_max_pool2d(torch.from_numpy(x).permute(0, 3, 1, 2), out)
     want = t.permute(0, 2, 3, 1).numpy()
     np.testing.assert_allclose(got, want)
+
+
+def test_s2d_stem_equivalence():
+    """s2d_stem packing (nn/modules.py _PackedStemConv) is an exact
+    weight-space rewrite of the k3/s2 3-channel stem conv: same params
+    (shape AND path), same output to fp tolerance."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from rtseg_tpu.nn import Conv, set_stem_packing
+
+    x = jnp.asarray(np.random.RandomState(0).rand(2, 32, 48, 3)
+                    .astype(np.float32))
+    conv = Conv(24, 3, 2, use_bias=True)
+    try:
+        set_stem_packing(False)
+        v = conv.init(jax.random.PRNGKey(0), x)
+        y_ref = conv.apply(v, x)
+        set_stem_packing(True)
+        v_packed = conv.init(jax.random.PRNGKey(0), x)
+        # identical param tree (path + shape): checkpoints carry over
+        assert jax.tree.map(lambda a: a.shape, v) \
+            == jax.tree.map(lambda a: a.shape, v_packed)
+        y_packed = conv.apply(v, x)
+        np.testing.assert_allclose(np.asarray(y_packed), np.asarray(y_ref),
+                                   atol=1e-5, rtol=1e-5)
+    finally:
+        set_stem_packing(False)
+
+
+def test_s2d_stem_model_level():
+    """Flag through config: fastscnn logits identical with/without packing
+    for the same weights (the gate condition only rewrites input-consuming
+    k3/s2 convs; everything else is untouched)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from rtseg_tpu.config import SegConfig
+    from rtseg_tpu.models import get_model
+    from rtseg_tpu.nn import set_stem_packing
+
+    x = jnp.asarray(np.random.RandomState(1).rand(1, 64, 64, 3)
+                    .astype(np.float32))
+    try:
+        cfg = SegConfig(dataset='synthetic', model='fastscnn', num_class=5,
+                        compute_dtype='float32', save_dir='/tmp/rtseg_s2d')
+        cfg.resolve(num_devices=1)
+        m = get_model(cfg)                       # sets packing off
+        v = m.init(jax.random.PRNGKey(0), x, False)
+        y_off = m.apply(v, x, False)
+
+        cfg2 = cfg.replace(s2d_stem=True)
+        m2 = get_model(cfg2)                     # sets packing on
+        y_on = m2.apply(v, x, False)             # same weights
+        np.testing.assert_allclose(np.asarray(y_on), np.asarray(y_off),
+                                   atol=1e-5, rtol=1e-5)
+    finally:
+        set_stem_packing(False)

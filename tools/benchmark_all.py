@@ -82,6 +82,9 @@ def _compiled_flops(compiled) -> float:
         return 0.0
 
 
+BENCH_S2D = {'on': False}        # set by --s2d; threaded via SegConfig
+
+
 def bench_forward(name, batch, h, w, queue, trials):
     import jax
     import jax.numpy as jnp
@@ -89,7 +92,8 @@ def bench_forward(name, batch, h, w, queue, trials):
     from rtseg_tpu.models import get_model
 
     cfg = SegConfig(dataset='synthetic', model=name, num_class=19,
-                    compute_dtype=BENCH_COMPUTE_DTYPE, save_dir='/tmp/rtseg_bench')
+                    compute_dtype=BENCH_COMPUTE_DTYPE,
+                    s2d_stem=BENCH_S2D['on'], save_dir='/tmp/rtseg_bench')
     cfg.resolve(num_devices=1)
     model = get_model(cfg)
     images = jax.device_put(
@@ -123,7 +127,8 @@ def _setup_state(name, batch, h, w, **cfg_overrides):
     from rtseg_tpu.train.state import create_train_state
 
     cfg = SegConfig(dataset='synthetic', model=name, num_class=19,
-                    compute_dtype=BENCH_COMPUTE_DTYPE, save_dir='/tmp/rtseg_bench',
+                    compute_dtype=BENCH_COMPUTE_DTYPE,
+                    s2d_stem=BENCH_S2D['on'], save_dir='/tmp/rtseg_bench',
                     **cfg_overrides)
     cfg.resolve(num_devices=1)
     cfg.resolve_schedule(train_num=batch * 1000)
@@ -203,12 +208,15 @@ def main() -> int:
     mode.add_argument('--eval', action='store_true',
                       help='benchmark the validation step (EMA forward + '
                            'on-device confusion matrix)')
+    ap.add_argument('--s2d', action='store_true',
+                    help='enable s2d_stem input packing (config.s2d_stem)')
     ap.add_argument('--peak-flops', type=float, default=None,
                     help='override the per-chip peak FLOP/s used for MFU '
                          '(required on device kinds not in '
                          'PEAK_BF16_BY_KIND)')
     args = ap.parse_args()
 
+    BENCH_S2D['on'] = args.s2d
     peak, device_kind = peak_flops(args.peak_flops)
     kind = 'train' if args.train else 'eval' if args.eval else 'forward'
     rows = []

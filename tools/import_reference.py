@@ -30,25 +30,26 @@ def main() -> int:
         pass
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument('--model', type=str, required=True)
+    ap.add_argument('--encoder', type=str, default=None,
+                    help="for --model smp: encoder name (e.g. resnet18, "
+                         "resnet101)")
+    ap.add_argument('--decoder', type=str, default=None,
+                    help='for --model smp: one of the 9 smp decoders')
     ap.add_argument('--num_class', type=int, required=True)
     ap.add_argument('--use_aux', action='store_true')
     ap.add_argument('--use_detail_head', action='store_true')
     ap.add_argument('--pth', type=str, required=True,
-                    help='reference .pth checkpoint')
+                    help='reference .pth checkpoint (incl. smp-family '
+                         'checkpoints such as the published KD teacher)')
     ap.add_argument('--out', type=str, required=True,
                     help='output orbax checkpoint directory')
     ap.add_argument('--imgh', type=int, default=64,
                     help='init trace height (any valid size works)')
     ap.add_argument('--imgw', type=int, default=64)
     args = ap.parse_args()
-    if args.model == 'smp':
-        # the reference's smp family delegates to the external
-        # segmentation_models_pytorch library, whose state_dict layout this
-        # importer has no call-order mapping for (SD_REORDER covers the 36
-        # in-repo architectures); fail clearly instead of deep in get_model
-        ap.error("--model smp (reference's segmentation_models_pytorch "
-                 'family) is not supported by the importer; only the 36 '
-                 'in-repo architectures are.')
+    if args.model == 'smp' and not (args.encoder and args.decoder):
+        ap.error('--model smp requires --encoder and --decoder (the '
+                 'reference stores neither in the .pth)')
 
     import jax.numpy as jnp
     from rtseg_tpu.config import SegConfig
@@ -57,14 +58,26 @@ def main() -> int:
     from rtseg_tpu.utils.transplant import load_reference_pth
 
     cfg = SegConfig(dataset='synthetic', model=args.model,
+                    encoder=args.encoder, decoder=args.decoder,
                     num_class=args.num_class, use_aux=args.use_aux,
                     use_detail_head=args.use_detail_head,
                     save_dir='/tmp/rtseg_import')
     cfg.resolve(num_devices=1)
     model = get_model(cfg)
+    # smp reorder fixups are keyed per decoder (smp_unet, smp_pan, ...)
+    reorder_key = (f'smp_{args.decoder}' if args.model == 'smp'
+                   else args.model)
+    # PAN's pyramid ladder needs a trace size whose deepest level survives
+    # three 2x2 max-pools: os16 encoders need >=128, mit (PAN at os32,
+    # reference models/__init__.py:71-75) needs >=256
+    min_side = 0
+    if args.model == 'smp':
+        min_side = 256 if (args.encoder or '').startswith('mit_') else 128
+    imgh = max(args.imgh, min_side)
+    imgw = max(args.imgw, min_side)
     variables = load_reference_pth(
-        args.pth, args.model, model,
-        jnp.zeros((1, args.imgh, args.imgw, 3), jnp.float32))
+        args.pth, reorder_key, model,
+        jnp.zeros((1, imgh, imgw, 3), jnp.float32))
 
     out = path.abspath(args.out)
     save_weights_ckpt(out, variables['params'],
