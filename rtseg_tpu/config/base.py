@@ -169,6 +169,10 @@ class SegConfig:
     # k2/s1 over 12 packed lanes (exact weight-space rewrite, checkpoint-
     # compatible; see nn/modules.py _PackedStemConv)
     s2d_stem: bool = False
+    # segnet-only: compute the two full-res 64-ch stages + classifier in
+    # S2D(2) layout at eval (exact; halves their HBM lane padding — the
+    # bs64 forward OOM hot spot; see models/segnet.py)
+    segnet_pack: bool = False
 
     # ----- Derived fields (filled by resolve(); never set by hand) -----
     train_num: int = 0

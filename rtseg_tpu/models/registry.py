@@ -88,6 +88,9 @@ def get_model(config):
         raise ValueError(f'Model {name} does not support auxiliary heads.')
     if config.use_detail_head:
         raise ValueError(f'Model {name} does not support detail heads.')
+    if name == 'segnet':
+        return cls(num_class=config.num_class,
+                   pack_fullres=getattr(config, 'segnet_pack', False))
     return cls(num_class=config.num_class)
 
 

@@ -3,24 +3,122 @@
 Behavior parity with reference models/segnet.py:14-80: VGG-ish symmetric
 encoder-decoder, argmax-captured 2x2 max pooling at all 5 stages, unpooling
 decoder (one-hot scatter, ops/pool.py), ConvBNAct classifier.
+
+`pack_fullres` (config.segnet_pack) computes the two full-resolution
+64-channel stages in space-to-depth layout (ops/s2d.py): those tensors are
+the model's HBM hot spot — 64 of 128 lanes used, so (8,128) tiling pads
+them 2x, which is what pushes the bs64 forward past 16 GiB (BENCHMARKS.md).
+Packed, they are (H/2, W/2, 256) with zero lane padding; pooling collapses
+to an elementwise max over the 4 sub-position groups and the classifier
+runs packed too, unpacking once at the output. The rewrite is exact (same
+parameter tree, same logits — tests/test_models.py::test_segnet_pack_*);
+eval-path only, which is where the bs64 OOM lives.
 """
 
 from __future__ import annotations
 
+import jax
+import jax.numpy as jnp
 from flax import linen as nn
 
-from ..nn import ConvBNAct
+from ..nn import Activation, ConvBNAct
 from ..ops import max_pool_argmax_2x2, max_unpool_2x2
+from ..ops.s2d import (depth_to_space2, packed_conv3x3,
+                       packed_max_pool_argmax_2x2, packed_max_unpool_2x2,
+                       space_to_depth2)
+
+
+class _PackedKernel(nn.Module):
+    """Inner param holder mirroring nn.Conv's scope ('conv', key 'kernel',
+    ORIGINAL (3,3,ci,co) shape); the conv itself runs packed."""
+    out_channels: int
+    in_channels: int
+
+    @nn.compact
+    def __call__(self, xp):
+        kernel = self.param('kernel', nn.initializers.lecun_normal(),
+                            (3, 3, self.in_channels, self.out_channels),
+                            jnp.float32)
+        return packed_conv3x3(xp, kernel)
+
+
+class _PackedK3(nn.Module):
+    """Scope twin of nn/modules.Conv computing on the packed input."""
+    out_channels: int
+    in_channels: int
+
+    @nn.compact
+    def __call__(self, xp):
+        return _PackedKernel(self.out_channels, self.in_channels,
+                             name='conv')(xp)
+
+
+class _PackedBNParams(nn.Module):
+    """Inner param/stat holder mirroring nn.BatchNorm's scope ('bn')."""
+    features: int
+    epsilon: float = 1e-5
+
+    @nn.compact
+    def __call__(self, xp):
+        scale = self.param('scale', nn.initializers.ones,
+                           (self.features,), jnp.float32)
+        bias = self.param('bias', nn.initializers.zeros,
+                          (self.features,), jnp.float32)
+        mean = self.variable('batch_stats', 'mean',
+                             lambda: jnp.zeros((self.features,), jnp.float32))
+        var = self.variable('batch_stats', 'var',
+                            lambda: jnp.ones((self.features,), jnp.float32))
+        inv = scale / jnp.sqrt(var.value + self.epsilon)
+        mul = jnp.tile(inv, 4).astype(xp.dtype)
+        add = jnp.tile(bias - mean.value * inv, 4).astype(xp.dtype)
+        return xp * mul + add
+
+
+class _PackedEvalBN(nn.Module):
+    """Scope twin of nn/modules.BatchNorm applied to packed channels via
+    4x-tiled running statistics. Eval-only (running stats)."""
+    features: int
+
+    @nn.compact
+    def __call__(self, xp):
+        return _PackedBNParams(self.features, name='bn')(xp)
+
+
+class _PackedConvBNAct(nn.Module):
+    """Scope-compatible twin of ConvBNAct(out, 3) on packed input: the
+    param tree (Conv_0/conv/kernel, BatchNorm_0/bn/{scale,bias}+stats) is
+    identical, so the same weights serve both layouts."""
+    out_channels: int
+    in_channels: int
+    act_type: str = 'relu'
+
+    @nn.compact
+    def __call__(self, xp):
+        xp = _PackedK3(self.out_channels, self.in_channels,
+                       name='Conv_0')(xp)
+        xp = _PackedEvalBN(self.out_channels, name='BatchNorm_0')(xp)
+        return Activation(self.act_type)(xp)
 
 
 class DownsampleBlock(nn.Module):
     out_channels: int
     act_type: str = 'relu'
     extra_conv: bool = False
+    packed: bool = False
 
     @nn.compact
     def __call__(self, x, train=False):
         c = self.out_channels
+        if self.packed and not train:
+            xp = space_to_depth2(x)
+            xp = _PackedConvBNAct(c, x.shape[-1], self.act_type,
+                                  name='ConvBNAct_0')(xp)
+            xp = _PackedConvBNAct(c, c, self.act_type,
+                                  name='ConvBNAct_1')(xp)
+            if self.extra_conv:
+                xp = _PackedConvBNAct(c, c, self.act_type,
+                                      name='ConvBNAct_2')(xp)
+            return packed_max_pool_argmax_2x2(xp)
         x = ConvBNAct(c, 3, act_type=self.act_type)(x, train)
         x = ConvBNAct(c, 3, act_type=self.act_type)(x, train)
         if self.extra_conv:
@@ -32,11 +130,23 @@ class UpsampleBlock(nn.Module):
     out_channels: int
     act_type: str = 'relu'
     extra_conv: bool = False
+    packed: bool = False
 
     @nn.compact
     def __call__(self, x, indices, train=False):
         in_c = x.shape[-1]
         hid = in_c if self.extra_conv else self.out_channels
+        if self.packed and not train:
+            # output stays packed; SegNet unpacks after the classifier
+            xp = packed_max_unpool_2x2(x, indices)
+            xp = _PackedConvBNAct(in_c, in_c, self.act_type,
+                                  name='ConvBNAct_0')(xp)
+            xp = _PackedConvBNAct(hid, in_c, self.act_type,
+                                  name='ConvBNAct_1')(xp)
+            if self.extra_conv:
+                xp = _PackedConvBNAct(self.out_channels, hid, self.act_type,
+                                      name='ConvBNAct_2')(xp)
+            return xp
         x = max_unpool_2x2(x, indices)
         x = ConvBNAct(in_c, 3, act_type=self.act_type)(x, train)
         x = ConvBNAct(hid, 3, act_type=self.act_type)(x, train)
@@ -50,11 +160,14 @@ class SegNet(nn.Module):
     num_class: int = 1
     hid_channel: int = 64
     act_type: str = 'relu'
+    pack_fullres: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         h, a = self.hid_channel, self.act_type
-        x, i1 = DownsampleBlock(h, a, False)(x, train)
+        pk = self.pack_fullres and not train \
+            and x.shape[1] % 2 == 0 and x.shape[2] % 2 == 0
+        x, i1 = DownsampleBlock(h, a, False, packed=pk)(x, train)
         x, i2 = DownsampleBlock(h * 2, a, False)(x, train)
         x, i3 = DownsampleBlock(h * 4, a, True)(x, train)
         x, i4 = DownsampleBlock(h * 8, a, True)(x, train)
@@ -63,5 +176,9 @@ class SegNet(nn.Module):
         x = UpsampleBlock(h * 4, a, True)(x, i4, train)
         x = UpsampleBlock(h * 2, a, True)(x, i3, train)
         x = UpsampleBlock(h, a, False)(x, i2, train)
-        x = UpsampleBlock(h, a, False)(x, i1, train)
+        x = UpsampleBlock(h, a, False, packed=pk)(x, i1, train)
+        if pk:
+            xp = _PackedConvBNAct(self.num_class, h, a,
+                                  name='ConvBNAct_0')(x)
+            return depth_to_space2(xp)
         return ConvBNAct(self.num_class, act_type=a)(x, train)

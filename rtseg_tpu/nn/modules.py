@@ -155,13 +155,11 @@ class _PackedStemConv(nn.Module):
 
     @nn.compact
     def __call__(self, x):
+        from ..ops.s2d import space_to_depth2
         c = x.shape[-1]
         kernel = self.param('kernel', nn.initializers.lecun_normal(),
                             (3, 3, c, self.features), jnp.float32)
-        n, h, w, _ = x.shape
-        xp = x.reshape(n, h // 2, 2, w // 2, 2, c)
-        xp = xp.transpose(0, 1, 3, 2, 4, 5).reshape(n, h // 2, w // 2,
-                                                    4 * c)
+        xp = space_to_depth2(x)
         wp = jnp.zeros((2, 2, 2, 2, c, self.features), kernel.dtype)
         for t in range(2):
             for u in range(2):

@@ -1,0 +1,94 @@
+"""Space-to-depth (factor 2) compute layout for full-resolution stages.
+
+Motivation (BENCHMARKS.md segnet analysis): a full-res 64-channel bf16
+tensor occupies only 64 of the TPU's 128 lanes, so (8,128) tiling pads its
+HBM footprint 2x — segnet's bs64 forward OOMs on exactly those tensors. In
+S2D(2) layout the same tensor is (H/2, W/2, 256): zero lane padding, half
+the resident HBM, and its 3x3 convs become 3x3 convs over 256 lanes (a
+4x-denser MXU reduction; the scattered kernel is 3/4 zeros, so nominal
+FLOPs rise 4x but they ride otherwise-idle MXU columns).
+
+The transforms here are exact weight-space rewrites (no approximation):
+
+  * conv: y[2I+e, 2J+f] = sum w[di,dj] x[2I+e+di-1, ...] with the packed
+    row r = 2(I+T-1)+a gives di = 2T+a-e-1 — a 3x3 packed kernel where
+    each output sub-position (e,f) reads 9 of the 36 (T,a)x(U,b) slots.
+  * 2x2/stride-2 argmax pooling collapses to an elementwise max over the 4
+    sub-position channel groups — no spatial op at all, and the slot index
+    (a*2+b) IS the max_pool_argmax_2x2 index contract.
+  * unpooling is a one-hot select into the 4 groups.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def space_to_depth2(x: jnp.ndarray) -> jnp.ndarray:
+    """(N, H, W, C) -> (N, H/2, W/2, 4C); packed channel = (a*2+b)*C + c."""
+    n, h, w, c = x.shape
+    x = x.reshape(n, h // 2, 2, w // 2, 2, c)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(n, h // 2, w // 2, 4 * c)
+
+
+def depth_to_space2(x: jnp.ndarray) -> jnp.ndarray:
+    """Inverse of space_to_depth2."""
+    n, h2, w2, c4 = x.shape
+    c = c4 // 4
+    x = x.reshape(n, h2, w2, 2, 2, c).transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(n, 2 * h2, 2 * w2, c)
+
+
+def pack_conv3x3_kernel(w: jnp.ndarray) -> jnp.ndarray:
+    """(3, 3, ci, co) k3/s1/p1 HWIO kernel -> (3, 3, 4ci, 4co) operating on
+    S2D(2) layout with 'same' (1,1) padding."""
+    ci, co = int(w.shape[2]), int(w.shape[3])
+    wp = jnp.zeros((3, 3, 2, 2, ci, 2, 2, co), w.dtype)
+    for t in range(3):
+        for u in range(3):
+            for a in range(2):
+                for b in range(2):
+                    for e in range(2):
+                        for f in range(2):
+                            di, dj = 2 * t + a - e - 1, 2 * u + b - f - 1
+                            if 0 <= di <= 2 and 0 <= dj <= 2:
+                                wp = wp.at[t, u, a, b, :, e, f, :].set(
+                                    w[di, dj])
+    return wp.reshape(3, 3, 4 * ci, 4 * co)
+
+
+def packed_conv3x3(xp: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+    """Apply the original (3,3,ci,co) kernel to an S2D(2)-packed input."""
+    wp = pack_conv3x3_kernel(w).astype(xp.dtype)
+    return lax.conv_general_dilated(
+        xp, wp, (1, 1), ((1, 1), (1, 1)),
+        dimension_numbers=('NHWC', 'HWIO', 'NHWC'))
+
+
+def packed_max_pool_argmax_2x2(
+        xp: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """max_pool_argmax_2x2 of the UNPACKED tensor, computed on the packed
+    one: max over the 4 sub-position groups, torch row-major tie-break.
+    Returns ((N,H2,W2,C) values, int8 indices) — the exact
+    ops/pool.py contract."""
+    n, h2, w2, c4 = xp.shape
+    c = c4 // 4
+    g = xp.reshape(n, h2, w2, 4, c)
+    a, b, cc, d = g[:, :, :, 0], g[:, :, :, 1], g[:, :, :, 2], g[:, :, :, 3]
+    vals = jnp.maximum(jnp.maximum(a, b), jnp.maximum(cc, d))
+    idx = jnp.where(
+        a >= vals, jnp.int8(0),
+        jnp.where(b >= vals, jnp.int8(1),
+                  jnp.where(cc >= vals, jnp.int8(2), jnp.int8(3))))
+    return vals, idx
+
+
+def packed_max_unpool_2x2(x: jnp.ndarray, idx: jnp.ndarray) -> jnp.ndarray:
+    """max_unpool_2x2 whose OUTPUT stays S2D(2)-packed: (N,H2,W2,C) values
+    + int8 slot indices -> (N,H2,W2,4C)."""
+    zero = jnp.zeros((), x.dtype)
+    planes = [jnp.where(idx == k, x, zero) for k in range(4)]
+    return jnp.concatenate(planes, axis=-1)

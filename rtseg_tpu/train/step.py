@@ -350,4 +350,4 @@ def build_predict_step(config, model, mesh: Optional[Mesh] = None) -> Callable:
         out = model.apply(variables, images.astype(compute_dtype), False)
         return jnp.argmax(out, axis=-1).astype(jnp.int32)
 
-    return step
+    return _pin_bn_axis(step, None, config)

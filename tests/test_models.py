@@ -305,3 +305,26 @@ def test_aux_detail_variants_trace_under_jit(name, flag):
                            rngs={'dropout': jax.random.PRNGKey(1)})
     (main, heads), _ = jax.eval_shape(train_fwd, variables, x)
     assert main.shape[0] == 1 and len(heads) >= 1
+
+
+def test_segnet_pack_fullres_equivalence():
+    """segnet_pack (S2D layout for the full-res stages, models/segnet.py) is
+    an exact rewrite: identical param tree, identical eval logits."""
+    from rtseg_tpu.models.segnet import SegNet
+    x = jnp.asarray(np.random.RandomState(0).rand(2, 64, 96, 3)
+                    .astype(np.float32))
+    plain = SegNet(num_class=NC)
+    packed = SegNet(num_class=NC, pack_fullres=True)
+    v = plain.init(jax.random.PRNGKey(0), x, False)
+    v2 = packed.init(jax.random.PRNGKey(0), x, False)
+    assert jax.tree.map(lambda a: a.shape, v) \
+        == jax.tree.map(lambda a: a.shape, v2)
+    # randomize batch_stats so BN folding errors can't hide behind 0/1
+    rng = np.random.RandomState(1)
+    bs = jax.tree.map(lambda a: jnp.asarray(
+        rng.uniform(0.5, 1.5, a.shape).astype(np.float32)), v['batch_stats'])
+    v = {'params': v['params'], 'batch_stats': bs}
+    y_plain = plain.apply(v, x, False)
+    y_packed = packed.apply(v, x, False)
+    np.testing.assert_allclose(np.asarray(y_packed), np.asarray(y_plain),
+                               atol=2e-5, rtol=1e-5)

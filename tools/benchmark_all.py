@@ -82,7 +82,8 @@ def _compiled_flops(compiled) -> float:
         return 0.0
 
 
-BENCH_S2D = {'on': False}        # set by --s2d; threaded via SegConfig
+BENCH_S2D = {'on': False,        # set by --s2d; threaded via SegConfig
+             'segnet_pack': False}
 
 
 def bench_forward(name, batch, h, w, queue, trials):
@@ -93,7 +94,9 @@ def bench_forward(name, batch, h, w, queue, trials):
 
     cfg = SegConfig(dataset='synthetic', model=name, num_class=19,
                     compute_dtype=BENCH_COMPUTE_DTYPE,
-                    s2d_stem=BENCH_S2D['on'], save_dir='/tmp/rtseg_bench')
+                    s2d_stem=BENCH_S2D['on'],
+                    segnet_pack=BENCH_S2D['segnet_pack'],
+                    save_dir='/tmp/rtseg_bench')
     cfg.resolve(num_devices=1)
     model = get_model(cfg)
     images = jax.device_put(
@@ -128,8 +131,9 @@ def _setup_state(name, batch, h, w, **cfg_overrides):
 
     cfg = SegConfig(dataset='synthetic', model=name, num_class=19,
                     compute_dtype=BENCH_COMPUTE_DTYPE,
-                    s2d_stem=BENCH_S2D['on'], save_dir='/tmp/rtseg_bench',
-                    **cfg_overrides)
+                    s2d_stem=BENCH_S2D['on'],
+                    segnet_pack=BENCH_S2D['segnet_pack'],
+                    save_dir='/tmp/rtseg_bench', **cfg_overrides)
     cfg.resolve(num_devices=1)
     cfg.resolve_schedule(train_num=batch * 1000)
     model = get_model(cfg)
@@ -210,6 +214,9 @@ def main() -> int:
                            'on-device confusion matrix)')
     ap.add_argument('--s2d', action='store_true',
                     help='enable s2d_stem input packing (config.s2d_stem)')
+    ap.add_argument('--segnet-pack', action='store_true',
+                    help='enable segnet full-res S2D layout '
+                         '(config.segnet_pack; the bs64 OOM mitigation)')
     ap.add_argument('--peak-flops', type=float, default=None,
                     help='override the per-chip peak FLOP/s used for MFU '
                          '(required on device kinds not in '
@@ -217,6 +224,7 @@ def main() -> int:
     args = ap.parse_args()
 
     BENCH_S2D['on'] = args.s2d
+    BENCH_S2D['segnet_pack'] = args.segnet_pack
     peak, device_kind = peak_flops(args.peak_flops)
     kind = 'train' if args.train else 'eval' if args.eval else 'forward'
     rows = []

@@ -1,0 +1,52 @@
+#!/bin/bash
+# Round-3 deferred on-chip measurement queue (run when the axon tunnel is
+# back; one TPU workload at a time — concurrent processes wedge the tunnel).
+# Each step appends to round3_onchip.log; safe to re-run from any step.
+set -x
+cd "$(dirname "$0")/.."
+LOG=round3_onchip.log
+{
+date
+# 0. tunnel sanity (fast jit)
+timeout 300 python -c "import jax; import jax.numpy as jnp; print(jax.devices()); x=jnp.ones((8,8)); print((x@x).sum())" || exit 1
+
+# 1. headline (driver contract)
+python bench.py
+
+# 2. forward MFU rows for the headline models (completes the round-2 column)
+python tools/benchmark_all.py --models bisenetv2,fastscnn,ddrnet,stdc,ppliteseg,esnet,erfnet,mininetv2,fddwnet
+
+# 3. train-step MFU (never measured; VERDICT round-2 #1)
+python tools/benchmark_all.py --train --batch 96 --models bisenetv2,fastscnn,ddrnet,stdc
+
+# 4. s2d stem packing A/B (same models, forward + train)
+python tools/benchmark_all.py --s2d --models bisenetv2,fastscnn,ddrnet,stdc
+python tools/benchmark_all.py --s2d --train --batch 96 --models bisenetv2,fastscnn,ddrnet,stdc
+
+# 5. segnet bs64: baseline repro (expected OOM) then the S2D mitigation
+python tools/benchmark_all.py --models segnet --batch 64
+python tools/benchmark_all.py --models segnet --batch 64 --segnet-pack
+
+# 6. esnet profiler trace (decides the intrinsic-ceiling claim)
+python - <<'EOF'
+import jax, numpy as np, jax.numpy as jnp
+from rtseg_tpu.config import SegConfig
+from rtseg_tpu.models import get_model
+cfg = SegConfig(dataset='synthetic', model='esnet', num_class=19,
+                save_dir='/tmp/rtseg_trace')
+cfg.resolve(num_devices=1)
+m = get_model(cfg)
+x = jax.device_put(np.random.rand(32, 512, 1024, 3).astype(np.float32)
+                   ).astype(jnp.bfloat16)
+v = m.init(jax.random.PRNGKey(0), jnp.zeros((1, 512, 1024, 3)), False)
+f = jax.jit(lambda v, x: m.apply(v, x, False).astype(jnp.float32).sum())
+c = f.lower(v, x).compile()
+c(v, x).block_until_ready()
+with jax.profiler.trace('/root/repo/traces/esnet'):
+    for _ in range(8):
+        r = c(v, x)
+    r.block_until_ready()
+print('trace written to traces/esnet')
+EOF
+date
+} 2>&1 | tee -a "$LOG"
