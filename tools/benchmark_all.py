@@ -70,16 +70,25 @@ def peak_flops(override=None, compute_dtype=BENCH_COMPUTE_DTYPE):
     return peak, kind
 
 
-def _compiled_flops(compiled) -> float:
-    """FLOPs of a compiled program per XLA's own cost analysis (same source
-    as tools/get_model_infos.py); 0.0 when unavailable."""
+def compiled_costs(compiled) -> tuple:
+    """(FLOPs, bytes accessed) of a compiled program per XLA's own cost
+    analysis (same source as tools/get_model_infos.py); zeros when
+    unavailable. The list/tuple unwrap tracks a cost_analysis return-shape
+    change across JAX versions."""
     try:
         cost = compiled.cost_analysis()
         if isinstance(cost, (list, tuple)):
             cost = cost[0]
-        return float(cost.get('flops', 0.0)) if cost else 0.0
+        if not cost:
+            return 0.0, 0.0
+        return (float(cost.get('flops', 0.0)),
+                float(cost.get('bytes accessed', 0.0)))
     except Exception:
-        return 0.0
+        return 0.0, 0.0
+
+
+def _compiled_flops(compiled) -> float:
+    return compiled_costs(compiled)[0]
 
 
 BENCH_S2D = {'on': False,        # set by --s2d; threaded via SegConfig
