@@ -147,3 +147,26 @@ def test_import_reference_cli_smp(tmp_path):
                      jnp.asarray(x), False)
     np.testing.assert_allclose(np.transpose(np.asarray(yf), (0, 3, 1, 2)),
                                yt.numpy(), atol=1e-4, rtol=1e-4)
+
+
+def test_roofline_lane_occupancy():
+    """The lane-occupancy estimate (tools/roofline.py) encodes the round-3
+    trace finding: thin-channel convs get batch-in-lanes layouts, so
+    occupancy grows with batch and saturates at one element per lane
+    (bs128). Tiny spatial dims keep the jaxpr trace fast — occupancy only
+    reads channel/batch extents, which don't depend on H/W."""
+    sys.path.insert(0, path.join(ROOT, 'tools'))
+    try:
+        from roofline import lane_occupancy
+    finally:
+        sys.path.pop(0)
+
+    occ32 = lane_occupancy('esnet', 32, 64, 128)
+    occ128 = lane_occupancy('esnet', 128, 64, 128)
+    assert 0.0 < occ32 < 1.0          # 16-ch stages can't fill 128 lanes
+    assert occ32 < occ128             # batch fills lanes
+    assert occ128 == pytest.approx(1.0)   # one element per lane: saturated
+
+    # a wide-channel model is lane-full even at small batch for most bytes
+    occ_wide = lane_occupancy('bisenetv2', 32, 64, 128)
+    assert occ_wide > occ32

@@ -8,6 +8,13 @@ device ridge point R = peak_flops/HBM_bw decides the bound:
 
     attainable FLOP/s = min(peak, I * bw)   ->   ceiling MFU = attainable/peak
 
+Round-3 addition: a bytes-weighted vector-lane occupancy estimate per
+model (see lane_occupancy) scales the bandwidth term — thin-channel convs
+get batch-in-lanes layouts on TPU, so at small batch most of the 128
+lanes carry padding and the plain roofline over-predicts the attainable
+bandwidth. The lane-adjusted ceiling explains the measured bs32 vs bs128
+gap (BENCHMARKS.md round-3 section).
+
 Caveat stated up front: 'bytes accessed' is measured on the *compiling*
 backend's post-fusion HLO. The default --backend cpu compiles everywhere
 but fuses differently from TPU (typically over-counting bytes, so the
@@ -36,7 +43,10 @@ DEFAULT_MODELS = ('fastscnn,bisenetv2,ddrnet,stdc,ppliteseg,enet,esnet,'
                   'erfnet,mininetv2,fddwnet')
 
 
-def analyze(name, batch, h, w):
+LANES = 128  # v5e vector lanes; one tile minor dim
+
+
+def _model_forward(name, batch, h, w):
     import jax
     import jax.numpy as jnp
     from rtseg_tpu.config import SegConfig
@@ -50,8 +60,56 @@ def analyze(name, batch, h, w):
         lambda: m.init(jax.random.PRNGKey(0),
                        jnp.zeros((1, h, w, 3), jnp.float32), False))
     x = jax.ShapeDtypeStruct((batch, h, w, 3), jnp.bfloat16)
-    f = jax.jit(lambda v, x: m.apply(v, x, False).astype(jnp.float32).sum())
-    return compiled_costs(f.lower(shapes, x).compile())
+    fn = lambda v, x: m.apply(v, x, False).astype(jnp.float32).sum()  # noqa: E731
+    return fn, shapes, x
+
+
+def _costs(fn, shapes, x):
+    import jax
+    return compiled_costs(jax.jit(fn).lower(shapes, x).compile())
+
+
+def lane_occupancy(name, batch, h, w):
+    """Bytes-weighted vector-lane occupancy estimate over the model's convs.
+
+    The round-3 esnet profiler trace (BENCHMARKS.md) showed XLA compiles
+    convs whose channel count can't fill the 128 lanes with batch-in-lanes
+    emitters, so the lanes carry whichever of {channels, batch} is larger:
+    per conv output, occ = min(1, max(C_out, B) / 128), weighted by output
+    bytes (the tensors whose traffic the lanes gate). This is the factor
+    the plain byte-count roofline misses — it predicted esnet bs32 at its
+    ceiling when the chip had 4x more lanes to give (233 -> 1237 imgs/sec
+    measured at bs128).
+
+    Walks the *traced* jaxpr (backend-independent, no compile needed).
+    """
+    fn, shapes, x = _model_forward(name, batch, h, w)
+    return _lane_occupancy(fn, shapes, x)
+
+
+def _lane_occupancy(fn, shapes, x):
+    import jax
+
+    jaxpr = jax.make_jaxpr(fn)(shapes, x)
+
+    weighted = total = 0.0
+    def visit(jp):
+        nonlocal weighted, total
+        for eqn in jp.eqns:
+            for sub in eqn.params.values():
+                if hasattr(sub, 'jaxpr'):          # nested (pjit, remat...)
+                    visit(sub.jaxpr)
+            if eqn.primitive.name != 'conv_general_dilated':
+                continue
+            aval = eqn.outvars[0].aval
+            if len(aval.shape) != 4:
+                continue
+            b, c = aval.shape[0], aval.shape[-1]   # NHWC throughout the zoo
+            by = aval.size * aval.dtype.itemsize
+            weighted += by * min(1.0, max(c, b) / LANES)
+            total += by
+    visit(jaxpr.jaxpr)
+    return weighted / total if total else 1.0
 
 
 def main():
@@ -84,34 +142,47 @@ def main():
     ridge = peak / bw
     if not args.json:
         print(f'| model | GFLOPs/img | GB/img | intensity (FLOP/B) | '
-              f'roofline-bound | est. ceiling MFU |')
-        print('|---|---|---|---|---|---|')
+              f'roofline-bound | est. ceiling MFU | lane occ @bs{args.batch} '
+              f'| lane-adj ceiling |')
+        print('|---|---|---|---|---|---|---|---|')
     for name in [s.strip() for s in args.models.split(',') if s.strip()]:
         try:
-            flops, bytes_ = analyze(name, args.batch, args.imgh, args.imgw)
+            fn, shapes, x = _model_forward(name, args.batch, args.imgh,
+                                           args.imgw)
+            flops, bytes_ = _costs(fn, shapes, x)
+            occ = _lane_occupancy(fn, shapes, x)
         except Exception as e:
             msg = f'{type(e).__name__}: {e}'.replace('|', '/')
             msg = ' '.join(msg.split())[:120]
             if args.json:
                 print(json.dumps({'model': name, 'error': msg}), flush=True)
             else:
-                print(f'| {name} | FAILED: {msg} | — | — | — | — |',
+                print(f'| {name} | FAILED: {msg} | — | — | — | — | — | — |',
                       flush=True)
             continue
         fpi, bpi = flops / args.batch, bytes_ / args.batch
         inten = fpi / bpi if bpi else float('inf')
         attain = min(peak, inten * bw)
+        # lanes carrying padding derate *effective* bandwidth, so the
+        # adjusted ceiling scales the bandwidth term by occupancy; this can
+        # pull a nominally compute-bound shape below peak too (padding
+        # traffic is real even when intensity clears the ridge)
+        attain_occ = min(peak, inten * bw * occ)
         if args.json:
             print(json.dumps({'model': name,
                               'gflops_per_img': round(fpi / 1e9, 3),
                               'gb_per_img': round(bpi / 1e9, 4),
                               'intensity': round(inten, 2),
-                              'ceiling_mfu': round(attain / peak, 4)}),
+                              'ceiling_mfu': round(attain / peak, 4),
+                              'lane_occupancy': round(occ, 4),
+                              'lane_adj_ceiling_mfu':
+                                  round(attain_occ / peak, 4)}),
                   flush=True)
         else:
             bound = 'compute' if inten >= ridge else 'bandwidth'
             print(f'| {name} | {fpi / 1e9:.2f} | {bpi / 1e9:.3f} | '
-                  f'{inten:.1f} | {bound} | {100 * attain / peak:.1f}% |',
+                  f'{inten:.1f} | {bound} | {100 * attain / peak:.1f}% | '
+                  f'{occ:.2f} | {100 * attain_occ / peak:.1f}% |',
                   flush=True)
     if not args.json:
         print(f'\nridge point: {ridge:.0f} FLOP/B '
