@@ -1,0 +1,35 @@
+"""Colormapped prediction over a folder of images (reference predict path,
+core/seg_trainer.py:154-191): writes a mask PNG and an alpha-blend overlay
+per input image.
+
+    python examples/predict_folder.py --test_data_folder imgs/ \
+        --load_ckpt_path save/bisenetv2_cityscapes/best.ckpt
+"""
+
+import sys
+from os import path
+
+sys.path.append(path.dirname(path.dirname(path.abspath(__file__))))
+
+from rtseg_tpu.config import SegConfig, load_parser
+from rtseg_tpu.train import SegTrainer
+
+config = SegConfig(
+    dataset='cityscapes',           # sets eval transform + colormap source
+    num_class=19,
+    model='bisenetv2',
+    is_testing=True,
+    test_data_folder='imgs/',
+    colormap='cityscapes',
+    save_mask=True,
+    blend_prediction=True,
+    blend_alpha=0.3,
+    load_ckpt_path='save/bisenetv2_cityscapes/best.ckpt',
+    save_dir='save/predict',
+)
+
+if __name__ == '__main__':
+    if len(sys.argv) > 1:
+        config = load_parser(config)
+    config.resolve()
+    SegTrainer(config).predict()

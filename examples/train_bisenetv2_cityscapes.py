@@ -1,0 +1,47 @@
+"""The reference's headline recipe: BiSeNetv2 on Cityscapes, 800 epochs,
+crop 1024x1024, aux-head OHEM, EMA (reference README.md:175 training
+protocol; config surface of configs/my_config.py:4-50).
+
+Expects the standard Cityscapes layout under --data_root:
+    leftImg8bit/{train,val}/<city>/*.png
+    gtFine/{train,val}/<city>/*_labelIds.png
+
+Run (defaults below are the full recipe; trim total_epoch to smoke-test):
+    python examples/train_bisenetv2_cityscapes.py
+Any field can be overridden from the CLI, e.g.:
+    python examples/train_bisenetv2_cityscapes.py --total_epoch 2 --train_bs 4
+"""
+
+import sys
+from os import path
+
+sys.path.append(path.dirname(path.dirname(path.abspath(__file__))))
+
+from rtseg_tpu.config import SegConfig, load_parser
+from rtseg_tpu.train import SegTrainer
+
+config = SegConfig(
+    dataset='cityscapes',
+    data_root='data/cityscapes',
+    num_class=19,
+    model='bisenetv2',
+    use_aux=True,                   # 4 aux heads (models/bisenetv2.py)
+    aux_coef=(1.0, 1.0, 1.0, 1.0),
+    loss_type='ohem',
+    total_epoch=800,
+    train_bs=16,                    # per device; scale down for small HBM
+    base_lr=0.05,
+    use_ema=True,
+    # augmentation stack of reference datasets/cityscapes.py:114-124
+    crop_size=1024,
+    randscale=(-0.5, 1.0),
+    brightness=0.5, contrast=0.5, saturation=0.5,
+    h_flip=0.5,
+    save_dir='save/bisenetv2_cityscapes',
+)
+
+if __name__ == '__main__':
+    if len(sys.argv) > 1:
+        config = load_parser(config)
+    config.resolve()
+    SegTrainer(config).run()

@@ -1,0 +1,41 @@
+"""Train FastSCNN on a custom dataset (reference datasets/custom.py:12-84
+layout): --data_root points at a directory with
+
+    data.yaml            # {path: ..., names: [...]} class list
+    train/imgs  train/masks
+    val/imgs    val/masks
+
+`utils/check_datasets.py` converts labelme JSON annotations into this
+layout. Images are padded square and resized to train_size.
+
+    python examples/train_fastscnn_custom.py --data_root my_dataset
+"""
+
+import sys
+from os import path
+
+sys.path.append(path.dirname(path.dirname(path.abspath(__file__))))
+
+from rtseg_tpu.config import SegConfig, load_parser
+from rtseg_tpu.train import SegTrainer
+
+config = SegConfig(
+    dataset='custom',
+    data_root='my_dataset',
+    num_class=2,                    # must match data.yaml names
+    model='fastscnn',
+    loss_type='ce',
+    total_epoch=100,
+    train_bs=8,
+    base_lr=0.01,
+    train_size=512,                 # pad-to-square then resize
+    test_size=512,
+    h_flip=0.5,
+    save_dir='save/fastscnn_custom',
+)
+
+if __name__ == '__main__':
+    if len(sys.argv) > 1:
+        config = load_parser(config)
+    config.resolve()
+    SegTrainer(config).run()

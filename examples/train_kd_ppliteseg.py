@@ -1,0 +1,55 @@
+"""Knowledge distillation: PP-LiteSeg student with a frozen DeepLabV3+/
+ResNet-101 teacher (the reference's published 79.20-mIoU teacher,
+README.md:201; teacher loading at models/__init__.py:102-122, KD loss at
+core/loss.py:80-87).
+
+The teacher checkpoint comes from the reference ecosystem via the
+migration CLI (MIGRATION.md):
+
+    python tools/import_reference.py --model smp --encoder resnet101 \
+        --decoder deeplabv3p --num_class 19 \
+        --pth teacher_dlv3p_r101.pth --out save/teacher_dlv3p_r101.ckpt
+
+Then:
+    python examples/train_kd_ppliteseg.py
+"""
+
+import sys
+from os import path
+
+sys.path.append(path.dirname(path.dirname(path.abspath(__file__))))
+
+from rtseg_tpu.config import SegConfig, load_parser
+from rtseg_tpu.train import SegTrainer
+
+config = SegConfig(
+    dataset='cityscapes',
+    data_root='data/cityscapes',
+    num_class=19,
+    model='ppliteseg',
+    loss_type='ohem',
+    total_epoch=800,
+    train_bs=16,
+    base_lr=0.02,
+    use_ema=True,
+    crop_size=1024,
+    randscale=(-0.5, 1.0),
+    brightness=0.5, contrast=0.5, saturation=0.5,
+    h_flip=0.5,
+    # --- distillation (teacher forward runs frozen inside the jit step) ---
+    kd_training=True,
+    teacher_ckpt='save/teacher_dlv3p_r101.ckpt',
+    teacher_model='smp',
+    teacher_encoder='resnet101',
+    teacher_decoder='deeplabv3p',
+    kd_loss_type='kl_div',
+    kd_temperature=4.0,
+    kd_loss_coefficient=1.0,
+    save_dir='save/kd_ppliteseg',
+)
+
+if __name__ == '__main__':
+    if len(sys.argv) > 1:
+        config = load_parser(config)
+    config.resolve()
+    SegTrainer(config).run()

@@ -170,3 +170,18 @@ def test_roofline_lane_occupancy():
     # a wide-channel model is lane-full even at small batch for most bytes
     occ_wide = lane_occupancy('bisenetv2', 32, 64, 128)
     assert occ_wide > occ32
+
+
+@pytest.mark.parametrize('script', [
+    'train_bisenetv2_cityscapes.py', 'train_fastscnn_custom.py',
+    'train_kd_ppliteseg.py', 'predict_folder.py'])
+def test_examples_parse(script):
+    """Every example script builds its SegConfig and enters the CLI parser
+    (--help exits 0 before touching data/accelerator) — keeps the
+    ready-to-edit configs in examples/ from rotting as fields change."""
+    r = subprocess.run(
+        [sys.executable, path.join(ROOT, 'examples', script), '--help'],
+        capture_output=True, text=True, timeout=300,
+        env={**os.environ, 'JAX_PLATFORMS': 'cpu',
+             'XLA_FLAGS': '--xla_force_host_platform_device_count=1'})
+    assert r.returncode == 0, (r.stdout + r.stderr)[-2000:]

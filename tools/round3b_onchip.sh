@@ -1,0 +1,25 @@
+#!/bin/bash
+# Round-3 second on-chip queue — batch/lane follow-ups to the measurements
+# in round3_onchip.log (one TPU workload at a time; appends to
+# round3b_onchip.log; safe to re-run from any step).
+#
+# Motivation (BENCHMARKS.md round-3 section): bs128 fills the 128 vector
+# lanes for batch-in-lanes conv layouts. The train table was measured at
+# bs96 and the full-res eval table at bs8 — both leave lanes empty.
+set -x
+cd "$(dirname "$0")/.."
+LOG=round3b_onchip.log
+{
+date
+# 0. tunnel sanity
+timeout 300 python -c "import jax; import jax.numpy as jnp; print(jax.devices()); x=jnp.ones((8,8)); print((x@x).sum())" || exit 1
+
+# 1. train step at lane-filling bs128 (bisenetv2 OOMed at bs128 in round 2;
+#    the others were never tried)
+python tools/benchmark_all.py --train --batch 128 --models fastscnn,stdc,ddrnet
+
+# 2. full-res eval at lane-filling batch (table stands at bs8)
+python tools/benchmark_all.py --eval --batch 32 --imgh 1024 --imgw 2048 --models fastscnn,ppliteseg,stdc,ddrnet
+python tools/benchmark_all.py --eval --batch 16 --imgh 1024 --imgw 2048 --models bisenetv2
+date
+} 2>&1 | tee -a "$LOG"
