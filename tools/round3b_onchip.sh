@@ -6,7 +6,7 @@
 # Motivation (BENCHMARKS.md round-3 section): bs128 fills the 128 vector
 # lanes for batch-in-lanes conv layouts. The train table was measured at
 # bs96 and the full-res eval table at bs8 — both leave lanes empty.
-set -x
+set -x -o pipefail
 cd "$(dirname "$0")/.."
 LOG=round3b_onchip.log
 {
@@ -23,3 +23,4 @@ python tools/benchmark_all.py --eval --batch 32 --imgh 1024 --imgw 2048 --models
 python tools/benchmark_all.py --eval --batch 16 --imgh 1024 --imgw 2048 --models bisenetv2
 date
 } 2>&1 | tee -a "$LOG"
+exit "${PIPESTATUS[0]}"
