@@ -43,17 +43,43 @@ def test_export_roundtrip_argmax(cfg, tmp_path):
     assert mismatch < 0.005, f'argmax mismatch fraction {mismatch:.4f}'
 
 
-def test_export_logits_and_poly_batch(cfg, tmp_path):
-    exported = export_model(cfg, imgh=64, imgw=64, batch=None, argmax=False)
-    path = save_exported(exported, str(tmp_path / 'fastscnn_logits'))
-    reloaded = load_exported(path)
+def _roundtrip_logits_poly_batch(c, out_path):
+    """Symbolic-batch logits export: serialize -> reload -> compare against
+    the in-process model at bs 1 and 3 (poly-batch refinement can
+    degenerate at b=1, e.g. reshape-based S2D/PixelShuffle paths)."""
+    exported = export_model(c, imgh=64, imgw=64, batch=None, argmax=False)
+    reloaded = load_exported(save_exported(exported, str(out_path)))
 
-    model = get_model(cfg)
+    model = get_model(c)
     variables = model.init(jax.random.PRNGKey(0),
                            jnp.zeros((1, 64, 64, 3)), False)
     for bs in (1, 3):
         x = np.random.RandomState(bs).rand(bs, 64, 64, 3).astype(np.float32)
         got = np.asarray(reloaded.call(jnp.asarray(x)))
         want = np.asarray(model.apply(variables, jnp.asarray(x), False))
-        assert got.shape == (bs, 64, 64, 19)
+        assert got.shape == (bs, 64, 64, c.num_class)
         np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_export_logits_and_poly_batch(cfg, tmp_path):
+    _roundtrip_logits_poly_batch(cfg, tmp_path / 'fastscnn_logits')
+
+
+@pytest.mark.parametrize('name,flags', [
+    ('enet', {}),             # argmax pool/unpool (scatterless rewrite)
+    ('lednet', {}),           # transposed-conv decoder + channel shuffle
+    ('farseenet', {}),        # PixelShuffle sub-pixel upsampling
+    ('lite_hrnet', {}),       # 4-branch fusion, cross-resolution weights
+    ('ddrnet', {}),           # aux model exported in eval mode (ref ONNX
+                              # branch, ddrnet.py:55-58)
+    ('segnet', {'segnet_pack': True}),   # S2D packed layout (round 3)
+])
+def test_export_hard_op_families(name, flags, tmp_path):
+    """jax.export round trip for the op families most at risk under
+    StableHLO serialization with a symbolic batch dimension. Small
+    resolutions; logits head; exactness bar same as the fastscnn pin."""
+    c = SegConfig(dataset='synthetic', model=name, num_class=7,
+                  compute_dtype='float32',
+                  save_dir=str(tmp_path / 'cfg'), **flags)
+    c.resolve(num_devices=1)
+    _roundtrip_logits_poly_batch(c, tmp_path / name)
