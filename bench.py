@@ -35,7 +35,7 @@ def _pick_model():
     raise RuntimeError('no benchmarkable model in registry')
 
 
-def main() -> int:
+def _measure() -> int:
     import jax
     import jax.numpy as jnp
     from rtseg_tpu.config import SegConfig
@@ -75,6 +75,25 @@ def main() -> int:
         'vs_baseline': round(best / base, 3) if base else None,
     }))
     return 0
+
+
+def main() -> int:
+    # the axon tunnel occasionally drops a remote_compile response
+    # mid-read (observed 2026-07-31: "response body closed before all
+    # bytes were read") — transient, the same compile succeeds on retry.
+    # Deliberately retries EVERY exception, not a signature allowlist:
+    # tunnel flakes have varied across rounds, and re-running a
+    # deterministic failure wastes minutes while a misclassified
+    # transient loses the round's headline metric.
+    last = None
+    for attempt in range(3):
+        try:
+            return _measure()
+        except Exception as e:                       # noqa: BLE001
+            last = e
+            print(f'bench attempt {attempt + 1} failed: '
+                  f'{type(e).__name__}: {e}', file=sys.stderr)
+    raise last
 
 
 if __name__ == '__main__':
