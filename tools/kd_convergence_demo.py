@@ -1,0 +1,113 @@
+"""On-chip knowledge-distillation convergence demo — the reference's full
+KD workflow (models/__init__.py:102-122 teacher, core/loss.py:80-87 KL
+loss, seg_trainer.py:95-105 in-step teacher forward) exercised end to end
+on real hardware with an in-framework-trained teacher:
+
+  1. train an smp DeepLabV3+/ResNet-18 teacher on the learnable synthetic
+     dataset and keep its best (EMA) checkpoint;
+  2. train a PP-LiteSeg student WITH the frozen teacher in the jit'd step
+     (kd_training, KL temperature 4);
+  3. train the identical student WITHOUT KD as the control.
+
+Prints one JSON line per phase and a final summary. ~10 min on a v5e chip
+(three compiles dominate). Results recorded in CONVERGENCE.md.
+
+    python tools/kd_convergence_demo.py [--steps 400]
+"""
+
+import argparse
+import json
+import sys
+from os import path
+
+sys.path.append(path.dirname(path.dirname(path.abspath(__file__))))
+
+
+def make_config(tag, **kw):
+    import jax
+
+    from rtseg_tpu.config import SegConfig
+    # keep >=4 steps/epoch whatever the device count (train_bs is
+    # per-device; the CPU-mesh smoke runs this on 8 virtual devices)
+    bs = kw.get('train_bs', 16)
+    base = dict(
+        dataset='synthetic', num_class=6,
+        synthetic_len=4 * bs * jax.device_count(),
+        crop_h=256, crop_w=512, train_bs=bs,
+        loss_type='ce', base_lr=0.02, use_ema=True,
+        val_interval=10, log_interval=0, use_tb=False,
+        random_seed=1,
+        save_dir=f'/tmp/rtseg_kd_demo/{tag}',
+    )
+    base.update(kw)
+    return SegConfig(**base)
+
+
+def train(tag, steps, **kw):
+    import shutil
+
+    from rtseg_tpu.train import SegTrainer
+    import jax
+    cfg = make_config(tag, **kw)
+    shutil.rmtree(cfg.save_dir, ignore_errors=True)   # no stale auto-resume
+    # synthetic_len / global batch steps per epoch
+    iters_per_epoch = max(
+        cfg.synthetic_len // (cfg.train_bs * jax.device_count()), 1)
+    cfg.total_epoch = max(steps // iters_per_epoch, 1)
+    cfg.val_interval = min(cfg.val_interval, cfg.total_epoch)
+    cfg.resolve(num_devices=1)
+    tr = SegTrainer(cfg)
+    tr.run()
+    best = float(tr.best_score)
+    # a short/degenerate run can end with best==0.0 and no best.ckpt written
+    # (the trainer only saves on improvement); the next phase still needs a
+    # loadable teacher, so persist the final EMA weights as the best
+    best_path = path.join(cfg.save_dir, 'best.ckpt')
+    if not path.exists(best_path):
+        from rtseg_tpu.train.checkpoint import save_best_ckpt
+        save_best_ckpt(best_path, tr.state, cfg.total_epoch, best)
+    print(json.dumps({'phase': tag, 'best_miou': round(best, 4),
+                      'steps': steps}), flush=True)
+    return best, cfg
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument('--steps', type=int, default=400)
+    ap.add_argument('--crop_h', type=int, default=256)
+    ap.add_argument('--crop_w', type=int, default=512)
+    ap.add_argument('--train_bs', type=int, default=16,
+                    help='per-device batch (shrink for the CPU-mesh smoke)')
+    args = ap.parse_args()
+    size = dict(crop_h=args.crop_h, crop_w=args.crop_w,
+                train_bs=args.train_bs)
+
+    teacher_best, teacher_cfg = train(
+        'teacher_dlv3p_r18', args.steps,
+        model='smp', encoder='resnet18', decoder='deeplabv3p',
+        encoder_weights=None, **size)
+    teacher_ckpt = path.join(teacher_cfg.save_dir, 'best.ckpt')
+
+    student_kd, _ = train(
+        'student_ppliteseg_kd', args.steps,
+        model='ppliteseg',
+        kd_training=True, teacher_ckpt=teacher_ckpt,
+        teacher_model='smp', teacher_encoder='resnet18',
+        teacher_decoder='deeplabv3p',
+        kd_loss_type='kl_div', kd_temperature=4.0, kd_loss_coefficient=1.0,
+        **size)
+
+    student_plain, _ = train('student_ppliteseg_plain', args.steps,
+                             model='ppliteseg', **size)
+
+    print(json.dumps({
+        'teacher_best_miou': round(teacher_best, 4),
+        'student_kd_best_miou': round(student_kd, 4),
+        'student_plain_best_miou': round(student_plain, 4),
+        'kd_delta': round(student_kd - student_plain, 4),
+    }), flush=True)
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
