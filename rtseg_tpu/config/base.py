@@ -173,6 +173,10 @@ class SegConfig:
     # S2D(2) layout at eval (exact; halves their HBM lane padding — the
     # bs64 forward OOM hot spot; see models/segnet.py)
     segnet_pack: bool = False
+    # bisenetv2-only: rematerialize the DetailBranch in backward (its
+    # high-res activations are the biggest train residuals); math
+    # identical, frees HBM for lane-filling train batches
+    detail_remat: bool = False
 
     # ----- Derived fields (filled by resolve(); never set by hand) -----
     train_num: int = 0

@@ -123,6 +123,7 @@ def get_parser() -> argparse.ArgumentParser:
     p.add_argument('--spatial_partition', type=int)
     p.add_argument('--s2d_stem', type=_bool)
     p.add_argument('--segnet_pack', type=_bool)
+    p.add_argument('--detail_remat', type=_bool)
     p.add_argument('--multihost', action='store_const', const=True)
     p.add_argument('--coordinator_address', type=str)
     p.add_argument('--process_id', type=int)

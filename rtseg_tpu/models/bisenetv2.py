@@ -148,11 +148,21 @@ class BiSeNetv2(nn.Module):
     num_class: int = 1
     act_type: str = 'relu'
     use_aux: bool = True
+    # rematerialize the DetailBranch in the backward pass: its eight
+    # high-resolution activations are the train step's biggest residuals
+    # (41% of step time, trace analysis in BENCHMARKS.md), and dropping
+    # them is what lets the flagship train at the lane-filling bs128.
+    # Param paths are unchanged (nn.remat preserves module names).
+    detail_remat: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         size = x.shape[1:3]
-        x_d = DetailBranch(128, self.act_type)(x, train)
+        detail_cls = (nn.remat(DetailBranch, static_argnums=(2,))
+                      if self.detail_remat else DetailBranch)
+        # pin the scope name: nn.remat's auto-name would be
+        # CheckpointDetailBranch_0, breaking checkpoint/transplant paths
+        x_d = detail_cls(128, self.act_type, name='DetailBranch_0')(x, train)
         x_s, aux = SemanticBranch(128, self.num_class, self.act_type,
                                   self.use_aux)(x, train)
         x = BilateralGuidedAggregationLayer(128, self.act_type)(

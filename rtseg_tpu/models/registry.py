@@ -78,6 +78,9 @@ def get_model(config):
                                config.num_class,
                                encoder_weights=config.encoder_weights)
     cls = model_class(name)
+    if name == 'bisenetv2':
+        return cls(num_class=config.num_class, use_aux=config.use_aux,
+                   detail_remat=getattr(config, 'detail_remat', False))
     if name in AUX_MODELS:
         return cls(num_class=config.num_class, use_aux=config.use_aux)
     if name in DETAIL_HEAD_MODELS:

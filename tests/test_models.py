@@ -328,3 +328,54 @@ def test_segnet_pack_fullres_equivalence():
     y_packed = packed.apply(v, x, False)
     np.testing.assert_allclose(np.asarray(y_packed), np.asarray(y_plain),
                                atol=2e-5, rtol=1e-5)
+
+
+def test_bisenetv2_detail_remat_equivalence():
+    """detail_remat (nn.remat on the DetailBranch, models/bisenetv2.py) is
+    math-identical: same param tree, same train-mode outputs (all heads,
+    batch_stats mutation), same gradients — only the backward's memory
+    schedule changes."""
+    from rtseg_tpu.models.bisenetv2 import BiSeNetv2
+    x = jnp.asarray(np.random.RandomState(0).rand(2, 64, 96, 3)
+                    .astype(np.float32))
+    plain = BiSeNetv2(num_class=NC, use_aux=True)
+    remat = BiSeNetv2(num_class=NC, use_aux=True, detail_remat=True)
+    v = plain.init(jax.random.PRNGKey(0), x, True)
+    v2 = remat.init(jax.random.PRNGKey(0), x, True)
+    assert jax.tree.map(lambda a: a.shape, v) \
+        == jax.tree.map(lambda a: a.shape, v2)
+
+    def loss(model, params):
+        (y, aux), mut = model.apply(
+            {'params': params, 'batch_stats': v['batch_stats']}, x, True,
+            mutable=['batch_stats'])
+        return (y.sum() + sum(a.sum() for a in aux)).astype(jnp.float32)
+
+    l1, g1 = jax.value_and_grad(lambda p: loss(plain, p))(v['params'])
+    l2, g2 = jax.value_and_grad(lambda p: loss(remat, p))(v['params'])
+    np.testing.assert_allclose(np.asarray(l2), np.asarray(l1),
+                               rtol=1e-5, atol=1e-5)
+    flat1 = jax.tree_util.tree_leaves(g1)
+    flat2 = jax.tree_util.tree_leaves(g2)
+    assert len(flat1) == len(flat2)
+    # The remat barrier changes XLA's global fusion plan, so f32 sums
+    # reassociate differently EVERYWHERE (measured: BN-scale grads in the
+    # un-rematted SemanticBranch drift too). Cancellation-dominated leaves
+    # (norm ~1e-2 from ~1e4 near-canceling O(1) terms; conv-bias-into-BN
+    # grads are exactly zero in theory) carry absolute noise ~1e-4, so
+    # element- or small-leaf-relative bars misfire. The same-math
+    # criteria: (1) global gradient rel-L2, (2) per-leaf rel-L2 on leaves
+    # with substantial norm. A real math divergence (wrong kernel,
+    # dropped term) shifts these by O(1) — orders outside both bars.
+    num = den = 0.0
+    for a, b in zip(flat1, flat2):
+        a, b = np.asarray(a, np.float64), np.asarray(b, np.float64)
+        num += float(np.sum((b - a) ** 2))
+        den += float(np.sum(a ** 2))
+        na = np.linalg.norm(a)
+        if na > 0.1:
+            rel_l2 = np.linalg.norm(b - a) / na
+            assert rel_l2 < 1e-3, \
+                f'grad leaf rel-L2 {rel_l2:.2e} (shape {a.shape})'
+    global_rel = (num / den) ** 0.5
+    assert global_rel < 1e-4, f'global grad rel-L2 {global_rel:.2e}'

@@ -92,6 +92,7 @@ def _compiled_flops(compiled) -> float:
 
 
 BENCH_S2D = {'on': False,        # set by --s2d; threaded via SegConfig
+             'detail_remat': False,
              'segnet_pack': False}
 
 
@@ -142,6 +143,7 @@ def _setup_state(name, batch, h, w, **cfg_overrides):
                     compute_dtype=BENCH_COMPUTE_DTYPE,
                     s2d_stem=BENCH_S2D['on'],
                     segnet_pack=BENCH_S2D['segnet_pack'],
+                    detail_remat=BENCH_S2D['detail_remat'],
                     save_dir='/tmp/rtseg_bench', **cfg_overrides)
     cfg.resolve(num_devices=1)
     cfg.resolve_schedule(train_num=batch * 1000)
@@ -223,6 +225,9 @@ def main() -> int:
                            'on-device confusion matrix)')
     ap.add_argument('--s2d', action='store_true',
                     help='enable s2d_stem input packing (config.s2d_stem)')
+    ap.add_argument('--detail-remat', action='store_true',
+                    help='bisenetv2: rematerialize the DetailBranch in '
+                         'backward (frees HBM for larger train batches)')
     ap.add_argument('--segnet-pack', action='store_true',
                     help='enable segnet full-res S2D layout '
                          '(config.segnet_pack; the bs64 OOM mitigation)')
@@ -234,6 +239,7 @@ def main() -> int:
 
     BENCH_S2D['on'] = args.s2d
     BENCH_S2D['segnet_pack'] = args.segnet_pack
+    BENCH_S2D['detail_remat'] = args.detail_remat
     peak, device_kind = peak_flops(args.peak_flops)
     kind = 'train' if args.train else 'eval' if args.eval else 'forward'
     rows = []
