@@ -89,6 +89,8 @@ def main() -> int:
     for attempt in range(3):
         try:
             return _measure()
+        except (ImportError, TypeError, AttributeError, SyntaxError):
+            raise    # deterministic code errors: retrying wastes compiles
         except Exception as e:                       # noqa: BLE001
             last = e
             print(f'bench attempt {attempt + 1} failed: '

@@ -89,9 +89,10 @@ class SegConfig:
     save_dir: str = 'save'
     use_tb: bool = True
     # rank-0 progress line every N train steps (reference shows a live tqdm
-    # bar, core/seg_trainer.py:36,115-119). 0 disables; each line costs one
-    # host<->device sync, so the default keeps steps fully async.
-    log_interval: int = 0
+    # bar, core/seg_trainer.py:36,115-119). 0 disables. The trainer reads
+    # the loss LAGGED by one interval (already materialized), so the line
+    # never stalls the async dispatch queue — which lets it default on.
+    log_interval: int = 50
     tb_log_dir: Optional[str] = None
     ckpt_name: Optional[str] = None
     logger_name: str = 'seg_trainer'
@@ -177,6 +178,18 @@ class SegConfig:
     # high-res activations are the biggest train residuals); math
     # identical, frees HBM for lane-filling train batches
     detail_remat: bool = False
+    # eval confusion matrix via the blocked Pallas kernel
+    # (ops/pallas_metrics.py) instead of the chunked one-hot einsum — same
+    # exact counts, no (n_pixels, C) one-hot HBM temporaries. Measured
+    # faster at the full-res serving shape (round4_onchip.log: bisenetv2
+    # +2.7%, fastscnn +5.7% eval imgs/sec). None = auto: the kernel on
+    # TPU, the einsum elsewhere (interpret-mode Pallas is slow on CPU).
+    use_pallas_metrics: Optional[bool] = None
+    # stdc/ddrnet/ppliteseg: rematerialize the highest-resolution encoder
+    # stages in backward (the generalization of bisenetv2's detail_remat —
+    # drop the big early-stage residuals, keep the cheap deep ones). Math
+    # identical; param paths unchanged (function-scope nn.remat).
+    hires_remat: bool = False
 
     # ----- Derived fields (filled by resolve(); never set by hand) -----
     train_num: int = 0

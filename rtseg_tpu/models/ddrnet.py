@@ -137,6 +137,11 @@ class DDRNet(nn.Module):
     arch_type: str = 'DDRNet-23-slim'
     act_type: str = 'relu'
     use_aux: bool = True
+    # rematerialize the high-resolution prefix (stem..stage3, the 1/2-1/8
+    # activations) and stage4 (both branches incl. the 1/8 high path) in
+    # backward; function-scope nn.remat keeps submodule auto-names, so
+    # param paths and checkpoints are unchanged
+    hires_remat: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -148,20 +153,28 @@ class DDRNet(nn.Module):
         size = x.shape[1:3]
 
         # conv1 + stage2 (1/4) + stage3 (1/8)
-        x = ConvBNAct(ch, 3, 2, act_type=a)(x, train)
-        x = ConvBNAct(ch, 3, 2, act_type=a)(x, train)
-        for _ in range(rep[0]):
-            x = RB(ch, 1, a)(x, train)
-        x = Blocks(RB, ch * 2, 2, rep[1], a)(x, train)
+        def prefix(mdl, x):
+            x = ConvBNAct(ch, 3, 2, act_type=a)(x, train)
+            x = ConvBNAct(ch, 3, 2, act_type=a)(x, train)
+            for _ in range(rep[0]):
+                x = RB(ch, 1, a)(x, train)
+            return Blocks(RB, ch * 2, 2, rep[1], a)(x, train)
 
         # stage4: split into low (1/16) and high (1/8) branches
-        x_low = Blocks(RB, ch * 4, 2, rep[2], a)(x, train)
-        x_high = Blocks(RB, ch * 2, 1, rep[2], a)(x, train)
-        x_low, x_high = BilateralFusion(2, a)(x_low, x_high, train)
-        if rep[3] > 0:
-            x_low = Blocks(RB, ch * 4, 1, rep[3], a)(x_low, train)
-            x_high = Blocks(RB, ch * 2, 1, rep[3], a)(x_high, train)
+        def stage4(mdl, x):
+            x_low = Blocks(RB, ch * 4, 2, rep[2], a)(x, train)
+            x_high = Blocks(RB, ch * 2, 1, rep[2], a)(x, train)
             x_low, x_high = BilateralFusion(2, a)(x_low, x_high, train)
+            if rep[3] > 0:
+                x_low = Blocks(RB, ch * 4, 1, rep[3], a)(x_low, train)
+                x_high = Blocks(RB, ch * 2, 1, rep[3], a)(x_high, train)
+                x_low, x_high = BilateralFusion(2, a)(x_low, x_high, train)
+            return x_low, x_high
+
+        if self.hires_remat:
+            prefix, stage4 = nn.remat(prefix), nn.remat(stage4)
+        x = prefix(self, x)
+        x_low, x_high = stage4(self, x)
 
         if self.use_aux:
             x_aux = SegHead(self.num_class, a, name='aux_head')(x_high, train)

@@ -47,16 +47,30 @@ class STDCBackbone(nn.Module):
     encoder_channels: Sequence[int]
     encoder_type: str = 'stdc1'
     act_type: str = 'relu'
+    # rematerialize the 1/2-1/8-resolution prefix (stems + first STDC
+    # stage) in backward; function-scope nn.remat keeps auto-names so
+    # param paths are unchanged
+    hires_remat: bool = False
 
     @nn.compact
     def __call__(self, x, train=False):
         ec = self.encoder_channels
         rep = REPEAT_TIMES_HUB[self.encoder_type]
         a = self.act_type
-        x = ConvBNAct(ec[0], 3, 2)(x, train)
-        x = ConvBNAct(ec[1], 3, 2)(x, train)
-        feats = []
-        for c, r in zip(ec[2:], rep):
+
+        def prefix(mdl, x):
+            x = ConvBNAct(ec[0], 3, 2)(x, train)
+            x = ConvBNAct(ec[1], 3, 2)(x, train)
+            x = STDCModule(ec[2], 2, a)(x, train)
+            for _ in range(rep[0]):
+                x = STDCModule(ec[2], 1, a)(x, train)
+            return x
+
+        if self.hires_remat:
+            prefix = nn.remat(prefix)
+        x = prefix(self, x)
+        feats = [x]
+        for c, r in zip(ec[3:], rep[1:]):
             x = STDCModule(c, 2, a)(x, train)
             for _ in range(r):
                 x = STDCModule(c, 1, a)(x, train)
@@ -115,6 +129,7 @@ class PPLiteSeg(nn.Module):
     encoder_type: str = 'stdc1'
     fusion_type: str = 'spatial'
     act_type: str = 'relu'
+    hires_remat: bool = False          # see STDCBackbone.hires_remat
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -124,7 +139,7 @@ class PPLiteSeg(nn.Module):
         size = x.shape[1:3]
         a = self.act_type
         x3, x4, x5 = STDCBackbone(self.encoder_channels, self.encoder_type,
-                                  a)(x, train)
+                                  a, hires_remat=self.hires_remat)(x, train)
         x5 = SPPM(dc[0], a)(x5, train)
         x = ConvBNAct(dc[0])(x5, train)
         x = UAFM(dc[0], self.fusion_type)(x, x4, train)

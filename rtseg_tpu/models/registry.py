@@ -78,15 +78,19 @@ def get_model(config):
                                config.num_class,
                                encoder_weights=config.encoder_weights)
     cls = model_class(name)
+    hires = getattr(config, 'hires_remat', False)
     if name == 'bisenetv2':
         return cls(num_class=config.num_class, use_aux=config.use_aux,
                    detail_remat=getattr(config, 'detail_remat', False))
+    if name == 'ddrnet':
+        return cls(num_class=config.num_class, use_aux=config.use_aux,
+                   hires_remat=hires)
     if name in AUX_MODELS:
         return cls(num_class=config.num_class, use_aux=config.use_aux)
-    if name in DETAIL_HEAD_MODELS:
+    if name in DETAIL_HEAD_MODELS:       # detail + aux + remat (stdc)
         return cls(num_class=config.num_class,
                    use_detail_head=config.use_detail_head,
-                   use_aux=config.use_aux)
+                   use_aux=config.use_aux, hires_remat=hires)
     if config.use_aux:
         raise ValueError(f'Model {name} does not support auxiliary heads.')
     if config.use_detail_head:
@@ -94,6 +98,8 @@ def get_model(config):
     if name == 'segnet':
         return cls(num_class=config.num_class,
                    pack_fullres=getattr(config, 'segnet_pack', False))
+    if name == 'ppliteseg':
+        return cls(num_class=config.num_class, hires_remat=hires)
     return cls(num_class=config.num_class)
 
 

@@ -64,6 +64,10 @@ class STDC(nn.Module):
     use_detail_head: bool = False
     use_aux: bool = False
     act_type: str = 'relu'
+    # rematerialize stages 1-3 (the 1/2, 1/4, 1/8-resolution activations —
+    # the train step's biggest residuals) in backward; math identical,
+    # param paths unchanged (setup attribute naming survives nn.remat)
+    hires_remat: bool = False
 
     def setup(self):
         if self.encoder_type not in REPEAT_TIMES_HUB:
@@ -73,9 +77,13 @@ class STDC(nn.Module):
                 'Currently only support either aux-head or detail head.')
         rep = REPEAT_TIMES_HUB[self.encoder_type]
         a = self.act_type
-        self.stage1 = ConvBNAct(32, 3, 2)
-        self.stage2 = ConvBNAct(64, 3, 2)
-        self.stage3 = Stage(256, rep[0], a)
+        CBA = (nn.remat(ConvBNAct, static_argnums=(2,))
+               if self.hires_remat else ConvBNAct)
+        Stg = (nn.remat(Stage, static_argnums=(2,))
+               if self.hires_remat else Stage)
+        self.stage1 = CBA(32, 3, 2)
+        self.stage2 = CBA(64, 3, 2)
+        self.stage3 = Stg(256, rep[0], a)
         self.stage4 = Stage(512, rep[1], a)
         self.stage5 = Stage(1024, rep[2], a)
         if self.use_aux:

@@ -41,7 +41,11 @@ def _cm_kernel(cp: int, t_ref, p_ref, out_ref):
         acc += jax.lax.dot_general(
             oh_t, oh_p, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
-    out_ref[:] += acc
+    # per-block counts are <= _BLOCK (2^16) so the f32 acc is exact; the
+    # CROSS-block running total accumulates in int32 — a single f32 total
+    # would lose exactness past 2^24 per cell (~17M px), under one bs64
+    # full-res batch
+    out_ref[:] += acc.astype(jnp.int32)
 
 
 def confusion_matrix_pallas(preds: jnp.ndarray, labels: jnp.ndarray,
@@ -65,7 +69,7 @@ def confusion_matrix_pallas(preds: jnp.ndarray, labels: jnp.ndarray,
         in_specs=[pl.BlockSpec((ROWS, LANES), lambda i: (i, 0)),
                   pl.BlockSpec((ROWS, LANES), lambda i: (i, 0))],
         out_specs=pl.BlockSpec((cp, cp), lambda i: (0, 0)),
-        out_shape=jax.ShapeDtypeStruct((cp, cp), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((cp, cp), jnp.int32),
         interpret=interpret,
     )(t.reshape(nb * ROWS, LANES), p.reshape(nb * ROWS, LANES))
-    return out[:num_class, :num_class].astype(jnp.int32)
+    return out[:num_class, :num_class]

@@ -12,26 +12,80 @@ from __future__ import annotations
 import optax
 
 
+def _torch_onecycle(total_steps: int, peak: float, pct_start: float,
+                    anneal: str, div_factor: float = 25.0,
+                    final_div_factor: float = 1e4) -> optax.Schedule:
+    """Exact torch OneCycleLR (torch/optim/lr_scheduler.py) semantics.
+
+    torch puts the phase boundaries at pct_start*total-1 and total-1 — the
+    cycle completes one step EARLY relative to a naive [0, total] split, so
+    optax's cosine_onecycle_schedule is one step out of phase everywhere
+    (and optax.linear_onecycle_schedule returns NaN for every step at
+    pct_start=0, the reference's 'linear' policy, from a 0-width interval
+    division). Both found by tests/test_trajectory_parity.py's step-exact
+    LR comparison; this re-implements torch's piecewise anneal directly.
+    """
+    initial = peak / div_factor
+    return _onecycle_piecewise(total_steps, pct_start, anneal,
+                               initial, peak, initial / final_div_factor)
+
+
+def _onecycle_piecewise(total_steps: int, pct_start: float, anneal: str,
+                        start1: float, mid: float, end2: float
+                        ) -> optax.Schedule:
+    """torch OneCycleLR's piecewise anneal (see _torch_onecycle)."""
+    import jax.numpy as jnp
+    e1 = pct_start * total_steps - 1.0
+    e2 = float(total_steps - 1)
+
+    def _cos(start, end, pct):
+        # torch _annealing_cos
+        return end + (start - end) / 2.0 * (1.0 + jnp.cos(jnp.pi * pct))
+
+    def _lin(start, end, pct):
+        return start + (end - start) * pct
+
+    fn = _cos if anneal == 'cos' else _lin
+
+    def schedule(count):
+        c = jnp.asarray(count, jnp.float32)
+        pct1 = jnp.where(e1 > 0, c / jnp.maximum(e1, 1e-12), 1.0)
+        pct2 = jnp.clip((c - e1) / jnp.maximum(e2 - e1, 1e-12), 0.0, 1.0)
+        return jnp.where(c <= e1,
+                         fn(start1, mid, jnp.clip(pct1, 0.0, 1.0)),
+                         fn(mid, end2, pct2))
+
+    return schedule
+
+
+def _torch_onecycle_momentum(total_steps: int, pct_start: float, anneal: str,
+                             base_momentum: float = 0.85,
+                             max_momentum: float = 0.95) -> optax.Schedule:
+    """torch OneCycleLR cycles momentum by DEFAULT (cycle_momentum=True):
+    SGD's `momentum` (or Adam's beta1) anneals max->base over the warmup
+    and base->max over the decay, inverse to the LR — silently OVERRIDING
+    the configured momentum=0.9 (reference base_config.py:54) for every
+    OneCycle run. Found by tests/test_trajectory_parity.py: the 30-step SGD
+    toy trajectory diverged by exactly the annealed-momentum ratio. The
+    reference's real training semantics are therefore cycled momentum, and
+    the repo reproduces them via schedule-injected hyperparams."""
+    return _onecycle_piecewise(total_steps, pct_start, anneal,
+                               max_momentum, base_momentum, max_momentum)
+
+
 def get_lr_schedule(config) -> optax.Schedule:
     assert config.total_itrs > 0, 'call config.resolve_schedule() first'
     if config.lr_policy == 'cos_warmup':
         # torch OneCycleLR defaults: div_factor=25, final_div_factor=1e4
-        return optax.cosine_onecycle_schedule(
-            transition_steps=config.total_itrs,
-            peak_value=config.lr,
+        return _torch_onecycle(
+            config.total_itrs, config.lr,
             pct_start=config.warmup_epochs / config.total_epoch,
-            div_factor=25.0,
-            final_div_factor=1e4)
+            anneal='cos')
     if config.lr_policy == 'linear':
         # torch OneCycleLR(anneal_strategy='linear', pct_start=0): straight
-        # linear decay from peak to peak/ (div*final_div)
-        return optax.linear_onecycle_schedule(
-            transition_steps=config.total_itrs,
-            peak_value=config.lr,
-            pct_start=0.0,
-            pct_final=1.0,
-            div_factor=25.0,
-            final_div_factor=1e4)
+        # linear decay from peak to peak / (div * final_div)
+        return _torch_onecycle(config.total_itrs, config.lr,
+                               pct_start=0.0, anneal='linear')
     if config.lr_policy == 'step':
         return optax.exponential_decay(
             init_value=config.lr,
@@ -42,18 +96,53 @@ def get_lr_schedule(config) -> optax.Schedule:
         f'Unsupported scheduler type: {config.lr_policy}')
 
 
+def get_momentum(config, torch_default=None):
+    """Effective momentum (SGD) / beta1 (Adam, AdamW): a cycled schedule for
+    OneCycle policies — torch OneCycleLR's cycle_momentum=True default
+    overrides the configured momentum (see _torch_onecycle_momentum). For
+    StepLR (no momentum cycling) SGD uses config.momentum; Adam/AdamW pass
+    torch_default=0.9 since the reference never forwards config.momentum to
+    them (utils/optimizer.py:14-16)."""
+    if config.lr_policy == 'cos_warmup':
+        return _torch_onecycle_momentum(
+            config.total_itrs,
+            config.warmup_epochs / config.total_epoch, 'cos')
+    if config.lr_policy == 'linear':
+        return _torch_onecycle_momentum(config.total_itrs, 0.0, 'linear')
+    return config.momentum if torch_default is None else torch_default
+
+
 def get_optimizer(config) -> optax.GradientTransformation:
     schedule = get_lr_schedule(config)
     if config.optimizer_type == 'sgd':
+        mom = get_momentum(config)
         # torch SGD(momentum, weight_decay): wd added to the raw gradient
         # before the momentum buffer -> add_decayed_weights first.
+        trace = (optax.inject_hyperparams(optax.trace)(decay=mom)
+                 if callable(mom) else optax.trace(decay=mom))
         return optax.chain(
             optax.add_decayed_weights(config.weight_decay),
-            optax.trace(decay=config.momentum),
+            trace,
             optax.scale_by_learning_rate(schedule))
+    # adam/adamw: config.momentum is an SGD knob the reference never
+    # forwards here — beta1 is torch's 0.9 default, cycled by OneCycle
+    mom = get_momentum(config, torch_default=0.9)
     if config.optimizer_type == 'adam':
-        return optax.adam(schedule)
+        # torch Adam defaults (reference utils/optimizer.py:14-16 passes lr
+        # only): beta2 0.999, eps 1e-8, NO weight decay — config.weight_decay
+        # is intentionally unused, as in the reference. beta1 is cycled by
+        # the scheduler like SGD momentum (bias correction uses the CURRENT
+        # beta1**step in both torch and optax.scale_by_adam).
+        if callable(mom):
+            return optax.inject_hyperparams(optax.adam)(
+                learning_rate=schedule, b1=mom)
+        return optax.adam(schedule, b1=mom)       # mom == 0.9 here
     if config.optimizer_type == 'adamw':
-        return optax.adamw(schedule)
+        # torch AdamW default weight_decay is 1e-2 (optax's is 1e-4); the
+        # decoupled update p -= lr*(adam_dir + wd*p) is the same in both.
+        if callable(mom):
+            return optax.inject_hyperparams(optax.adamw)(
+                learning_rate=schedule, b1=mom, weight_decay=1e-2)
+        return optax.adamw(schedule, b1=mom, weight_decay=1e-2)
     raise NotImplementedError(
         f'Unsupported optimizer type: {config.optimizer_type}')

@@ -314,6 +314,14 @@ def build_eval_step(config, model, mesh: Mesh, use_ema: bool = True
     from ..parallel.mesh import SPATIAL_AXIS
     axes = _mesh_axes(mesh)
     compute_dtype = jnp.dtype(config.compute_dtype)
+    use_pallas = getattr(config, 'use_pallas_metrics', None)
+    if use_pallas is None:      # auto: kernel on TPU, einsum elsewhere
+        use_pallas = jax.devices()[0].platform == 'tpu'
+    if use_pallas:
+        from ..ops.pallas_metrics import confusion_matrix_pallas
+        cm_fn = confusion_matrix_pallas
+    else:
+        cm_fn = confusion_matrix
 
     def forward_cm(state: TrainState, images, masks):
         params = state.ema_params if use_ema else state.params
@@ -321,8 +329,7 @@ def build_eval_step(config, model, mesh: Mesh, use_ema: bool = True
         out = model.apply({'params': params, 'batch_stats': bs},
                           images.astype(compute_dtype), False)
         preds = jnp.argmax(out, axis=-1)
-        return confusion_matrix(preds, masks, config.num_class,
-                                config.ignore_index)
+        return cm_fn(preds, masks, config.num_class, config.ignore_index)
 
     if SPATIAL_AXIS in mesh.axis_names:
         from ..parallel import batch_sharding, replicated

@@ -129,8 +129,24 @@ class SegTrainer:
             return
         meta = load_meta(path) or {}
         if cfg.resume_training and meta.get('kind') == 'train':
-            self.state, self.cur_epoch, self.best_score = \
-                restore_train_ckpt(path, self.state)
+            try:
+                self.state, self.cur_epoch, self.best_score = \
+                    restore_train_ckpt(path, self.state)
+            # tree-structure mismatches only — I/O and permission errors
+            # propagate unchanged so users don't delete a valid checkpoint
+            # on a transient failure
+            except (ValueError, KeyError, TypeError) as e:
+                # an incompatible train state (e.g. the optimizer-state
+                # layout changed between framework versions) surfaces as an
+                # opaque orbax tree-mismatch dump; name the actual problem
+                # and the two ways out instead of crashing implicitly on
+                # the default auto-resume path (config/base.py:209-210)
+                raise RuntimeError(
+                    f'Cannot resume from {path}: the checkpointed train '
+                    f'state does not match the current model/optimizer '
+                    f'structure. Delete the stale checkpoint to start '
+                    f'fresh, or set load_ckpt=False / resume_training='
+                    f'False to load weights only.') from e
             self.logger.info(f'Resumed from {path} at epoch {self.cur_epoch}'
                              f' (best {self.best_score:.4f})')
         else:
@@ -200,6 +216,12 @@ class SegTrainer:
         # mean (reference live-tqdm role, core/seg_trainer.py:115-119)
         # without any per-step host sync
         loss_sum, n_steps = None, 0
+        # lagged progress line: at each log point we print the loss captured
+        # at the PREVIOUS log point — dispatched log_interval steps ago and
+        # therefore already materialized, so float() returns without
+        # draining the async dispatch queue (the reference's live tqdm bar,
+        # core/seg_trainer.py:115-119, syncs every step instead)
+        lag = None
         nb = len(self.train_loader)
         profiling = (cfg.profile_dir is not None and self.cur_epoch == 0
                      and self.main_rank)
@@ -218,9 +240,13 @@ class SegTrainer:
                 self.logger.info(f'Profiler trace in {cfg.profile_dir}')
             if (cfg.log_interval > 0 and self.main_rank
                     and (i + 1) % cfg.log_interval == 0):
+                # first log point of the epoch reads the current loss (one
+                # host sync per epoch); later points read the lagged one
+                li, ll = lag if lag is not None else (i, metrics['loss'])
                 self.logger.info(
                     f'Epoch:{self.cur_epoch + 1}/{cfg.total_epoch} | '
-                    f'Iter:{i + 1}/{nb} | Loss:{float(metrics["loss"]):.4g}')
+                    f'Iter:{li + 1}/{nb} | Loss:{float(ll):.4g}')
+                lag = (i, metrics['loss'])
             if self.main_rank and cfg.use_tb:
                 # the only unconditional per-step host<->device sync;
                 # skipped entirely when TB is off so steps dispatch

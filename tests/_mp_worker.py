@@ -3,16 +3,17 @@ CPU validating the multi-host input feed — ShardedLoader slices by
 process_index, make_global_array assembles the global batch, and a jit'd
 collective sees the right data. Run as:
 
-    python tests/_mp_worker.py <process_id> <port>
+    python tests/_mp_worker.py <process_id> <port> [devices_per_process]
 """
 
 import os
 import sys
 
 pid, port = int(sys.argv[1]), sys.argv[2]
+DEV = int(sys.argv[3]) if len(sys.argv) > 3 else 2
 os.environ['JAX_PLATFORMS'] = 'cpu'
 os.environ['XLA_FLAGS'] = (os.environ.get('XLA_FLAGS', '') +
-                           ' --xla_force_host_platform_device_count=2')
+                           f' --xla_force_host_platform_device_count={DEV}')
 
 import jax  # noqa: E402
 
@@ -45,15 +46,16 @@ class FakeDataset:
 
 def main():
     assert jax.process_count() == 2
-    assert len(jax.devices()) == 4
+    assert len(jax.devices()) == 2 * DEV
     mesh = make_mesh()
     sharding = batch_sharding(mesh)
 
-    GLOBAL_BS, N = 4, 12
+    GLOBAL_BS = 2 * DEV
+    N = 3 * GLOBAL_BS
     loader = ShardedLoader(FakeDataset(N), GLOBAL_BS, shuffle=False,
                            process_index=jax.process_index(),
                            process_count=jax.process_count())
-    assert loader.local_batch == 2
+    assert loader.local_batch == DEV
 
     # replicate the assembled global batch so every process can inspect it
     gather = jax.jit(lambda a: a + 0,
@@ -61,7 +63,7 @@ def main():
 
     n_batches = 0
     for b, (images, masks) in enumerate(loader):
-        assert images.shape == (2, 8, 8, 3)       # process-local slice only
+        assert images.shape == (DEV, 8, 8, 3)     # process-local slice only
         gi = make_global_array(images, sharding)
         gm = make_global_array(masks.astype(np.int32), sharding)
         assert gi.shape == (GLOBAL_BS, 8, 8, 3)   # global assembled batch
@@ -94,8 +96,8 @@ def train_step_cross_process(mesh, sharding):
     cfg = SegConfig(dataset='synthetic', model='fastscnn', num_class=4,
                     train_bs=1, crop_size=32, sync_bn=True, use_ema=True,
                     compute_dtype='float32', save_dir='/tmp/rtseg_mp')
-    cfg.resolve(num_devices=4)
-    cfg.resolve_schedule(train_num=16)
+    cfg.resolve(num_devices=2 * DEV)
+    cfg.resolve_schedule(train_num=8 * DEV)
     model = get_model(cfg)
     opt = get_optimizer(cfg)
     state = create_train_state(model, opt, jax.random.PRNGKey(0),
@@ -104,13 +106,13 @@ def train_step_cross_process(mesh, sharding):
 
     # per-process local slice of the deterministic global batch
     rng = np.random.RandomState(7)
-    g_images = rng.rand(4, 32, 32, 3).astype(np.float32)
-    g_masks = rng.randint(0, 4, (4, 32, 32)).astype(np.int32)
-    lo = jax.process_index() * 2
+    g_images = rng.rand(2 * DEV, 32, 32, 3).astype(np.float32)
+    g_masks = rng.randint(0, 4, (2 * DEV, 32, 32)).astype(np.int32)
+    lo = jax.process_index() * DEV
     images = jax.make_array_from_process_local_data(
-        sharding, g_images[lo:lo + 2])
+        sharding, g_images[lo:lo + DEV])
     masks = jax.make_array_from_process_local_data(
-        sharding, g_masks[lo:lo + 2])
+        sharding, g_masks[lo:lo + DEV])
 
     for _ in range(2):
         state, metrics = step(state, images, masks)

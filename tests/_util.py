@@ -1,0 +1,15 @@
+"""Shared numeric helpers for the parity test files."""
+
+import jax
+import numpy as np
+
+
+def global_rel_l2(tree_a, tree_b) -> float:
+    """Global relative L2 between two pytrees, in float64 (the round-3
+    lesson: cancellation-dominated leaves make elementwise comparison
+    meaningless across remat/backend boundaries — compare globally)."""
+    fa = np.concatenate([np.asarray(x, np.float64).ravel()
+                         for x in jax.tree.leaves(tree_a)])
+    fb = np.concatenate([np.asarray(x, np.float64).ravel()
+                         for x in jax.tree.leaves(tree_b)])
+    return float(np.linalg.norm(fa - fb) / max(np.linalg.norm(fb), 1e-12))

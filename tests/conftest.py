@@ -46,10 +46,12 @@ def mesh8():
 
 @pytest.fixture(autouse=True)
 def _reset_bn_axis():
-    """The collective BN axis is process-global and set by step builders;
-    reset it so bare model.apply(train=True) outside shard_map never sees a
-    stale mesh axis from a previous test."""
-    from rtseg_tpu.nn import set_bn_axis
+    """The collective BN axis and the stem-packing switch are process-global
+    and set by step builders; reset both so bare model.apply() outside
+    shard_map never sees stale state from a previous test."""
+    from rtseg_tpu.nn import set_bn_axis, set_stem_packing
     set_bn_axis(None)
+    set_stem_packing(False)
     yield
     set_bn_axis(None)
+    set_stem_packing(False)

@@ -46,6 +46,26 @@ def torch_param_count(model) -> int:
     return sum(p.numel() for p in model.parameters())
 
 
+def load_ref_util(name: str):
+    """Import /root/reference/utils/<name>.py under a private 'refutils'
+    package (so model_ema's relative `from .parallel import de_parallel`
+    resolves) without clashing with the repo's own utils package."""
+    if 'refutils' not in sys.modules:
+        pkg = type(sys)('refutils')
+        pkg.__path__ = ['/root/reference/utils']
+        sys.modules['refutils'] = pkg
+    return _load(f'refutils.{name}', f'/root/reference/utils/{name}.py')
+
+
+def load_ref_loss():
+    """Import /root/reference/core/loss.py (no intra-package imports).
+
+    OhemCELoss.__init__ hard-codes `.cuda()` on its threshold tensor
+    (core/loss.py:9) — callers on a CPU-only box must shim
+    torch.Tensor.cuda to identity before constructing it."""
+    return _load('refcore_loss', '/root/reference/core/loss.py')
+
+
 def load_ref_regseg():
     """Load reference regseg with the one-line construction bug patched.
 

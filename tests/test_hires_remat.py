@@ -1,0 +1,83 @@
+"""hires_remat is a pure memory/scheduling lever: params, outputs, and
+gradients must be IDENTICAL with the flag on and off (the same guarantee
+bisenetv2's detail_remat carries). Checks the three models the flag wires
+up (stdc, ddrnet, ppliteseg) at init + train-mode forward + grad level.
+
+Grad comparison follows the round-3 lesson (BENCHMARKS.md): XLA refusion
+across a remat barrier perturbs cancellation-dominated leaves, so compare
+by global rel-L2, not elementwise.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import sys
+from pathlib import Path
+sys.path.insert(0, str(Path(__file__).parent))
+from _util import global_rel_l2  # noqa: E402
+
+from rtseg_tpu.config import SegConfig
+from rtseg_tpu.models import get_model
+
+H, W, NC = 64, 128, 19
+
+
+def _cfg(model, remat, **kw):
+    cfg = SegConfig(dataset='synthetic', model=model, num_class=NC,
+                    compute_dtype='float32', hires_remat=remat,
+                    save_dir='/tmp/rtseg_remat', **kw)
+    cfg.resolve(num_devices=1)
+    cfg.resolve_schedule(train_num=64)
+    return cfg
+
+
+def _tree_paths(tree):
+    return [jax.tree_util.keystr(kp)
+            for kp, _ in jax.tree_util.tree_flatten_with_path(tree)[0]]
+
+
+@pytest.mark.parametrize('name,kw', [
+    ('stdc', {'use_aux': True}),
+    ('ddrnet', {'use_aux': True}),
+    ('ppliteseg', {}),
+])
+def test_hires_remat_equivalence(name, kw):
+    rng = np.random.RandomState(0)
+    x = rng.uniform(-1.5, 1.5, (2, H, W, 3)).astype(np.float32)
+    masks = rng.randint(0, NC, (2, H, W)).astype(np.int32)
+
+    models, variables, outs, grads = {}, {}, {}, {}
+    for remat in (False, True):
+        cfg = _cfg(name, remat, **kw)
+        model = get_model(cfg)
+        v = model.init(jax.random.PRNGKey(0), jnp.asarray(x), False)
+        models[remat], variables[remat] = model, v
+        outs[remat] = model.apply(v, jnp.asarray(x), False)
+
+        def loss_fn(params):
+            out, _ = model.apply(
+                {'params': params, 'batch_stats': v['batch_stats']},
+                jnp.asarray(x), True, mutable=['batch_stats'],
+                rngs={'dropout': jax.random.PRNGKey(3)})
+            main = out[0] if isinstance(out, tuple) else out
+            oh = jax.nn.one_hot(masks, NC)
+            return -(jax.nn.log_softmax(main) * oh).mean()
+
+        grads[remat] = jax.grad(loss_fn)(v['params'])
+
+    # identical param paths and values -> checkpoints interchangeable
+    assert _tree_paths(variables[False]['params']) == \
+        _tree_paths(variables[True]['params']), \
+        f'{name}: hires_remat changes parameter paths'
+    assert jax.tree.all(jax.tree.map(
+        lambda a, b: np.array_equal(np.asarray(a), np.asarray(b)),
+        variables[False], variables[True]))
+    # identical eval logits
+    np.testing.assert_array_equal(np.asarray(outs[False]),
+                                  np.asarray(outs[True]))
+    # gradients equal up to remat-barrier refusion noise
+    rel = global_rel_l2(grads[True], grads[False])
+    assert rel < 1e-5, f'{name}: grads diverge under hires_remat ({rel:.2e})'

@@ -24,11 +24,15 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_global_batch_assembly():
+@pytest.mark.parametrize('dev_per_proc', [
+    2,
+    pytest.param(4, marks=pytest.mark.slow),   # 2 procs x 4 devices each
+])
+def test_two_process_global_batch_assembly(dev_per_proc):
     worker = Path(__file__).parent / '_mp_worker.py'
     port = free_port()
     procs = [subprocess.Popen(
-        [sys.executable, str(worker), str(i), str(port)],
+        [sys.executable, str(worker), str(i), str(port), str(dev_per_proc)],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
         for i in range(2)]
     outs = []

@@ -1,0 +1,390 @@
+"""Golden training-trajectory parity against the in-situ torch reference.
+
+Single-apply logit parity (test_logit_parity.py) pins the forward graph and
+loss-function parity (test_losses.py) pins each loss in isolation; this file
+pins the *composition* the reference runs per iteration — SGD wd-before-
+momentum, per-iteration OneCycle stepping, aux-coefficient summation, ramp
+EMA — by running BOTH trainers from identical transplanted init on identical
+batches for 50 fp32 optimizer steps and comparing:
+
+  1. the per-step training-loss curve,
+  2. the final EMA parameter tree (transplant-aligned, rel-L2),
+  3. EMA-weights validation mIoU on a held-out batch.
+
+The torch side composes the reference's own pieces exactly as its hot loop
+does (core/seg_trainer.py:38-121): utils/optimizer.py get_optimizer,
+utils/scheduler.py get_scheduler (stepped after every optimizer step,
+seg_trainer.py:111), utils/model_ema.py ModelEmaV2 (updated with the 1-based
+iteration count, seg_trainer.py:113), core/loss.py get_loss_fn. The loop
+body here is a minimal re-statement of those lines (no DDP/amp/tqdm — all
+disabled paths on this box), not a re-interpretation.
+
+This is the strongest offline proxy for the north-star Cityscapes-mIoU
+reproduction (BASELINE.md): it proves that given the reference's data, the
+compiled TPU train step walks the same loss trajectory the reference does.
+"""
+
+import math
+import sys
+from pathlib import Path
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, str(Path(__file__).parent))
+from _util import global_rel_l2  # noqa: E402
+from reference_loader import (  # noqa: E402
+    load_ref_loss, load_ref_model_module, load_ref_util)
+
+from rtseg_tpu.config import SegConfig  # noqa: E402
+from rtseg_tpu.utils.metrics import iou_from_cm  # noqa: E402
+from rtseg_tpu.utils.transplant import (  # noqa: E402
+    SD_REORDER, apply_units, sd_leaf_units, transplant_from_module)
+
+H, W, NC = 64, 128, 19
+BS, STEPS = 4, 50
+EPOCHS, WARMUP = 10, 3          # 5 iters/epoch * 10 epochs = 50 total_itrs
+
+
+def _make_batches(seed=3, n_steps=STEPS, bs=BS):
+    """Deterministic shared batches; ~5% ignore pixels exercise the 255
+    path through CE/OHEM and the confusion matrix."""
+    rng = np.random.RandomState(seed)
+    batches = []
+    for _ in range(n_steps):
+        im = rng.uniform(-1.5, 1.5, (bs, H, W, 3)).astype(np.float32)
+        mk = rng.randint(0, NC, (bs, H, W)).astype(np.int32)
+        mk = np.where(rng.rand(bs, H, W) < 0.05, 255, mk)
+        batches.append((im, mk))
+    val_im = rng.uniform(-1.5, 1.5, (2, H, W, 3)).astype(np.float32)
+    val_mk = rng.randint(0, NC, (2, H, W)).astype(np.int32)
+    return batches, (val_im, val_mk)
+
+
+def _ref_ns(**kw):
+    """The reference-config attribute surface its optimizer/scheduler/EMA/
+    loss factories read (base_config.py fields), as a plain namespace."""
+    ns = SimpleNamespace(
+        optimizer_type='sgd', base_lr=0.01, momentum=0.9, weight_decay=1e-4,
+        DDP=False, gpu_num=1, train_bs=BS, train_num=BS * STEPS // EPOCHS,
+        total_epoch=EPOCHS, lr_policy='cos_warmup', warmup_epochs=WARMUP,
+        step_size=10000, use_ema=True, class_weights=None, loss_type='ce',
+        ignore_index=255, reduction='mean', ohem_thrs=0.7)
+    for k, v in kw.items():
+        setattr(ns, k, v)
+    return ns
+
+
+def _seg_config(model, **kw):
+    base = dict(dataset='synthetic', model=model, num_class=NC,
+                compute_dtype='float32', train_bs=BS,
+                total_epoch=EPOCHS, warmup_epochs=WARMUP, base_lr=0.01,
+                sync_bn=False, use_ema=True, save_dir='/tmp/rtseg_traj')
+    base.update(kw)
+    cfg = SegConfig(**base)
+    cfg.resolve(num_devices=1)
+    cfg.resolve_schedule(train_num=BS * STEPS // EPOCHS)
+    return cfg
+
+
+def _shim_cuda(monkeypatch):
+    """OhemCELoss.__init__ hard-codes .cuda() (reference core/loss.py:9);
+    identity on this CPU-only box."""
+    import torch
+    monkeypatch.setattr(torch.Tensor, 'cuda',
+                        lambda self, *a, **k: self, raising=False)
+
+
+def run_torch_trajectory(ref_model, ns, batches, val_batch, use_aux=False,
+                         aux_coef=None):
+    """Reference per-iteration composition, mirroring
+    core/seg_trainer.py:38-121 (plain + aux branches; amp/DDP/tb disabled)."""
+    import torch
+    import torch.nn.functional as F
+
+    opt = load_ref_util('optimizer').get_optimizer(ns, ref_model)
+    sched = load_ref_util('scheduler').get_scheduler(ns, opt)
+    ema = load_ref_util('model_ema').ModelEmaV2(ns, ref_model, device=None)
+    loss_fn = load_ref_loss().get_loss_fn(ns, torch.device('cpu'))
+
+    ref_model.train()
+    losses, lrs, train_itrs = [], [], 0
+    for im, mk in batches:
+        train_itrs += 1
+        xt = torch.from_numpy(np.transpose(im, (0, 3, 1, 2)).copy())
+        mt = torch.from_numpy(mk.astype(np.int64))
+        lrs.append(float(opt.param_groups[0]['lr']))
+        opt.zero_grad()
+        if use_aux:
+            preds, preds_aux = ref_model(xt, is_training=True)
+            loss = loss_fn(preds, mt)
+            coefs = aux_coef if aux_coef is not None \
+                else torch.ones(len(preds_aux))
+            masks_auxs = mt.unsqueeze(1).float()
+            for i in range(len(preds_aux)):
+                aux_size = preds_aux[i].size()[2:]
+                masks_aux = F.interpolate(masks_auxs, aux_size,
+                                          mode='nearest')
+                masks_aux = masks_aux.squeeze(1).to(dtype=torch.long)
+                loss = loss + coefs[i] * loss_fn(preds_aux[i], masks_aux)
+        else:
+            preds = ref_model(xt)
+            loss = loss_fn(preds, mt)
+        loss.backward()
+        opt.step()
+        sched.step()
+        ema.update(ref_model, train_itrs)
+        losses.append(float(loss.detach()))
+
+    # EMA-weights validation forward (seg_trainer.py:130)
+    val_im, val_mk = val_batch
+    ema.ema.eval()
+    with torch.no_grad():
+        vp = ema.ema(torch.from_numpy(
+            np.transpose(val_im, (0, 3, 1, 2)).copy()))
+    vp = vp.argmax(1).numpy()
+    cm = np.zeros((NC, NC), np.int64)
+    valid = val_mk != 255
+    np.add.at(cm, (val_mk[valid], vp[valid]), 1)
+    return losses, lrs, cm, ema
+
+
+def run_jax_trajectory(cfg, variables, batches, val_batch):
+    """The repo's compiled train step on a 1-device mesh, then the eval
+    step's EMA confusion matrix — the production path end to end."""
+    from jax.sharding import Mesh
+    from rtseg_tpu.models import get_model
+    from rtseg_tpu.parallel.mesh import DATA_AXIS
+    from rtseg_tpu.train.optim import get_lr_schedule, get_optimizer
+    from rtseg_tpu.train.state import TrainState
+    from rtseg_tpu.train.step import build_eval_step, build_train_step
+
+    model = get_model(cfg)
+    opt = get_optimizer(cfg)
+    params = variables['params']
+    bstats = variables.get('batch_stats', {})
+    state = TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                       batch_stats=bstats, opt_state=opt.init(params),
+                       ema_params=jax.tree.map(jnp.copy, params),
+                       ema_batch_stats=jax.tree.map(jnp.copy, bstats))
+    mesh = Mesh(np.array(jax.devices()[:1]), (DATA_AXIS,))
+    step = build_train_step(cfg, model, opt, mesh)
+    losses = []
+    with jax.default_matmul_precision('highest'):
+        for im, mk in batches:
+            state, metrics = step(state, im, mk)
+            losses.append(float(metrics['loss']))
+        eval_step = build_eval_step(cfg, model, mesh, use_ema=True)
+        cm = np.asarray(eval_step(state, *val_batch))
+    sched = get_lr_schedule(cfg)
+    lrs = [float(sched(i)) for i in range(len(batches))]
+    return losses, lrs, cm, state
+
+
+def _assert_trajectory(name, t_losses, j_losses, t_lrs, j_lrs,
+                       t_cm, j_cm, loss_rtol):
+    # the LR schedule must agree essentially exactly — any drift here is a
+    # schedule-semantics bug, not float noise
+    np.testing.assert_allclose(j_lrs, t_lrs, rtol=1e-5, atol=1e-9,
+                               err_msg=f'{name}: OneCycle LR sequences '
+                                       f'diverge')
+    t = np.asarray(t_losses)
+    j = np.asarray(j_losses)
+    rel = np.abs(t - j) / np.maximum(np.abs(t), 1e-9)
+    print(f'{name}: per-step loss rel-diff max={rel.max():.3e} '
+          f'mean={rel.mean():.3e} final t={t[-1]:.5f} j={j[-1]:.5f}')
+    np.testing.assert_allclose(j, t, rtol=loss_rtol,
+                               err_msg=f'{name}: loss trajectories diverge')
+    miou_t = float(np.mean(iou_from_cm(t_cm)))
+    miou_j = float(np.mean(iou_from_cm(j_cm)))
+    # after 50 steps from random init the logits are near-flat, so the
+    # argmax map flips on ~10% of pixels under the measured ~1e-2 param
+    # drift (diagnostic only) while mIoU — the quantity the reference
+    # validates on — stays within a few 1e-3: that's the assert
+    disagree = int(np.abs(t_cm - j_cm).sum()) // 2
+    total_px = int(t_cm.sum())
+    print(f'{name}: EMA-val mIoU torch={miou_t:.5f} jax={miou_j:.5f} '
+          f'pred-disagreement={disagree}/{total_px}px')
+    assert abs(miou_t - miou_j) < 5e-3, \
+        f'{name}: EMA-val mIoU diverges ({miou_t:.5f} vs {miou_j:.5f})'
+
+
+def _ema_tree_rel_l2(ref_ema_model, model_name, cfg, variables, state):
+    """Transplant the torch EMA state_dict through the production sd-order
+    machinery and compare against the jax EMA tree."""
+    sd = {k: v.detach().cpu().numpy()
+          for k, v in ref_ema_model.state_dict().items()}
+    units = sd_leaf_units(sd)
+    fix = SD_REORDER.get(model_name)
+    if fix is not None:
+        units = fix(units)
+    from rtseg_tpu.models import get_model
+    from rtseg_tpu.utils.transplant import flax_leaf_order
+    _, flax_units = flax_leaf_order(get_model(cfg),
+                                    jnp.zeros((1, H, W, 3)), True)
+    v_t = apply_units(variables, flax_units, units)
+    rel = global_rel_l2(state.ema_params, v_t['params'])
+    return rel
+
+
+@pytest.mark.slow
+def test_fastscnn_ce_trajectory():
+    """50-step SGD+OneCycle+EMA trajectory, plain CE branch
+    (seg_trainer.py:84-87)."""
+    batches, val_batch = _make_batches()
+    ref = load_ref_model_module('fastscnn').FastSCNN(num_class=NC)
+    cfg = _seg_config('fastscnn', loss_type='ce')
+    assert cfg.total_itrs == STEPS
+    from rtseg_tpu.models import get_model
+    variables, _, _ = transplant_from_module(
+        ref, get_model(cfg), jnp.asarray(batches[0][0]))
+
+    t_losses, t_lrs, t_cm, ema = run_torch_trajectory(
+        ref, _ref_ns(), batches, val_batch)
+    j_losses, j_lrs, j_cm, state = run_jax_trajectory(
+        cfg, variables, batches, val_batch)
+    # 5e-2 bar: torch-CPU vs XLA-CPU fp32 grads differ ~1e-6 relative per
+    # step and deep-net SGD amplifies that multiplicatively (measured
+    # 1.26e-2 after 50 steps); optimizer SEMANTICS are pinned separately at
+    # 2e-5 by test_optimizer_trajectory_parity, so drift here is backend
+    # float noise, not composition error
+    rel = _ema_tree_rel_l2(ema.ema, 'fastscnn', cfg, variables, state)
+    print(f'fastscnn/ce: EMA param tree global rel-L2 = {rel:.3e}')
+    assert rel < 5e-2
+    _assert_trajectory('fastscnn/ce', t_losses, j_losses, t_lrs, j_lrs,
+                       t_cm, j_cm, loss_rtol=5e-3)
+
+
+@pytest.mark.slow
+def test_bisenetv2_ohem_aux_ema_trajectory(monkeypatch):
+    """50-step trajectory through the aux branch with OHEM loss and ramp
+    EMA (seg_trainer.py:48-65,107-113) — the flagship training recipe."""
+    _shim_cuda(monkeypatch)
+    batches, val_batch = _make_batches(seed=11)
+    ref = load_ref_model_module('bisenetv2').BiSeNetv2(num_class=NC,
+                                                       use_aux=True)
+    cfg = _seg_config('bisenetv2', loss_type='ohem', use_aux=True)
+    from rtseg_tpu.models import get_model
+    variables, _, _ = transplant_from_module(
+        ref, get_model(cfg), jnp.asarray(batches[0][0]))
+
+    t_losses, t_lrs, t_cm, ema = run_torch_trajectory(
+        ref, _ref_ns(loss_type='ohem'), batches, val_batch, use_aux=True)
+    j_losses, j_lrs, j_cm, state = run_jax_trajectory(
+        cfg, variables, batches, val_batch)
+    # 7e-2 bar: measured 4.7e-2 after 50 steps — OHEM's hard-pixel
+    # selection amplifies fp32 backend drift (a <1e-6 loss difference can
+    # flip a pixel in/out of the top-k set); optimizer semantics are
+    # pinned exactly by test_optimizer_trajectory_parity
+    rel = _ema_tree_rel_l2(ema.ema, 'bisenetv2', cfg, variables, state)
+    print(f'bisenetv2: EMA param tree global rel-L2 = {rel:.3e}')
+    assert rel < 7e-2
+    # loss rtol 2e-2: measured max 1.1e-2 per-step rel drift (mean 3e-3)
+    _assert_trajectory('bisenetv2/ohem+aux+ema', t_losses, j_losses,
+                       t_lrs, j_lrs, t_cm, j_cm, loss_rtol=2e-2)
+
+
+# ------------------------------------------------- optimizer-semantics pins
+
+class _ToyNet:
+    """A 2-param torch module and its jax twin sharing one smooth loss with
+    framework-independent gradients — isolates pure optimizer semantics."""
+
+    def __init__(self):
+        import torch
+        import torch.nn as tnn
+        rng = np.random.RandomState(0)
+        self.w0 = rng.uniform(-1, 1, (5, 7)).astype(np.float32)
+        self.b0 = rng.uniform(-1, 1, (7,)).astype(np.float32)
+        self.a = rng.uniform(-1, 1, (5, 7)).astype(np.float32)
+
+        class M(tnn.Module):
+            def __init__(s):
+                super().__init__()
+                s.w = tnn.Parameter(torch.from_numpy(self.w0.copy()))
+                s.b = tnn.Parameter(torch.from_numpy(self.b0.copy()))
+        self.torch_model = M()
+
+    def torch_loss(self):
+        import torch
+        m = self.torch_model
+        return (torch.sin(m.w) * torch.from_numpy(self.a)).sum() \
+            + (m.w ** 2).mean() + (torch.tanh(m.b) ** 2).sum()
+
+    def jax_params(self):
+        return {'w': jnp.asarray(self.w0), 'b': jnp.asarray(self.b0)}
+
+    def jax_loss(self, p):
+        return (jnp.sin(p['w']) * jnp.asarray(self.a)).sum() \
+            + (p['w'] ** 2).mean() + (jnp.tanh(p['b']) ** 2).sum()
+
+
+@pytest.mark.parametrize('opt_type', ['sgd', 'adam', 'adamw'])
+def test_optimizer_trajectory_parity(opt_type):
+    """30 steps of reference get_optimizer + get_scheduler vs the repo's
+    optax factories on identical analytic gradients. Pins torch-default
+    Adam (no wd) and AdamW (decoupled wd=1e-2) semantics — reference
+    utils/optimizer.py:14-16 ignores config.weight_decay for both — plus
+    SGD's wd-before-momentum and the per-step OneCycle schedule."""
+    from rtseg_tpu.train.optim import get_optimizer
+    import torch
+
+    steps = 30
+    net = _ToyNet()
+    ns = _ref_ns(optimizer_type=opt_type, total_epoch=6, train_num=20,
+                 warmup_epochs=2)    # ceil(20/4)=5 iters * 6 epochs = 30
+    topt = load_ref_util('optimizer').get_optimizer(ns, net.torch_model)
+    tsched = load_ref_util('scheduler').get_scheduler(ns, topt)
+    for _ in range(steps):
+        topt.zero_grad()
+        net.torch_loss().backward()
+        topt.step()
+        tsched.step()
+
+    cfg = _seg_config('fastscnn', optimizer_type=opt_type,
+                      total_epoch=6, warmup_epochs=2)
+    cfg.resolve_schedule(train_num=20)
+    assert cfg.total_itrs == steps and abs(cfg.lr - ns.lr) < 1e-12
+    jopt = get_optimizer(cfg)
+    params = net.jax_params()
+    opt_state = jopt.init(params)
+    grad_fn = jax.grad(net.jax_loss)
+    for _ in range(steps):
+        upd, opt_state = jopt.update(grad_fn(params), opt_state, params)
+        params = jax.tree.map(lambda p, u: p + u, params, upd)
+
+    np.testing.assert_allclose(
+        np.asarray(params['w']),
+        net.torch_model.w.detach().numpy(), rtol=2e-5, atol=2e-6,
+        err_msg=f'{opt_type}: 30-step weight trajectories diverge')
+    np.testing.assert_allclose(
+        np.asarray(params['b']),
+        net.torch_model.b.detach().numpy(), rtol=2e-5, atol=2e-6)
+
+
+def test_lr_schedule_parity_linear():
+    """torch OneCycleLR(anneal='linear', pct_start=0) vs
+    optax.linear_onecycle over every step of a 40-step cycle."""
+    import torch
+    from rtseg_tpu.train.optim import get_lr_schedule
+
+    ns = _ref_ns(lr_policy='linear', total_epoch=8, train_num=20)
+    m = torch.nn.Linear(2, 2)
+    topt = load_ref_util('optimizer').get_optimizer(ns, m)
+    tsched = load_ref_util('scheduler').get_scheduler(ns, topt)
+    t_lrs = []
+    for _ in range(40):
+        t_lrs.append(float(topt.param_groups[0]['lr']))
+        topt.step()
+        tsched.step()
+
+    cfg = _seg_config('fastscnn', lr_policy='linear', total_epoch=8)
+    cfg.resolve_schedule(train_num=20)
+    assert cfg.total_itrs == 40
+    sched = get_lr_schedule(cfg)
+    j_lrs = [float(sched(i)) for i in range(40)]
+    np.testing.assert_allclose(j_lrs, t_lrs, rtol=1e-5, atol=1e-9)

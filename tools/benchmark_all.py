@@ -93,7 +93,9 @@ def _compiled_flops(compiled) -> float:
 
 BENCH_S2D = {'on': False,        # set by --s2d; threaded via SegConfig
              'detail_remat': False,
-             'segnet_pack': False}
+             'hires_remat': False,
+             'segnet_pack': False,
+             'pallas_cm': None}   # None = production auto (kernel on TPU)
 
 
 def bench_forward(name, batch, h, w, queue, trials):
@@ -144,6 +146,8 @@ def _setup_state(name, batch, h, w, **cfg_overrides):
                     s2d_stem=BENCH_S2D['on'],
                     segnet_pack=BENCH_S2D['segnet_pack'],
                     detail_remat=BENCH_S2D['detail_remat'],
+                    hires_remat=BENCH_S2D['hires_remat'],
+                    use_pallas_metrics=BENCH_S2D['pallas_cm'],
                     save_dir='/tmp/rtseg_bench', **cfg_overrides)
     cfg.resolve(num_devices=1)
     cfg.resolve_schedule(train_num=batch * 1000)
@@ -231,6 +235,19 @@ def main() -> int:
     ap.add_argument('--segnet-pack', action='store_true',
                     help='enable segnet full-res S2D layout '
                          '(config.segnet_pack; the bs64 OOM mitigation)')
+    ap.add_argument('--hires-remat', action='store_true',
+                    help='stdc/ddrnet/ppliteseg: rematerialize the '
+                         'high-resolution encoder stages in backward '
+                         '(config.hires_remat)')
+    ap.add_argument('--pallas-cm', action='store_true', default=None,
+                    help='eval mode: force the blocked Pallas confusion-'
+                         'matrix kernel (config.use_pallas_metrics); '
+                         'default None follows production auto (kernel '
+                         'on TPU)')
+    ap.add_argument('--no-pallas-cm', dest='pallas_cm',
+                    action='store_false',
+                    help='eval mode: force the one-hot-einsum CM (the '
+                         'A/B baseline)')
     ap.add_argument('--peak-flops', type=float, default=None,
                     help='override the per-chip peak FLOP/s used for MFU '
                          '(required on device kinds not in '
@@ -240,6 +257,8 @@ def main() -> int:
     BENCH_S2D['on'] = args.s2d
     BENCH_S2D['segnet_pack'] = args.segnet_pack
     BENCH_S2D['detail_remat'] = args.detail_remat
+    BENCH_S2D['hires_remat'] = args.hires_remat
+    BENCH_S2D['pallas_cm'] = args.pallas_cm
     peak, device_kind = peak_flops(args.peak_flops)
     kind = 'train' if args.train else 'eval' if args.eval else 'forward'
     rows = []

@@ -1,0 +1,204 @@
+"""Capture + aggregate a train-step profiler trace by model module.
+
+The round-3 method that found bisenetv2's DetailBranch at 41% of step time
+(BENCHMARKS.md "Flagship train-step profile") as a repeatable tool: jit the
+full train step, trace N fenced iterations with jax.profiler, then parse the
+trace-viewer JSON and aggregate device time by the model-module prefix XLA
+records in each op's metadata (jax source-info -> HLO op_name).
+
+    python tools/profile_step.py --model ddrnet --batch 96
+    python tools/profile_step.py --model stdc --batch 128 --hires-remat
+    python tools/profile_step.py --inspect   # dump raw event fields
+
+Writes the trace under --trace-dir (default /tmp, NOT the repo: binary
+traces stay out of git per the round-3 advisor note) and prints a
+module-share table.
+"""
+
+import argparse
+import collections
+import glob
+import gzip
+import json
+import os
+import re
+import sys
+from os import path
+
+sys.path.append(path.dirname(path.dirname(path.abspath(__file__))))
+
+import numpy as np
+
+
+def capture(model_name, batch, h, w, trace_dir, iters, hires_remat=False,
+            detail_remat=False, eval_mode=False):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+    from rtseg_tpu.config import SegConfig
+    from rtseg_tpu.models import get_model
+    from rtseg_tpu.models.registry import AUX_MODELS, DETAIL_HEAD_MODELS
+    from rtseg_tpu.nn import set_bn_axis
+    from rtseg_tpu.parallel.mesh import DATA_AXIS
+    from rtseg_tpu.train.optim import get_optimizer
+    from rtseg_tpu.train.state import create_train_state
+    from rtseg_tpu.train.step import build_eval_step, build_train_step
+
+    cfg = SegConfig(dataset='synthetic', model=model_name, num_class=19,
+                    compute_dtype='bfloat16', train_bs=batch,
+                    use_aux=model_name in AUX_MODELS and not eval_mode,
+                    use_detail_head=(model_name in DETAIL_HEAD_MODELS
+                                     and not eval_mode),
+                    use_ema=True, loss_type='ohem',
+                    detail_remat=detail_remat, hires_remat=hires_remat,
+                    save_dir='/tmp/rtseg_profile')
+    cfg.resolve(num_devices=1)
+    cfg.resolve_schedule(train_num=batch * 1000)
+    model = get_model(cfg)
+    opt = get_optimizer(cfg)
+    mesh = Mesh(np.array(jax.devices()[:1]), (DATA_AXIS,))
+    state = create_train_state(model, opt, jax.random.PRNGKey(0),
+                               jnp.zeros((1, h, w, 3), jnp.float32))
+    rng = np.random.RandomState(0)
+    images = jax.device_put(rng.rand(batch, h, w, 3).astype(np.float32))
+    masks = jax.device_put(
+        rng.randint(0, 19, (batch, h, w)).astype(np.int32))
+    if eval_mode:
+        step = build_eval_step(cfg, model, mesh)
+        set_bn_axis(step.bn_axis)
+        compiled = step.jitted.lower(
+            jax.device_get(state), images, masks).compile()
+        cm = compiled(state, images, masks)
+        jax.block_until_ready(cm)
+        with jax.profiler.trace(trace_dir):
+            for _ in range(iters):
+                cm = compiled(state, images, masks)
+            jax.block_until_ready(cm)
+        return float(np.asarray(cm).sum())
+    step = build_train_step(cfg, model, opt, mesh)
+    set_bn_axis(step.bn_axis)
+    compiled = step.jitted.lower(
+        jax.device_get(state), images, masks).compile()
+    state, _ = compiled(state, images, masks)      # warmup / compile check
+    jax.block_until_ready(state)
+    with jax.profiler.trace(trace_dir):
+        for _ in range(iters):
+            state, metrics = compiled(state, images, masks)
+        jax.block_until_ready(state)
+    return float(np.asarray(metrics['loss']))
+
+
+def load_events(trace_dir):
+    """All complete ('X') events from the newest trace.json.gz under
+    trace_dir, with the process-name map so device tracks are findable."""
+    files = sorted(glob.glob(path.join(
+        trace_dir, '**', '*.trace.json.gz'), recursive=True),
+        key=path.getmtime)
+    if not files:
+        raise FileNotFoundError(f'no *.trace.json.gz under {trace_dir}')
+    with gzip.open(files[-1], 'rt') as f:
+        data = json.load(f)
+    events = data['traceEvents'] if isinstance(data, dict) else data
+    pid_names = {e.get('pid'): e.get('args', {}).get('name', '')
+                 for e in events
+                 if e.get('ph') == 'M' and e.get('name') == 'process_name'}
+    xevents = [e for e in events if e.get('ph') == 'X']
+    return xevents, pid_names
+
+
+# jax records the originating module path in the HLO metadata op_name, which
+# the trace viewer surfaces per event (args key varies across versions)
+_ARGS_KEYS = ('long_name', 'tf_op', 'hlo_op', 'name')
+_MODULE_RE = re.compile(r'([A-Za-z0-9_]+_\d+|[a-z_]+[0-9]?)/')
+
+
+def module_of(event, depth):
+    args = event.get('args', {}) or {}
+    meta = ''
+    for k in _ARGS_KEYS:
+        v = args.get(k, '')
+        if isinstance(v, str) and '/' in v:
+            meta = v
+            break
+    if not meta:
+        return None
+    parts = [p for p in meta.split('/') if p and '=' not in p]
+    # drop transpose/jit wrappers so fwd and bwd of one module aggregate
+    parts = [p for p in parts if not p.startswith(('jit(', 'transpose('))]
+    if not parts:
+        return None
+    return '/'.join(parts[:depth])
+
+
+def aggregate(trace_dir, depth):
+    events, pid_names = load_events(trace_dir)
+    device_pids = {pid for pid, name in pid_names.items()
+                   if 'TPU' in name or 'GPU' in name or '/device' in name}
+    rows = collections.Counter()
+    total = 0.0
+    for e in events:
+        if device_pids and e.get('pid') not in device_pids:
+            continue
+        dur = float(e.get('dur', 0.0))
+        if dur <= 0:
+            continue
+        mod = module_of(e, depth)
+        total += dur
+        rows[mod if mod else '(unattributed)'] += dur
+    return rows, total
+
+
+def inspect(trace_dir, n=15):
+    events, pid_names = load_events(trace_dir)
+    print('processes:', pid_names)
+    shown = 0
+    for e in sorted(events, key=lambda e: -float(e.get('dur', 0))):
+        print(json.dumps(e)[:400])
+        shown += 1
+        if shown >= n:
+            break
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument('--model', default='ddrnet')
+    ap.add_argument('--batch', type=int, default=96)
+    ap.add_argument('--imgh', type=int, default=512)
+    ap.add_argument('--imgw', type=int, default=1024)
+    ap.add_argument('--iters', type=int, default=6)
+    ap.add_argument('--depth', type=int, default=1,
+                    help='module-path depth to aggregate at')
+    ap.add_argument('--trace-dir', default=None)
+    ap.add_argument('--hires-remat', action='store_true')
+    ap.add_argument('--detail-remat', action='store_true')
+    ap.add_argument('--eval', action='store_true',
+                    help='profile the eval step (EMA forward + CM) instead '
+                         'of the train step')
+    ap.add_argument('--no-capture', action='store_true',
+                    help='aggregate an existing trace only')
+    ap.add_argument('--inspect', action='store_true',
+                    help='dump the longest raw events and exit')
+    args = ap.parse_args()
+    trace_dir = args.trace_dir or f'/tmp/rtseg_profile/{args.model}'
+
+    if not args.no_capture and not args.inspect:
+        os.makedirs(trace_dir, exist_ok=True)
+        loss = capture(args.model, args.batch, args.imgh, args.imgw,
+                       trace_dir, args.iters, hires_remat=args.hires_remat,
+                       detail_remat=args.detail_remat, eval_mode=args.eval)
+        print(f'# traced {args.iters} iters, fence={loss:.4f}')
+    if args.inspect:
+        inspect(trace_dir)
+        return 0
+    rows, total = aggregate(trace_dir, args.depth)
+    print(f'\n| module (depth {args.depth}) | device ms/iter | share |')
+    print('|---|---|---|')
+    for mod, dur in rows.most_common(20):
+        print(f'| {mod} | {dur / 1000 / args.iters:.2f} | '
+              f'{100 * dur / total:.1f}% |')
+    print(f'| TOTAL | {total / 1000 / args.iters:.2f} | 100% |')
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

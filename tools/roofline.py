@@ -99,6 +99,13 @@ def _lane_occupancy(fn, shapes, x):
             for sub in eqn.params.values():
                 if hasattr(sub, 'jaxpr'):          # nested (pjit, remat...)
                     visit(sub.jaxpr)
+                elif isinstance(sub, (tuple, list)):
+                    # params holding SEQUENCES of ClosedJaxprs (cond
+                    # branches, scan bodies) would otherwise be silently
+                    # skipped and their convs dropped from the estimate
+                    for el in sub:
+                        if hasattr(el, 'jaxpr'):
+                            visit(el.jaxpr)
             if eqn.primitive.name != 'conv_general_dilated':
                 continue
             aval = eqn.outvars[0].aval

@@ -1,0 +1,31 @@
+#!/bin/bash
+# Round-4 first on-chip queue (one TPU workload at a time; appends to
+# round4_onchip.log; safe to re-run from any step).
+#
+# Covers the VERDICT round-3 items measurable with existing code:
+#   - item 8: bs1 latency honesty row (reference protocol is bare forward
+#     at 1024x512 bs1, /root/reference/tools/test_speed.py:9-61)
+#   - ADVICE item 1: the bs64 full-res eval numbers asserted in
+#     BENCHMARKS.md without a committed evidence log
+#   - item 6: Pallas CM vs einsum CM on the integrated eval path at the
+#     serving shape (2048x1024 bs16)
+set -x -o pipefail
+cd "$(dirname "$0")/.."
+LOG=round4_onchip.log
+{
+date
+# 0. tunnel sanity
+timeout 300 python -c "import jax; import jax.numpy as jnp; print(jax.devices()); x=jnp.ones((8,8)); print((x@x).sum())" || exit 1
+
+# 1. bs1 latency (reference protocol shape)
+python tools/benchmark_all.py --batch 1 --models fastscnn,bisenetv2,ddrnet,stdc,ppliteseg,enet
+
+# 2. bs64 full-res eval evidence log (numbers previously asserted unlogged)
+python tools/benchmark_all.py --eval --batch 64 --imgh 1024 --imgw 2048 --models fastscnn,ddrnet,ppliteseg,stdc
+
+# 3. Pallas CM vs einsum CM, same compiled eval step otherwise
+python tools/benchmark_all.py --eval --batch 16 --imgh 1024 --imgw 2048 --models bisenetv2,fastscnn
+python tools/benchmark_all.py --eval --batch 16 --imgh 1024 --imgw 2048 --pallas-cm --models bisenetv2,fastscnn
+date
+} 2>&1 | tee -a "$LOG"
+exit "${PIPESTATUS[0]}"

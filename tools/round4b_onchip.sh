@@ -1,0 +1,32 @@
+#!/bin/bash
+# Round-4 second on-chip queue: remat generalization (VERDICT r3 item 3)
+# and the full-res eval attack (item 4). One TPU workload at a time;
+# appends to round4b_onchip.log; safe to re-run from any step.
+set -x -o pipefail
+cd "$(dirname "$0")/.."
+LOG=round4b_onchip.log
+{
+date
+# 0. tunnel sanity
+timeout 300 python -c "import jax; import jax.numpy as jnp; print(jax.devices()); x=jnp.ones((8,8)); print((x@x).sum())" || exit 1
+
+# 1. pre-remat train profiles: find each model's dominant branch
+#    (the 41fc827 method; event-args sanity first via --inspect)
+python tools/profile_step.py --model ddrnet --batch 96 --iters 6 --depth 1
+python tools/profile_step.py --model ddrnet --no-capture --inspect | head -20
+python tools/profile_step.py --model stdc --batch 96 --iters 6 --depth 1
+python tools/profile_step.py --model ppliteseg --batch 96 --iters 6 --depth 2
+
+# 2. ppliteseg bs128 baseline (never measured) + hires-remat bs128 sweep
+python tools/benchmark_all.py --train --batch 128 --models ppliteseg
+python tools/benchmark_all.py --train --batch 128 --hires-remat --models ddrnet,stdc,ppliteseg
+
+# 3. bisenetv2 full-res eval profile (where do the 14.3%-MFU cycles go?)
+python tools/profile_step.py --eval --model bisenetv2 --batch 16 --imgh 1024 --imgw 2048 --iters 6 --depth 1
+
+# 4. re-measure the Pallas CM with the final int32-accumulate kernel
+#    (batch-1 numbers were the f32-accumulate draft)
+python tools/benchmark_all.py --eval --batch 16 --imgh 1024 --imgw 2048 --pallas-cm --models bisenetv2,fastscnn
+date
+} 2>&1 | tee -a "$LOG"
+exit "${PIPESTATUS[0]}"
