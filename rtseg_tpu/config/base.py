@@ -190,6 +190,11 @@ class SegConfig:
     # drop the big early-stage residuals, keep the cheap deep ones). Math
     # identical; param paths unchanged (function-scope nn.remat).
     hires_remat: bool = False
+    # bisenetv2: eval-only S2D(2) compute layout for the full-res stem +
+    # detail stages (the generalization of segnet_pack — the stem's thin-
+    # channel tensors are 38.7% of the full-res eval step). Exact, same
+    # param tree; see nn/packed.py.
+    pack_fullres: bool = False
 
     # ----- Derived fields (filled by resolve(); never set by hand) -----
     train_num: int = 0

@@ -15,22 +15,45 @@ import jax
 
 from ..nn import (Activation, BatchNorm, Conv, ConvBNAct, DWConvBNAct,
                   PWConvBNAct, SegHead)
+from ..nn.packed import PackedConvBNAct, can_pack
 from ..ops import global_avg_pool, max_pool, avg_pool, resize_bilinear
+from ..ops.s2d import (depth_to_space2, packed_concat,
+                       packed_max_pool3x3_s2, space_to_depth2)
 
 
 class StemBlock(nn.Module):
     out_channels: int = 16
     act_type: str = 'relu'
+    # eval-only S2D(2) compute layout: the stem's 3-32-channel tensors at
+    # 1/1-1/4 resolution fill 2-25% of the vector lanes unpacked and are
+    # 38.7% of the full-res eval step (BENCHMARKS.md round-4 profile);
+    # packed, every op runs at 4x the channel density. Exact weight-space
+    # rewrite, same param tree (nn/packed.py).
+    packed: bool = False
 
     @nn.compact
     def __call__(self, x, train=False):
         c = self.out_channels
-        x = ConvBNAct(c, 3, 2, act_type=self.act_type)(x, train)
-        left = ConvBNAct(c // 2, 1, act_type=self.act_type)(x, train)
-        left = ConvBNAct(c, 3, 2, act_type=self.act_type)(left, train)
+        a = self.act_type
+        if can_pack(x, train, self.packed, grid=8):
+            xp = space_to_depth2(x)
+            xp = PackedConvBNAct(c, x.shape[-1], a, 3, 2,
+                                 name='ConvBNAct_0')(xp)
+            left = PackedConvBNAct(c // 2, c, a, 1, 1,
+                                   name='ConvBNAct_1')(xp)
+            left = PackedConvBNAct(c, c // 2, a, 3, 2,
+                                   name='ConvBNAct_2')(left)
+            right = packed_max_pool3x3_s2(xp)
+            xp = packed_concat([left, right])
+            xp = PackedConvBNAct(c, 2 * c, a, 3, 1,
+                                 name='ConvBNAct_3')(xp)
+            return depth_to_space2(xp)
+        x = ConvBNAct(c, 3, 2, act_type=a)(x, train)
+        left = ConvBNAct(c // 2, 1, act_type=a)(x, train)
+        left = ConvBNAct(c, 3, 2, act_type=a)(left, train)
         right = max_pool(x, 3, 2, 1)
         x = jax.numpy.concatenate([left, right], axis=-1)
-        return ConvBNAct(c, 3, 1, act_type=self.act_type)(x, train)
+        return ConvBNAct(c, 3, 1, act_type=a)(x, train)
 
 
 class GatherExpansionLayer(nn.Module):
@@ -77,12 +100,31 @@ class ContextEmbeddingBlock(nn.Module):
 class DetailBranch(nn.Module):
     out_channels: int = 128
     act_type: str = 'relu'
+    # eval-only S2D(2) layout for the first three convs (the 1/1-1/2-res
+    # 64-channel stages — 20% of the full-res eval step, half-empty lanes
+    # unpacked); exact rewrite, same param tree
+    packed: bool = False
 
     @nn.compact
     def __call__(self, x, train=False):
         a = self.act_type
-        for c, s in ((64, 2), (64, 1), (64, 2), (64, 1), (128, 1),
-                     (128, 2), (128, 1), (self.out_channels, 1)):
+        specs = ((64, 2), (64, 1), (64, 2), (64, 1), (128, 1),
+                 (128, 2), (128, 1), (self.out_channels, 1))
+        # grid=8: the S2D pack plus TWO stride-2 convs need H, W divisible
+        # by 8 or the second packed conv runs on an odd grid with wrong
+        # borders (silently non-exact)
+        if can_pack(x, train, self.packed, grid=8):
+            xp = space_to_depth2(x)
+            xp = PackedConvBNAct(64, x.shape[-1], a, 3, 2,
+                                 name='ConvBNAct_0')(xp)
+            xp = PackedConvBNAct(64, 64, a, 3, 1, name='ConvBNAct_1')(xp)
+            xp = PackedConvBNAct(64, 64, a, 3, 2, name='ConvBNAct_2')(xp)
+            x = depth_to_space2(xp)
+            for i, (c, s) in enumerate(specs[3:], start=3):
+                x = ConvBNAct(c, 3, s, act_type=a,
+                              name=f'ConvBNAct_{i}')(x, train)
+            return x
+        for c, s in specs:
             x = ConvBNAct(c, 3, s, act_type=a)(x, train)
         return x
 
@@ -92,12 +134,13 @@ class SemanticBranch(nn.Module):
     num_class: int = 1
     act_type: str = 'relu'
     use_aux: bool = False
+    packed: bool = False               # forwarded to StemBlock (eval-only)
 
     @nn.compact
     def __call__(self, x, train=False):
         a = self.act_type
         aux = []
-        x = StemBlock(16, a)(x, train)                         # 1/4
+        x = StemBlock(16, a, packed=self.packed)(x, train)     # 1/4
         if self.use_aux:
             aux.append(SegHead(self.num_class, a, name='seg_head2')(x, train))
         x = GatherExpansionLayer(32, 2, a)(x, train)           # 1/8
@@ -154,6 +197,9 @@ class BiSeNetv2(nn.Module):
     # them is what lets the flagship train at the lane-filling bs128.
     # Param paths are unchanged (nn.remat preserves module names).
     detail_remat: bool = False
+    # eval-only S2D(2) compute layout for the full-res stem + detail
+    # stages (config.pack_fullres); exact, same params — see nn/packed.py
+    pack_fullres: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -162,9 +208,11 @@ class BiSeNetv2(nn.Module):
                       if self.detail_remat else DetailBranch)
         # pin the scope name: nn.remat's auto-name would be
         # CheckpointDetailBranch_0, breaking checkpoint/transplant paths
-        x_d = detail_cls(128, self.act_type, name='DetailBranch_0')(x, train)
+        x_d = detail_cls(128, self.act_type, packed=self.pack_fullres,
+                         name='DetailBranch_0')(x, train)
         x_s, aux = SemanticBranch(128, self.num_class, self.act_type,
-                                  self.use_aux)(x, train)
+                                  self.use_aux,
+                                  packed=self.pack_fullres)(x, train)
         x = BilateralGuidedAggregationLayer(128, self.act_type)(
             x_d, x_s, train)
         x = SegHead(self.num_class, self.act_type)(x, train)

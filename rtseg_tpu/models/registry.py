@@ -81,7 +81,8 @@ def get_model(config):
     hires = getattr(config, 'hires_remat', False)
     if name == 'bisenetv2':
         return cls(num_class=config.num_class, use_aux=config.use_aux,
-                   detail_remat=getattr(config, 'detail_remat', False))
+                   detail_remat=getattr(config, 'detail_remat', False),
+                   pack_fullres=getattr(config, 'pack_fullres', False))
     if name == 'ddrnet':
         return cls(num_class=config.num_class, use_aux=config.use_aux,
                    hires_remat=hires)

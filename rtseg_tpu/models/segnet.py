@@ -21,83 +21,11 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-from ..nn import Activation, ConvBNAct
+from ..nn import ConvBNAct
+from ..nn.packed import PackedConvBNAct
 from ..ops import max_pool_argmax_2x2, max_unpool_2x2
-from ..ops.s2d import (depth_to_space2, packed_conv3x3,
-                       packed_max_pool_argmax_2x2, packed_max_unpool_2x2,
-                       space_to_depth2)
-
-
-class _PackedKernel(nn.Module):
-    """Inner param holder mirroring nn.Conv's scope ('conv', key 'kernel',
-    ORIGINAL (3,3,ci,co) shape); the conv itself runs packed."""
-    out_channels: int
-    in_channels: int
-
-    @nn.compact
-    def __call__(self, xp):
-        kernel = self.param('kernel', nn.initializers.lecun_normal(),
-                            (3, 3, self.in_channels, self.out_channels),
-                            jnp.float32)
-        return packed_conv3x3(xp, kernel)
-
-
-class _PackedK3(nn.Module):
-    """Scope twin of nn/modules.Conv computing on the packed input."""
-    out_channels: int
-    in_channels: int
-
-    @nn.compact
-    def __call__(self, xp):
-        return _PackedKernel(self.out_channels, self.in_channels,
-                             name='conv')(xp)
-
-
-class _PackedBNParams(nn.Module):
-    """Inner param/stat holder mirroring nn.BatchNorm's scope ('bn')."""
-    features: int
-    epsilon: float = 1e-5
-
-    @nn.compact
-    def __call__(self, xp):
-        scale = self.param('scale', nn.initializers.ones,
-                           (self.features,), jnp.float32)
-        bias = self.param('bias', nn.initializers.zeros,
-                          (self.features,), jnp.float32)
-        mean = self.variable('batch_stats', 'mean',
-                             lambda: jnp.zeros((self.features,), jnp.float32))
-        var = self.variable('batch_stats', 'var',
-                            lambda: jnp.ones((self.features,), jnp.float32))
-        inv = scale / jnp.sqrt(var.value + self.epsilon)
-        mul = jnp.tile(inv, 4).astype(xp.dtype)
-        add = jnp.tile(bias - mean.value * inv, 4).astype(xp.dtype)
-        return xp * mul + add
-
-
-class _PackedEvalBN(nn.Module):
-    """Scope twin of nn/modules.BatchNorm applied to packed channels via
-    4x-tiled running statistics. Eval-only (running stats)."""
-    features: int
-
-    @nn.compact
-    def __call__(self, xp):
-        return _PackedBNParams(self.features, name='bn')(xp)
-
-
-class _PackedConvBNAct(nn.Module):
-    """Scope-compatible twin of ConvBNAct(out, 3) on packed input: the
-    param tree (Conv_0/conv/kernel, BatchNorm_0/bn/{scale,bias}+stats) is
-    identical, so the same weights serve both layouts."""
-    out_channels: int
-    in_channels: int
-    act_type: str = 'relu'
-
-    @nn.compact
-    def __call__(self, xp):
-        xp = _PackedK3(self.out_channels, self.in_channels,
-                       name='Conv_0')(xp)
-        xp = _PackedEvalBN(self.out_channels, name='BatchNorm_0')(xp)
-        return Activation(self.act_type)(xp)
+from ..ops.s2d import (depth_to_space2, packed_max_pool_argmax_2x2,
+                       packed_max_unpool_2x2, space_to_depth2)
 
 
 class DownsampleBlock(nn.Module):
@@ -111,12 +39,12 @@ class DownsampleBlock(nn.Module):
         c = self.out_channels
         if self.packed and not train:
             xp = space_to_depth2(x)
-            xp = _PackedConvBNAct(c, x.shape[-1], self.act_type,
+            xp = PackedConvBNAct(c, x.shape[-1], self.act_type,
                                   name='ConvBNAct_0')(xp)
-            xp = _PackedConvBNAct(c, c, self.act_type,
+            xp = PackedConvBNAct(c, c, self.act_type,
                                   name='ConvBNAct_1')(xp)
             if self.extra_conv:
-                xp = _PackedConvBNAct(c, c, self.act_type,
+                xp = PackedConvBNAct(c, c, self.act_type,
                                       name='ConvBNAct_2')(xp)
             return packed_max_pool_argmax_2x2(xp)
         x = ConvBNAct(c, 3, act_type=self.act_type)(x, train)
@@ -139,12 +67,12 @@ class UpsampleBlock(nn.Module):
         if self.packed and not train:
             # output stays packed; SegNet unpacks after the classifier
             xp = packed_max_unpool_2x2(x, indices)
-            xp = _PackedConvBNAct(in_c, in_c, self.act_type,
+            xp = PackedConvBNAct(in_c, in_c, self.act_type,
                                   name='ConvBNAct_0')(xp)
-            xp = _PackedConvBNAct(hid, in_c, self.act_type,
+            xp = PackedConvBNAct(hid, in_c, self.act_type,
                                   name='ConvBNAct_1')(xp)
             if self.extra_conv:
-                xp = _PackedConvBNAct(self.out_channels, hid, self.act_type,
+                xp = PackedConvBNAct(self.out_channels, hid, self.act_type,
                                       name='ConvBNAct_2')(xp)
             return xp
         x = max_unpool_2x2(x, indices)
@@ -178,7 +106,7 @@ class SegNet(nn.Module):
         x = UpsampleBlock(h, a, False)(x, i2, train)
         x = UpsampleBlock(h, a, False, packed=pk)(x, i1, train)
         if pk:
-            xp = _PackedConvBNAct(self.num_class, h, a,
+            xp = PackedConvBNAct(self.num_class, h, a,
                                   name='ConvBNAct_0')(x)
             return depth_to_space2(xp)
         return ConvBNAct(self.num_class, act_type=a)(x, train)

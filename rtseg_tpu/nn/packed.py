@@ -1,0 +1,119 @@
+"""Scope-compatible S2D(2) twins of the nn/modules building blocks.
+
+Each twin declares its parameters with the ORIGINAL shapes under the
+ORIGINAL scope names (ConvBNAct_i/Conv_0/conv/kernel,
+BatchNorm_0/bn/{scale,bias} + batch_stats), so one parameter tree serves
+both layouts; only the compute runs packed (ops/s2d.py exact weight-space
+rewrites). Eval-only: BN applies running statistics, 4x-tiled over the
+sub-position groups.
+
+First used by segnet's pack_fullres (round 3, where it un-OOMed the bs64
+full-res forward at 63.5% MFU); generalized in round 4 for bisenetv2's
+full-res stem/detail stages, whose 3-32-channel tensors occupy 2-25% of
+the 128 vector lanes unpacked (the measured 38.7%-of-eval StemBlock hot
+spot, BENCHMARKS.md round-4 profile).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..ops.s2d import (packed_conv1x1, packed_conv3x3, packed_conv3x3_s2,
+                       space_to_depth2)
+from .modules import Activation
+
+
+class _PackedKernel(nn.Module):
+    """Param holder mirroring nn/modules Conv's scope ('conv' -> 'kernel',
+    ORIGINAL (k,k,ci,co) shape); the conv itself runs packed."""
+    out_channels: int
+    in_channels: int
+    kernel_size: int = 3
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, xp):
+        k = self.kernel_size
+        kernel = self.param('kernel', nn.initializers.lecun_normal(),
+                            (k, k, self.in_channels, self.out_channels),
+                            jnp.float32)
+        if k == 1:
+            return packed_conv1x1(xp, kernel)
+        if self.stride == 2:
+            return packed_conv3x3_s2(xp, kernel)
+        return packed_conv3x3(xp, kernel)
+
+
+class _PackedConv(nn.Module):
+    """Scope twin of nn/modules.Conv computing on the packed input."""
+    out_channels: int
+    in_channels: int
+    kernel_size: int = 3
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, xp):
+        return _PackedKernel(self.out_channels, self.in_channels,
+                             self.kernel_size, self.stride,
+                             name='conv')(xp)
+
+
+class _PackedBNParams(nn.Module):
+    """Param/stat holder mirroring nn.BatchNorm's scope ('bn')."""
+    features: int
+    epsilon: float = 1e-5
+
+    @nn.compact
+    def __call__(self, xp):
+        scale = self.param('scale', nn.initializers.ones,
+                           (self.features,), jnp.float32)
+        bias = self.param('bias', nn.initializers.zeros,
+                          (self.features,), jnp.float32)
+        mean = self.variable('batch_stats', 'mean',
+                             lambda: jnp.zeros((self.features,), jnp.float32))
+        var = self.variable('batch_stats', 'var',
+                            lambda: jnp.ones((self.features,), jnp.float32))
+        inv = scale / jnp.sqrt(var.value + self.epsilon)
+        mul = jnp.tile(inv, 4).astype(xp.dtype)
+        add = jnp.tile(bias - mean.value * inv, 4).astype(xp.dtype)
+        return xp * mul + add
+
+
+class PackedEvalBN(nn.Module):
+    """Scope twin of nn/modules.BatchNorm applied to packed channels via
+    4x-tiled running statistics. Eval-only (running stats)."""
+    features: int
+
+    @nn.compact
+    def __call__(self, xp):
+        return _PackedBNParams(self.features, name='bn')(xp)
+
+
+class PackedConvBNAct(nn.Module):
+    """Scope-compatible twin of ConvBNAct(out, kernel_size, stride) on
+    packed input: identical param tree (Conv_0/conv/kernel,
+    BatchNorm_0/bn/...), packed compute. stride=2 keeps the output packed
+    (at half the packed grid)."""
+    out_channels: int
+    in_channels: int
+    act_type: str = 'relu'
+    kernel_size: int = 3
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, xp):
+        xp = _PackedConv(self.out_channels, self.in_channels,
+                         self.kernel_size, self.stride, name='Conv_0')(xp)
+        xp = PackedEvalBN(self.out_channels, name='BatchNorm_0')(xp)
+        return Activation(self.act_type)(xp)
+
+
+def can_pack(x, train: bool, enabled: bool, grid: int = 4) -> bool:
+    """The packed eval path applies only out of training and when the
+    spatial dims survive the pack + stride-2 chain exactly."""
+    return (enabled and not train
+            and x.shape[1] % grid == 0 and x.shape[2] % grid == 0)
+
+
+__all__ = ['PackedConvBNAct', 'PackedEvalBN', 'can_pack', 'space_to_depth2']

@@ -42,9 +42,12 @@ def depth_to_space2(x: jnp.ndarray) -> jnp.ndarray:
     return x.reshape(n, 2 * h2, 2 * w2, c)
 
 
-def pack_conv3x3_kernel(w: jnp.ndarray) -> jnp.ndarray:
-    """(3, 3, ci, co) k3/s1/p1 HWIO kernel -> (3, 3, 4ci, 4co) operating on
-    S2D(2) layout with 'same' (1,1) padding."""
+def _pack_conv3x3_kernel(w: jnp.ndarray, stride: int) -> jnp.ndarray:
+    """(3, 3, ci, co) k3/p1 HWIO kernel -> (3, 3, 4ci, 4co) operating on
+    S2D(2) layout ('same' (1,1) padding). stride=1 keeps the packed grid;
+    stride=2 (applied with conv stride (2,2)) keeps the OUTPUT packed at
+    half the grid. Tap condition: with packed input row (P,a) = 2P+a and
+    P = stride*I + t - 1, di = 2t + a - stride*e - 1 must land in [0, 2]."""
     ci, co = int(w.shape[2]), int(w.shape[3])
     wp = jnp.zeros((3, 3, 2, 2, ci, 2, 2, co), w.dtype)
     for t in range(3):
@@ -53,11 +56,18 @@ def pack_conv3x3_kernel(w: jnp.ndarray) -> jnp.ndarray:
                 for b in range(2):
                     for e in range(2):
                         for f in range(2):
-                            di, dj = 2 * t + a - e - 1, 2 * u + b - f - 1
+                            di = 2 * t + a - stride * e - 1
+                            dj = 2 * u + b - stride * f - 1
                             if 0 <= di <= 2 and 0 <= dj <= 2:
                                 wp = wp.at[t, u, a, b, :, e, f, :].set(
                                     w[di, dj])
     return wp.reshape(3, 3, 4 * ci, 4 * co)
+
+
+def pack_conv3x3_kernel(w: jnp.ndarray) -> jnp.ndarray:
+    """(3, 3, ci, co) k3/s1/p1 HWIO kernel -> (3, 3, 4ci, 4co) operating on
+    S2D(2) layout with 'same' (1,1) padding."""
+    return _pack_conv3x3_kernel(w, stride=1)
 
 
 def packed_conv3x3(xp: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
@@ -66,6 +76,75 @@ def packed_conv3x3(xp: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
     return lax.conv_general_dilated(
         xp, wp, (1, 1), ((1, 1), (1, 1)),
         dimension_numbers=('NHWC', 'HWIO', 'NHWC'))
+
+
+def pack_conv3x3_s2_kernel(w: jnp.ndarray) -> jnp.ndarray:
+    """(3, 3, ci, co) k3/STRIDE-2/p1 HWIO kernel -> (3, 3, 4ci, 4co) to be
+    applied with stride (2,2), padding (1,1) on S2D(2) layout; the output
+    stays packed (it is the S2D(2) of the unpacked stride-2 output)."""
+    return _pack_conv3x3_kernel(w, stride=2)
+
+
+def packed_conv3x3_s2(xp: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+    """Apply an original (3,3,ci,co) stride-2 kernel to an S2D(2)-packed
+    input; (N,H2,W2,4ci) -> (N,H2/2,W2/2,4co), still packed."""
+    wp = pack_conv3x3_s2_kernel(w).astype(xp.dtype)
+    return lax.conv_general_dilated(
+        xp, wp, (2, 2), ((1, 1), (1, 1)),
+        dimension_numbers=('NHWC', 'HWIO', 'NHWC'))
+
+
+def packed_conv1x1(xp: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+    """1x1 conv ((1,1,ci,co) or (ci,co) kernel) on S2D(2) layout: channel
+    mixing within each of the 4 sub-position groups."""
+    n, h, w_, c4 = xp.shape
+    ci = c4 // 4
+    k = w.reshape(ci, -1).astype(xp.dtype)
+    y = jnp.einsum('nhwgc,cd->nhwgd', xp.reshape(n, h, w_, 4, ci), k)
+    return y.reshape(n, h, w_, 4 * k.shape[1])
+
+
+# (t, a) row taps contributing to packed output sub-position e of a
+# k3/s2/p1 window: di = 2t+a-2e-1 in [0, 2]
+_POOL_TAPS = {0: ((0, 1), (1, 0), (1, 1)), 1: ((1, 1), (2, 0), (2, 1))}
+
+
+def packed_max_pool3x3_s2(xp: jnp.ndarray) -> jnp.ndarray:
+    """k3/stride-2/p1 max pool of the UNPACKED tensor, computed on — and
+    returning — S2D(2) layout: (N,H2,W2,4C) -> (N,H2/2,W2/2,4C). Matches
+    ops/pool.py max_pool(x, 3, 2, 1) exactly (-inf border padding)."""
+    n, h2, w2, c4 = xp.shape
+    c = c4 // 4
+    h4, w4 = h2 // 2, w2 // 2
+    g = xp.reshape(n, h2, w2, 2, 2, c)
+    neg = (-jnp.inf if jnp.issubdtype(xp.dtype, jnp.floating)
+           else jnp.iinfo(xp.dtype).min)
+    gp = jnp.pad(g, ((0, 0), (1, 1), (1, 1), (0, 0), (0, 0), (0, 0)),
+                 constant_values=neg)
+
+    def rows(e):
+        r = None
+        for t, a in _POOL_TAPS[e]:
+            s = gp[:, t:t + 2 * h4:2, :, a]          # (n, h4, w2+2, 2, c)
+            r = s if r is None else jnp.maximum(r, s)
+        return r
+
+    def cols(r, f):
+        o = None
+        for u, b in _POOL_TAPS[f]:
+            s = r[:, :, u:u + 2 * w4:2, b]           # (n, h4, w4, c)
+            o = s if o is None else jnp.maximum(o, s)
+        return o
+
+    out = [cols(rows(e), f) for e in range(2) for f in range(2)]
+    return jnp.stack(out, axis=3).reshape(n, h4, w4, 4 * c)
+
+
+def packed_concat(xs) -> jnp.ndarray:
+    """Channel concat in S2D(2) layout (per sub-position group)."""
+    parts = [x.reshape(*x.shape[:3], 4, -1) for x in xs]
+    y = jnp.concatenate(parts, axis=-1)
+    return y.reshape(*xs[0].shape[:3], -1)
 
 
 def packed_max_pool_argmax_2x2(

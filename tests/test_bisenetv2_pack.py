@@ -1,0 +1,79 @@
+"""bisenetv2 pack_fullres: the S2D(2) eval path must produce the SAME
+logits from the SAME parameter tree as the standard layout (the segnet
+pack_fullres guarantee, generalized). Also pins the scope-twin param-tree
+equality so checkpoints are interchangeable."""
+
+import sys
+from pathlib import Path
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, str(Path(__file__).parent))
+
+from rtseg_tpu.models.bisenetv2 import BiSeNetv2  # noqa: E402
+
+
+def _tree_paths(tree):
+    return [jax.tree_util.keystr(kp)
+            for kp, _ in jax.tree_util.tree_flatten_with_path(tree)[0]]
+
+
+def test_bisenetv2_pack_fullres_exact():
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.uniform(-1.5, 1.5, (2, 64, 128, 3))
+                    .astype(np.float32))
+    base = BiSeNetv2(num_class=19, use_aux=False)
+    packed = BiSeNetv2(num_class=19, use_aux=False, pack_fullres=True)
+    v = base.init(jax.random.PRNGKey(0), x, False)
+    # randomize BN stats so eval normalization is non-trivial
+    v = jax.tree.map(lambda a: a, v)
+    bs = jax.tree.map(
+        lambda a: jnp.asarray(
+            np.random.RandomState(abs(hash(str(a.shape))) % 2 ** 31)
+            .uniform(0.5, 1.5, a.shape).astype(np.float32)),
+        v['batch_stats'])
+    v = {'params': v['params'], 'batch_stats': bs}
+
+    vp = packed.init(jax.random.PRNGKey(0), x, False)
+    assert _tree_paths(vp['params']) == _tree_paths(v['params']), \
+        'pack_fullres changes the parameter tree'
+    assert _tree_paths(vp['batch_stats']) == _tree_paths(v['batch_stats'])
+
+    y0 = base.apply(v, x, False)
+    y1 = packed.apply(v, x, False)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y0),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_bisenetv2_pack_fullres_div4_not8_falls_back():
+    """H or W divisible by 4 but not 8 cannot survive the pack + two
+    stride-2 convs on an even grid — the packed path must NOT engage
+    (review finding: grid=4 produced silently wrong borders there)."""
+    rng = np.random.RandomState(7)
+    x = jnp.asarray(rng.uniform(-1.5, 1.5, (1, 20, 36, 3))
+                    .astype(np.float32))
+    base = BiSeNetv2(num_class=7, use_aux=False)
+    packed = BiSeNetv2(num_class=7, use_aux=False, pack_fullres=True)
+    v = base.init(jax.random.PRNGKey(0), x, False)
+    y0 = base.apply(v, x, False)
+    y1 = packed.apply(v, x, False)
+    np.testing.assert_array_equal(np.asarray(y0), np.asarray(y1))
+
+
+def test_bisenetv2_pack_fullres_train_falls_back():
+    """Training mode ignores the packed layout (it is eval-only: BN uses
+    running stats) — train outputs must be identical objects-wise too."""
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.uniform(-1, 1, (2, 64, 64, 3)).astype(np.float32))
+    m0 = BiSeNetv2(num_class=5, use_aux=True)
+    m1 = BiSeNetv2(num_class=5, use_aux=True, pack_fullres=True)
+    v = m0.init(jax.random.PRNGKey(0), x, False)
+    r = {'dropout': jax.random.PRNGKey(3)}
+    (y0, aux0), _ = m0.apply(v, x, True, mutable=['batch_stats'], rngs=r)
+    (y1, aux1), _ = m1.apply(v, x, True, mutable=['batch_stats'], rngs=r)
+    np.testing.assert_array_equal(np.asarray(y0), np.asarray(y1))
+    for a0, a1 in zip(aux0, aux1):
+        np.testing.assert_array_equal(np.asarray(a0), np.asarray(a1))

@@ -1,0 +1,61 @@
+"""Exactness of the round-4 S2D(2) op extensions (ops/s2d.py): stride-2
+packed conv, packed 1x1, packed k3/s2/p1 max pool, packed concat — each
+against its unpacked reference op on the same weights/input."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+from jax import lax
+
+from rtseg_tpu.ops import max_pool
+from rtseg_tpu.ops.s2d import (depth_to_space2, packed_concat,
+                               packed_conv1x1, packed_conv3x3_s2,
+                               packed_max_pool3x3_s2, space_to_depth2)
+
+
+def _conv_s2(x, w):
+    return lax.conv_general_dilated(
+        x, w, (2, 2), ((1, 1), (1, 1)),
+        dimension_numbers=('NHWC', 'HWIO', 'NHWC'))
+
+
+@pytest.mark.parametrize('h,w,ci,co', [(16, 24, 3, 16), (8, 8, 16, 8)])
+def test_packed_conv3x3_s2_exact(h, w, ci, co):
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(2, h, w, ci).astype(np.float32))
+    k = jnp.asarray(rng.randn(3, 3, ci, co).astype(np.float32) * 0.2)
+    want = _conv_s2(x, k)                       # (2, h/2, w/2, co)
+    got = depth_to_space2(packed_conv3x3_s2(space_to_depth2(x), k))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_packed_conv1x1_exact():
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(2, 12, 20, 16).astype(np.float32))
+    k = jnp.asarray(rng.randn(1, 1, 16, 8).astype(np.float32))
+    want = lax.conv_general_dilated(
+        x, k, (1, 1), 'VALID', dimension_numbers=('NHWC', 'HWIO', 'NHWC'))
+    got = depth_to_space2(packed_conv1x1(space_to_depth2(x), k))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize('h,w,c', [(16, 24, 16), (12, 8, 5)])
+def test_packed_max_pool3x3_s2_exact(h, w, c):
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(2, h, w, c).astype(np.float32))
+    want = max_pool(x, 3, 2, 1)
+    got = depth_to_space2(packed_max_pool3x3_s2(space_to_depth2(x)))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want))
+
+
+def test_packed_concat_matches_unpacked():
+    rng = np.random.RandomState(3)
+    a = rng.randn(2, 8, 8, 16).astype(np.float32)
+    b = rng.randn(2, 8, 8, 16).astype(np.float32)
+    want = np.concatenate([a, b], axis=-1)
+    got = depth_to_space2(packed_concat(
+        [space_to_depth2(jnp.asarray(a)), space_to_depth2(jnp.asarray(b))]))
+    np.testing.assert_array_equal(np.asarray(got), want)

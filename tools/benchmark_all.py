@@ -95,6 +95,7 @@ BENCH_S2D = {'on': False,        # set by --s2d; threaded via SegConfig
              'detail_remat': False,
              'hires_remat': False,
              'segnet_pack': False,
+             'pack_fullres': False,
              'pallas_cm': None}   # None = production auto (kernel on TPU)
 
 
@@ -147,6 +148,7 @@ def _setup_state(name, batch, h, w, **cfg_overrides):
                     segnet_pack=BENCH_S2D['segnet_pack'],
                     detail_remat=BENCH_S2D['detail_remat'],
                     hires_remat=BENCH_S2D['hires_remat'],
+                    pack_fullres=BENCH_S2D['pack_fullres'],
                     use_pallas_metrics=BENCH_S2D['pallas_cm'],
                     save_dir='/tmp/rtseg_bench', **cfg_overrides)
     cfg.resolve(num_devices=1)
@@ -235,6 +237,10 @@ def main() -> int:
     ap.add_argument('--segnet-pack', action='store_true',
                     help='enable segnet full-res S2D layout '
                          '(config.segnet_pack; the bs64 OOM mitigation)')
+    ap.add_argument('--pack-fullres', action='store_true',
+                    help='bisenetv2: eval-only S2D(2) layout for the '
+                         'full-res stem/detail stages '
+                         '(config.pack_fullres)')
     ap.add_argument('--hires-remat', action='store_true',
                     help='stdc/ddrnet/ppliteseg: rematerialize the '
                          'high-resolution encoder stages in backward '
@@ -258,6 +264,7 @@ def main() -> int:
     BENCH_S2D['segnet_pack'] = args.segnet_pack
     BENCH_S2D['detail_remat'] = args.detail_remat
     BENCH_S2D['hires_remat'] = args.hires_remat
+    BENCH_S2D['pack_fullres'] = args.pack_fullres
     BENCH_S2D['pallas_cm'] = args.pallas_cm
     peak, device_kind = peak_flops(args.peak_flops)
     kind = 'train' if args.train else 'eval' if args.eval else 'forward'

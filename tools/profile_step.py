@@ -31,7 +31,7 @@ import numpy as np
 
 
 def capture(model_name, batch, h, w, trace_dir, iters, hires_remat=False,
-            detail_remat=False, eval_mode=False):
+            detail_remat=False, pack_fullres=False, eval_mode=False):
     import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh
@@ -51,6 +51,7 @@ def capture(model_name, batch, h, w, trace_dir, iters, hires_remat=False,
                                      and not eval_mode),
                     use_ema=True, loss_type='ohem',
                     detail_remat=detail_remat, hires_remat=hires_remat,
+                    pack_fullres=pack_fullres,
                     save_dir='/tmp/rtseg_profile')
     cfg.resolve(num_devices=1)
     cfg.resolve_schedule(train_num=batch * 1000)
@@ -134,14 +135,23 @@ def aggregate(trace_dir, depth):
     events, pid_names = load_events(trace_dir)
     device_pids = {pid for pid, name in pid_names.items()
                    if 'TPU' in name or 'GPU' in name or '/device' in name}
+    dev_events = [e for e in events
+                  if (not device_pids or e.get('pid') in device_pids)
+                  and float(e.get('dur', 0)) > 0]
+    # the device track carries several thread lines: whole-step container
+    # events (one per iteration) AND the per-HLO-op line; summing all of
+    # them double-counts every cycle. The op-level line is the tid with
+    # the most events — aggregate only that one.
+    per_line = collections.Counter(
+        (e.get('pid'), e.get('tid')) for e in dev_events)
+    if per_line:
+        op_line = per_line.most_common(1)[0][0]
+        dev_events = [e for e in dev_events
+                      if (e.get('pid'), e.get('tid')) == op_line]
     rows = collections.Counter()
     total = 0.0
-    for e in events:
-        if device_pids and e.get('pid') not in device_pids:
-            continue
+    for e in dev_events:
         dur = float(e.get('dur', 0.0))
-        if dur <= 0:
-            continue
         mod = module_of(e, depth)
         total += dur
         rows[mod if mod else '(unattributed)'] += dur
@@ -171,6 +181,7 @@ def main():
     ap.add_argument('--trace-dir', default=None)
     ap.add_argument('--hires-remat', action='store_true')
     ap.add_argument('--detail-remat', action='store_true')
+    ap.add_argument('--pack-fullres', action='store_true')
     ap.add_argument('--eval', action='store_true',
                     help='profile the eval step (EMA forward + CM) instead '
                          'of the train step')
@@ -185,7 +196,8 @@ def main():
         os.makedirs(trace_dir, exist_ok=True)
         loss = capture(args.model, args.batch, args.imgh, args.imgw,
                        trace_dir, args.iters, hires_remat=args.hires_remat,
-                       detail_remat=args.detail_remat, eval_mode=args.eval)
+                       detail_remat=args.detail_remat,
+                       pack_fullres=args.pack_fullres, eval_mode=args.eval)
         print(f'# traced {args.iters} iters, fence={loss:.4f}')
     if args.inspect:
         inspect(trace_dir)

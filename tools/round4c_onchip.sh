@@ -1,0 +1,23 @@
+#!/bin/bash
+# Round-4 third on-chip queue: hires_remat in its real use case — the
+# reference's 1024x1024 train crop (README.md:174-175), where activation
+# memory doubles vs the 1024x512 bench shape and the lane-filling batch
+# may not fit without remat. A/B max-batch and throughput.
+set -x -o pipefail
+cd "$(dirname "$0")/.."
+LOG=round4c_onchip.log
+{
+date
+timeout 300 python -c "import jax; import jax.numpy as jnp; print(jax.devices()); x=jnp.ones((8,8)); print((x@x).sum())" || exit 1
+
+# baseline 1024^2: expect OOM at bs128 somewhere; probe 64 then 128
+python tools/benchmark_all.py --train --batch 64 --imgh 1024 --imgw 1024 --models stdc,ddrnet,ppliteseg
+python tools/benchmark_all.py --train --batch 128 --imgh 1024 --imgw 1024 --models stdc,ddrnet,ppliteseg
+# remat 1024^2 at the same batches
+python tools/benchmark_all.py --train --batch 128 --imgh 1024 --imgw 1024 --hires-remat --models stdc,ddrnet,ppliteseg
+# bisenetv2 1024^2 for the full flagship picture (detail_remat lever)
+python tools/benchmark_all.py --train --batch 64 --imgh 1024 --imgw 1024 --detail-remat --models bisenetv2
+python tools/benchmark_all.py --train --batch 128 --imgh 1024 --imgw 1024 --detail-remat --models bisenetv2
+date
+} 2>&1 | tee -a "$LOG"
+exit "${PIPESTATUS[0]}"

@@ -1,0 +1,21 @@
+#!/bin/bash
+# Round-4 fourth on-chip queue: the bisenetv2 pack_fullres eval A/B
+# (VERDICT r3 item 4 — attack the 14.3%-MFU full-res serving shape).
+set -x -o pipefail
+cd "$(dirname "$0")/.."
+LOG=round4d_onchip.log
+{
+date
+timeout 300 python -c "import jax; import jax.numpy as jnp; print(jax.devices()); x=jnp.ones((8,8)); print((x@x).sum())" || exit 1
+
+# packed vs standard eval at the serving shape (standard bs16 baseline =
+# 161-166 imgs/sec, round4_onchip.log)
+python tools/benchmark_all.py --eval --batch 16 --imgh 1024 --imgw 2048 --pack-fullres --models bisenetv2
+# packed halves the stem HBM: probe the next batch up
+python tools/benchmark_all.py --eval --batch 32 --imgh 1024 --imgw 2048 --pack-fullres --models bisenetv2
+python tools/benchmark_all.py --eval --batch 32 --imgh 1024 --imgw 2048 --models bisenetv2
+# packed eval profile: where does the time go now?
+python tools/profile_step.py --eval --model bisenetv2 --batch 16 --imgh 1024 --imgw 2048 --iters 6 --depth 3 --pack-fullres
+date
+} 2>&1 | tee -a "$LOG"
+exit "${PIPESTATUS[0]}"

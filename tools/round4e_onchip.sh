@@ -1,0 +1,20 @@
+#!/bin/bash
+# Round-4 fifth on-chip queue: stdc at its own memory-bound shape (bs64
+# 1024^2 baseline OOMs — round4c) with hires_remat, + the driver bench
+# sanity (verify surface).
+set -x -o pipefail
+cd "$(dirname "$0")/.."
+LOG=round4e_onchip.log
+{
+date
+timeout 300 python -c "import jax; import jax.numpy as jnp; print(jax.devices()); x=jnp.ones((8,8)); print((x@x).sum())" || exit 1
+python tools/benchmark_all.py --train --batch 64 --imgh 1024 --imgw 1024 --hires-remat --models stdc
+python tools/benchmark_all.py --train --batch 32 --imgh 1024 --imgw 1024 --models stdc
+# full-res eval batch scaling now that the Pallas CM freed the one-hot HBM
+python tools/benchmark_all.py --eval --batch 64 --imgh 1024 --imgw 2048 --models bisenetv2
+# attribution control: einsum CM at bs32 (did the Pallas CM unlock bs32?)
+python tools/benchmark_all.py --eval --batch 32 --imgh 1024 --imgw 2048 --no-pallas-cm --models bisenetv2
+python bench.py
+date
+} 2>&1 | tee -a "$LOG"
+exit "${PIPESTATUS[0]}"
