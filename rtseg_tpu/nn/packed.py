@@ -39,6 +39,8 @@ class _PackedKernel(nn.Module):
                             (k, k, self.in_channels, self.out_channels),
                             jnp.float32)
         if k == 1:
+            assert self.stride == 1, \
+                'packed 1x1 stride-2 conv is not implemented'
             return packed_conv1x1(xp, kernel)
         if self.stride == 2:
             return packed_conv3x3_s2(xp, kernel)
@@ -109,9 +111,12 @@ class PackedConvBNAct(nn.Module):
         return Activation(self.act_type)(xp)
 
 
-def can_pack(x, train: bool, enabled: bool, grid: int = 4) -> bool:
+def can_pack(x, train: bool, enabled: bool, *, grid: int) -> bool:
     """The packed eval path applies only out of training and when the
-    spatial dims survive the pack + stride-2 chain exactly."""
+    spatial dims survive the pack + stride-2 chain exactly. `grid` is
+    deliberately required: 4 covers the bare pack, and each stride-2 conv
+    in the packed segment doubles it (2 stride-2 convs -> grid=8) — a
+    too-small grid produces silently wrong borders, not an error."""
     return (enabled and not train
             and x.shape[1] % grid == 0 and x.shape[2] % grid == 0)
 

@@ -28,11 +28,13 @@ def test_bisenetv2_pack_fullres_exact():
     base = BiSeNetv2(num_class=19, use_aux=False)
     packed = BiSeNetv2(num_class=19, use_aux=False, pack_fullres=True)
     v = base.init(jax.random.PRNGKey(0), x, False)
-    # randomize BN stats so eval normalization is non-trivial
-    v = jax.tree.map(lambda a: a, v)
+    # randomize BN stats so eval normalization is non-trivial; per-leaf
+    # counter seed so every leaf (incl. each layer's mean vs var) draws
+    # DIFFERENT values — a mean/var swap in the packed BN must not cancel
+    counter = iter(range(10_000))
     bs = jax.tree.map(
         lambda a: jnp.asarray(
-            np.random.RandomState(abs(hash(str(a.shape))) % 2 ** 31)
+            np.random.RandomState(next(counter))
             .uniform(0.5, 1.5, a.shape).astype(np.float32)),
         v['batch_stats'])
     v = {'params': v['params'], 'batch_stats': bs}

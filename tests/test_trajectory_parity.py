@@ -153,7 +153,8 @@ def run_torch_trajectory(ref_model, ns, batches, val_batch, use_aux=False,
     return losses, lrs, cm, ema
 
 
-def run_jax_trajectory(cfg, variables, batches, val_batch):
+def run_jax_trajectory(cfg, variables, batches, val_batch,
+                       teacher_model=None, teacher_variables=None):
     """The repo's compiled train step on a 1-device mesh, then the eval
     step's EMA confusion matrix — the production path end to end."""
     from jax.sharding import Mesh
@@ -172,7 +173,8 @@ def run_jax_trajectory(cfg, variables, batches, val_batch):
                        ema_params=jax.tree.map(jnp.copy, params),
                        ema_batch_stats=jax.tree.map(jnp.copy, bstats))
     mesh = Mesh(np.array(jax.devices()[:1]), (DATA_AXIS,))
-    step = build_train_step(cfg, model, opt, mesh)
+    step = build_train_step(cfg, model, opt, mesh, teacher_model,
+                            teacher_variables)
     losses = []
     with jax.default_matmul_precision('highest'):
         for im, mk in batches:
@@ -286,6 +288,162 @@ def test_bisenetv2_ohem_aux_ema_trajectory(monkeypatch):
     # loss rtol 2e-2: measured max 1.1e-2 per-step rel drift (mean 3e-3)
     _assert_trajectory('bisenetv2/ohem+aux+ema', t_losses, j_losses,
                        t_lrs, j_lrs, t_cm, j_cm, loss_rtol=2e-2)
+
+
+@pytest.mark.slow
+def test_stdc_detail_ohem_trajectory(monkeypatch):
+    """50-step trajectory through the DETAIL-HEAD branch
+    (seg_trainer.py:68-82): OHEM main loss + Laplacian-pyramid detail
+    targets via the model's own detail_conv (thresholded in place, as the
+    reference does) + Dice+BCE detail loss + ramp EMA. Completes
+    trajectory coverage of all three reference forward branches."""
+    import torch
+    import torch.nn.functional as F
+    _shim_cuda(monkeypatch)
+    batches, val_batch = _make_batches(seed=21)
+    ref_mod = load_ref_model_module('stdc')
+    ref = ref_mod.STDC(num_class=NC, encoder_type='stdc1',
+                       use_detail_head=True)
+    cfg = _seg_config('stdc', loss_type='ohem', use_detail_head=True)
+    from rtseg_tpu.models import get_model
+
+    xt0 = torch.from_numpy(
+        np.transpose(batches[0][0], (0, 3, 1, 2)).copy())
+
+    def torch_forward(m):
+        # detail_conv is trainer-invoked only; the Flax twin materializes
+        # it first during init (same builder as test_logit_parity)
+        m.detail_conv(torch.zeros(1, 3, 4, 4))
+        m(xt0, is_training=True)
+
+    variables, _, _ = transplant_from_module(
+        ref, get_model(cfg), jnp.asarray(batches[0][0]),
+        torch_forward=torch_forward)
+
+    ns = _ref_ns(loss_type='ohem', detail_thrs=0.1, detail_loss_coef=1.0,
+                 dice_loss_coef=1.0, bce_loss_coef=1.0)
+    opt = load_ref_util('optimizer').get_optimizer(ns, ref)
+    sched = load_ref_util('scheduler').get_scheduler(ns, opt)
+    ema = load_ref_util('model_ema').ModelEmaV2(ns, ref, device=None)
+    loss_mod = load_ref_loss()
+    loss_fn = loss_mod.get_loss_fn(ns, torch.device('cpu'))
+    detail_loss_fn = loss_mod.get_detail_loss_fn(ns)
+    lap = ref_mod.LaplacianConv(torch.device('cpu'))
+
+    ref.train()
+    t_losses, t_lrs, itrs = [], [], 0
+    for im, mk in batches:
+        itrs += 1
+        xt = torch.from_numpy(np.transpose(im, (0, 3, 1, 2)).copy())
+        mt = torch.from_numpy(mk.astype(np.int64))
+        t_lrs.append(float(opt.param_groups[0]['lr']))
+        opt.zero_grad()
+        # detail GT as seg_trainer.py:69-77; the detach is mathematically
+        # identical to the reference's in-place thresholding (every element
+        # is overwritten with a constant, so no gradient reaches
+        # detail_conv either way) without autograd's in-place hazards
+        md = lap(mt.unsqueeze(1).float())
+        md = ref.detail_conv(md)
+        md = md.detach()
+        md[md > ns.detail_thrs] = 1
+        md[md <= ns.detail_thrs] = 0
+        detail_size = md.size()[2:]
+        preds, preds_detail = ref(xt, is_training=True)
+        preds_detail = F.interpolate(preds_detail, detail_size,
+                                     mode='bilinear', align_corners=True)
+        loss_detail = detail_loss_fn(preds_detail, md)
+        loss = loss_fn(preds, mt) + ns.detail_loss_coef * loss_detail
+        loss.backward()
+        opt.step()
+        sched.step()
+        ema.update(ref, itrs)
+        t_losses.append(float(loss.detach()))
+    val_im, val_mk = val_batch
+    ema.ema.eval()
+    with torch.no_grad():
+        vp = ema.ema(torch.from_numpy(
+            np.transpose(val_im, (0, 3, 1, 2)).copy())).argmax(1).numpy()
+    t_cm = np.zeros((NC, NC), np.int64)
+    valid = val_mk != 255
+    np.add.at(t_cm, (val_mk[valid], vp[valid]), 1)
+
+    j_losses, j_lrs, j_cm, state = run_jax_trajectory(
+        cfg, variables, batches, val_batch)
+    rel = _ema_tree_rel_l2(ema.ema, 'stdc', cfg, variables, state)
+    print(f'stdc/detail: EMA param tree global rel-L2 = {rel:.3e}')
+    assert rel < 7e-2
+    _assert_trajectory('stdc/detail+ohem', t_losses, j_losses, t_lrs,
+                       j_lrs, t_cm, j_cm, loss_rtol=2e-2)
+
+
+@pytest.mark.slow
+def test_fastscnn_kd_trajectory():
+    """50-step trajectory through the KD branch (seg_trainer.py:95-105):
+    CE + kl_div distillation from a frozen smp-style teacher, both sides
+    from the same transplanted teacher+student weights."""
+    import torch
+    from smp_stub import build_stub_smp
+    from test_logit_parity import randomize_torch
+    from rtseg_tpu.models import get_model
+    from rtseg_tpu.models.smp import build_smp_model
+
+    batches, val_batch = _make_batches(seed=31)
+    ref = load_ref_model_module('fastscnn').FastSCNN(num_class=NC)
+    teacher_t = build_stub_smp('deeplabv3p', 'resnet18', NC)
+    randomize_torch(teacher_t, seed=5)
+    teacher_t.eval()
+    cfg = _seg_config('fastscnn', loss_type='ce', kd_training=True,
+                      kd_loss_type='kl_div')
+    variables, _, _ = transplant_from_module(
+        ref, get_model(cfg), jnp.asarray(batches[0][0]))
+    teacher_j = build_smp_model('resnet18', 'deeplabv3p', NC)
+    tvars, _, _ = transplant_from_module(teacher_t, teacher_j,
+                                         jnp.asarray(batches[0][0]))
+
+    ns = _ref_ns(loss_type='ce', kd_training=True, kd_loss_type='kl_div',
+                 kd_loss_coefficient=1.0, kd_temperature=4.0)
+    opt = load_ref_util('optimizer').get_optimizer(ns, ref)
+    sched = load_ref_util('scheduler').get_scheduler(ns, opt)
+    ema = load_ref_util('model_ema').ModelEmaV2(ns, ref, device=None)
+    loss_mod = load_ref_loss()
+    loss_fn = loss_mod.get_loss_fn(ns, torch.device('cpu'))
+
+    ref.train()
+    t_losses, t_lrs, itrs = [], [], 0
+    for im, mk in batches:
+        itrs += 1
+        xt = torch.from_numpy(np.transpose(im, (0, 3, 1, 2)).copy())
+        mt = torch.from_numpy(mk.astype(np.int64))
+        t_lrs.append(float(opt.param_groups[0]['lr']))
+        opt.zero_grad()
+        preds = ref(xt)
+        loss = loss_fn(preds, mt)
+        with torch.no_grad():
+            tp = teacher_t(xt)
+        loss_kd = loss_mod.kd_loss_fn(ns, preds, tp.detach())
+        loss = loss + ns.kd_loss_coefficient * loss_kd
+        loss.backward()
+        opt.step()
+        sched.step()
+        ema.update(ref, itrs)
+        t_losses.append(float(loss.detach()))
+    val_im, val_mk = val_batch
+    ema.ema.eval()
+    with torch.no_grad():
+        vp = ema.ema(torch.from_numpy(
+            np.transpose(val_im, (0, 3, 1, 2)).copy())).argmax(1).numpy()
+    t_cm = np.zeros((NC, NC), np.int64)
+    valid = val_mk != 255
+    np.add.at(t_cm, (val_mk[valid], vp[valid]), 1)
+
+    j_losses, j_lrs, j_cm, state = run_jax_trajectory(
+        cfg, variables, batches, val_batch,
+        teacher_model=teacher_j, teacher_variables=tvars)
+    rel = _ema_tree_rel_l2(ema.ema, 'fastscnn', cfg, variables, state)
+    print(f'fastscnn/kd: EMA param tree global rel-L2 = {rel:.3e}')
+    assert rel < 5e-2
+    _assert_trajectory('fastscnn/ce+kd', t_losses, j_losses, t_lrs,
+                       j_lrs, t_cm, j_cm, loss_rtol=1e-2)
 
 
 # ------------------------------------------------- optimizer-semantics pins

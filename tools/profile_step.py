@@ -135,6 +135,10 @@ def aggregate(trace_dir, depth):
     events, pid_names = load_events(trace_dir)
     device_pids = {pid for pid, name in pid_names.items()
                    if 'TPU' in name or 'GPU' in name or '/device' in name}
+    if not device_pids:
+        print('# WARNING: no device (TPU/GPU) process track found — '
+              'aggregating HOST events; module shares will be '
+              'meaningless for device-time analysis', flush=True)
     dev_events = [e for e in events
                   if (not device_pids or e.get('pid') in device_pids)
                   and float(e.get('dur', 0)) > 0]

@@ -1,0 +1,16 @@
+#!/bin/bash
+# Round-4 sixth on-chip queue: full-res EVAL at the bs128 lane knee for
+# the flagship set (bs64 table: fastscnn 696@13.4%, ddrnet 468@23.9%,
+# ppliteseg 434@21.1%, stdc 380@29.3%, bisenetv2 326@28.7% — the train
+# knee says 128 lanes want 128 batch elements for thin-channel convs).
+set -x -o pipefail
+cd "$(dirname "$0")/.."
+LOG=round4f_onchip.log
+{
+date
+timeout 300 python -c "import jax; import jax.numpy as jnp; print(jax.devices()); x=jnp.ones((8,8)); print((x@x).sum())" || exit 1
+python tools/benchmark_all.py --eval --batch 128 --imgh 1024 --imgw 2048 --models fastscnn,ppliteseg,stdc,ddrnet,bisenetv2
+python tools/benchmark_all.py --eval --batch 64 --imgh 1024 --imgw 2048 --models bisenetv2,enet
+date
+} 2>&1 | tee -a "$LOG"
+exit "${PIPESTATUS[0]}"
