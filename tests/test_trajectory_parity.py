@@ -99,10 +99,30 @@ def _shim_cuda(monkeypatch):
                         lambda self, *a, **k: self, raising=False)
 
 
+def _torch_ema_val_cm(ema, val_batch):
+    """EMA-weights validation forward + host confusion matrix — the torch
+    side of seg_trainer.py:123-137. ONE copy: every trajectory test pins
+    the same validation protocol."""
+    import torch
+    val_im, val_mk = val_batch
+    ema.ema.eval()
+    with torch.no_grad():
+        vp = ema.ema(torch.from_numpy(
+            np.transpose(val_im, (0, 3, 1, 2)).copy()))
+    vp = vp.argmax(1).numpy()
+    cm = np.zeros((NC, NC), np.int64)
+    valid = val_mk != 255
+    np.add.at(cm, (val_mk[valid], vp[valid]), 1)
+    return cm
+
+
 def run_torch_trajectory(ref_model, ns, batches, val_batch, use_aux=False,
-                         aux_coef=None):
+                         aux_coef=None, loss_builder=None):
     """Reference per-iteration composition, mirroring
-    core/seg_trainer.py:38-121 (plain + aux branches; amp/DDP/tb disabled)."""
+    core/seg_trainer.py:38-121 (amp/DDP/tb disabled). The plain and aux
+    branches are built in; detail-head / KD tests inject their branch via
+    `loss_builder(model, loss_fn, xt, mt) -> loss` so the optimizer/
+    scheduler/EMA stepping and EMA-validation exist in exactly one copy."""
     import torch
     import torch.nn.functional as F
 
@@ -119,7 +139,9 @@ def run_torch_trajectory(ref_model, ns, batches, val_batch, use_aux=False,
         mt = torch.from_numpy(mk.astype(np.int64))
         lrs.append(float(opt.param_groups[0]['lr']))
         opt.zero_grad()
-        if use_aux:
+        if loss_builder is not None:
+            loss = loss_builder(ref_model, loss_fn, xt, mt)
+        elif use_aux:
             preds, preds_aux = ref_model(xt, is_training=True)
             loss = loss_fn(preds, mt)
             coefs = aux_coef if aux_coef is not None \
@@ -140,17 +162,7 @@ def run_torch_trajectory(ref_model, ns, batches, val_batch, use_aux=False,
         ema.update(ref_model, train_itrs)
         losses.append(float(loss.detach()))
 
-    # EMA-weights validation forward (seg_trainer.py:130)
-    val_im, val_mk = val_batch
-    ema.ema.eval()
-    with torch.no_grad():
-        vp = ema.ema(torch.from_numpy(
-            np.transpose(val_im, (0, 3, 1, 2)).copy()))
-    vp = vp.argmax(1).numpy()
-    cm = np.zeros((NC, NC), np.int64)
-    valid = val_mk != 255
-    np.add.at(cm, (val_mk[valid], vp[valid]), 1)
-    return losses, lrs, cm, ema
+    return losses, lrs, _torch_ema_val_cm(ema, val_batch), ema
 
 
 def run_jax_trajectory(cfg, variables, batches, val_batch,
@@ -322,50 +334,26 @@ def test_stdc_detail_ohem_trajectory(monkeypatch):
 
     ns = _ref_ns(loss_type='ohem', detail_thrs=0.1, detail_loss_coef=1.0,
                  dice_loss_coef=1.0, bce_loss_coef=1.0)
-    opt = load_ref_util('optimizer').get_optimizer(ns, ref)
-    sched = load_ref_util('scheduler').get_scheduler(ns, opt)
-    ema = load_ref_util('model_ema').ModelEmaV2(ns, ref, device=None)
-    loss_mod = load_ref_loss()
-    loss_fn = loss_mod.get_loss_fn(ns, torch.device('cpu'))
-    detail_loss_fn = loss_mod.get_detail_loss_fn(ns)
+    detail_loss_fn = load_ref_loss().get_detail_loss_fn(ns)
     lap = ref_mod.LaplacianConv(torch.device('cpu'))
 
-    ref.train()
-    t_losses, t_lrs, itrs = [], [], 0
-    for im, mk in batches:
-        itrs += 1
-        xt = torch.from_numpy(np.transpose(im, (0, 3, 1, 2)).copy())
-        mt = torch.from_numpy(mk.astype(np.int64))
-        t_lrs.append(float(opt.param_groups[0]['lr']))
-        opt.zero_grad()
+    def loss_builder(m, loss_fn, xt, mt):
         # detail GT as seg_trainer.py:69-77; the detach is mathematically
         # identical to the reference's in-place thresholding (every element
         # is overwritten with a constant, so no gradient reaches
         # detail_conv either way) without autograd's in-place hazards
         md = lap(mt.unsqueeze(1).float())
-        md = ref.detail_conv(md)
-        md = md.detach()
+        md = m.detail_conv(md).detach()
         md[md > ns.detail_thrs] = 1
         md[md <= ns.detail_thrs] = 0
-        detail_size = md.size()[2:]
-        preds, preds_detail = ref(xt, is_training=True)
-        preds_detail = F.interpolate(preds_detail, detail_size,
-                                     mode='bilinear', align_corners=True)
-        loss_detail = detail_loss_fn(preds_detail, md)
-        loss = loss_fn(preds, mt) + ns.detail_loss_coef * loss_detail
-        loss.backward()
-        opt.step()
-        sched.step()
-        ema.update(ref, itrs)
-        t_losses.append(float(loss.detach()))
-    val_im, val_mk = val_batch
-    ema.ema.eval()
-    with torch.no_grad():
-        vp = ema.ema(torch.from_numpy(
-            np.transpose(val_im, (0, 3, 1, 2)).copy())).argmax(1).numpy()
-    t_cm = np.zeros((NC, NC), np.int64)
-    valid = val_mk != 255
-    np.add.at(t_cm, (val_mk[valid], vp[valid]), 1)
+        preds, preds_detail = m(xt, is_training=True)
+        pd = F.interpolate(preds_detail, md.size()[2:], mode='bilinear',
+                           align_corners=True)
+        return loss_fn(preds, mt) \
+            + ns.detail_loss_coef * detail_loss_fn(pd, md)
+
+    t_losses, t_lrs, t_cm, ema = run_torch_trajectory(
+        ref, ns, batches, val_batch, loss_builder=loss_builder)
 
     j_losses, j_lrs, j_cm, state = run_jax_trajectory(
         cfg, variables, batches, val_batch)
@@ -402,39 +390,19 @@ def test_fastscnn_kd_trajectory():
 
     ns = _ref_ns(loss_type='ce', kd_training=True, kd_loss_type='kl_div',
                  kd_loss_coefficient=1.0, kd_temperature=4.0)
-    opt = load_ref_util('optimizer').get_optimizer(ns, ref)
-    sched = load_ref_util('scheduler').get_scheduler(ns, opt)
-    ema = load_ref_util('model_ema').ModelEmaV2(ns, ref, device=None)
     loss_mod = load_ref_loss()
-    loss_fn = loss_mod.get_loss_fn(ns, torch.device('cpu'))
 
-    ref.train()
-    t_losses, t_lrs, itrs = [], [], 0
-    for im, mk in batches:
-        itrs += 1
-        xt = torch.from_numpy(np.transpose(im, (0, 3, 1, 2)).copy())
-        mt = torch.from_numpy(mk.astype(np.int64))
-        t_lrs.append(float(opt.param_groups[0]['lr']))
-        opt.zero_grad()
-        preds = ref(xt)
+    def loss_builder(m, loss_fn, xt, mt):
+        # seg_trainer.py:95-105: frozen-teacher forward + kd term
+        preds = m(xt)
         loss = loss_fn(preds, mt)
         with torch.no_grad():
             tp = teacher_t(xt)
         loss_kd = loss_mod.kd_loss_fn(ns, preds, tp.detach())
-        loss = loss + ns.kd_loss_coefficient * loss_kd
-        loss.backward()
-        opt.step()
-        sched.step()
-        ema.update(ref, itrs)
-        t_losses.append(float(loss.detach()))
-    val_im, val_mk = val_batch
-    ema.ema.eval()
-    with torch.no_grad():
-        vp = ema.ema(torch.from_numpy(
-            np.transpose(val_im, (0, 3, 1, 2)).copy())).argmax(1).numpy()
-    t_cm = np.zeros((NC, NC), np.int64)
-    valid = val_mk != 255
-    np.add.at(t_cm, (val_mk[valid], vp[valid]), 1)
+        return loss + ns.kd_loss_coefficient * loss_kd
+
+    t_losses, t_lrs, t_cm, ema = run_torch_trajectory(
+        ref, ns, batches, val_batch, loss_builder=loss_builder)
 
     j_losses, j_lrs, j_cm, state = run_jax_trajectory(
         cfg, variables, batches, val_batch,

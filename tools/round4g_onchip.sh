@@ -1,0 +1,17 @@
+#!/bin/bash
+# Round-4 seventh on-chip queue: zoo-wide full-res (2048x1024) eval at the
+# bs128 knee — extends the flagship serving table across the zoo. Models
+# that OOM at bs128 fall through (the sweep reports FAILED and continues);
+# segnet runs with its S2D packing at its known-good bs64.
+set -x -o pipefail
+cd "$(dirname "$0")/.."
+LOG=round4g_onchip.log
+{
+date
+timeout 300 python -c "import jax; import jax.numpy as jnp; print(jax.devices()); x=jnp.ones((8,8)); print((x@x).sum())" || exit 1
+python tools/benchmark_all.py --eval --batch 128 --imgh 1024 --imgw 2048 --models erfnet,bisenetv1,esnet,cgnet,contextnet,dabnet || echo "## STEP FAILED rc=$? (queue continues)"
+python tools/benchmark_all.py --eval --batch 128 --imgh 1024 --imgw 2048 --models lednet,linknet,swiftnet,edanet,fssnet,sqnet || echo "## STEP FAILED rc=$? (queue continues)"
+python tools/benchmark_all.py --eval --batch 64 --imgh 1024 --imgw 2048 --segnet-pack --models segnet || echo "## STEP FAILED rc=$? (queue continues)"
+date
+} 2>&1 | tee -a "$LOG"
+exit "${PIPESTATUS[0]}"

@@ -1,0 +1,14 @@
+#!/bin/bash
+# Round-4 eighth on-chip queue: bs64 full-res eval for the models that
+# OOM at bs128, + segnet-pack at a full-res-feasible batch.
+set -x -o pipefail
+cd "$(dirname "$0")/.."
+LOG=round4h_onchip.log
+{
+date
+timeout 300 python -c "import jax; import jax.numpy as jnp; print(jax.devices()); x=jnp.ones((8,8)); print((x@x).sum())" || exit 1
+python tools/benchmark_all.py --eval --batch 64 --imgh 1024 --imgw 2048 --models bisenetv1,cgnet,contextnet,lednet,swiftnet,edanet,sqnet || echo "## STEP FAILED rc=$? (queue continues)"
+python tools/benchmark_all.py --eval --batch 16 --imgh 1024 --imgw 2048 --segnet-pack --models segnet || echo "## STEP FAILED rc=$? (queue continues)"
+date
+} 2>&1 | tee -a "$LOG"
+exit "${PIPESTATUS[0]}"
