@@ -1,0 +1,132 @@
+"""Fused serving head: bilinear-upsample class logits + channel argmax.
+
+The reference's eval/predict protocol upsamples logits to label resolution
+and argmaxes (reference core/seg_trainer.py:128-131,170-172 — the model's
+final F.interpolate followed by tensor.argmax(1)). Done naively on TPU that
+materializes a [B, H, W, C] full-resolution logit tensor in HBM — at the
+Cityscapes serving shape (bs128, 1024x2048, 19 classes) that is ~10 GB of
+write+read traffic per step just to pick the max channel, plus a separate
+full-size argmax reduce and int cast (measured 39% of the fastscnn eval
+step, BENCHMARKS.md round-4 "fused head" section).
+
+This op never builds the full-res tensor:
+
+  stage 1 (XLA einsum): W-interpolation at LOW height — [B,h,w,C] ->
+      [B,h,C,W] — the cheap axis order (contracting w at low h costs ~8x
+      less than at full H), laid out channel-major for the kernel.
+  stage 2 (Pallas): per (batch, W-tile) program, loop over H-tiles: a
+      [TH,h] x [h,C*TW] MXU dot performs the H-interpolation for one output
+      tile, and the channel argmax runs in VMEM over the C static slices of
+      the product; only the int32 prediction tile is written to HBM.
+
+Both interpolation matrices are the exact torch `F.interpolate` operators
+from ops/resize.py (`_interp_matrix`), so the result equals
+`argmax(resize_bilinear(x, size))` up to float-associativity on near-ties
+(exact-tie behavior matches jnp.argmax: lowest class index wins).
+
+Runs natively on TPU; `interpret=True` everywhere else (CPU tests). Shapes
+that don't tile (or don't fit VMEM) fall back to the materializing path —
+`resize_argmax` is always safe to call.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .resize import _interp_matrix, _pair, resize_bilinear
+
+
+def _argmax_ref(x: jnp.ndarray, size, align_corners: bool) -> jnp.ndarray:
+    """Materializing reference path (upsample, then argmax)."""
+    out = resize_bilinear(x, size, align_corners=align_corners)
+    return jnp.argmax(out, axis=-1).astype(jnp.int32)
+
+
+def _choose_tiles(h: int, C: int, H: int, W: int, itemsize: int
+                  ) -> Optional[Tuple[int, int]]:
+    """Pick (TH, TW): TH | H (multiple of 8), TW | W (multiple of 128),
+    sized so one program's working set stays well under VMEM. None if no
+    valid tiling exists (caller falls back)."""
+    tw = None
+    for cand in (512, 384, 256, 128):
+        if W % cand == 0 and h * C * cand * itemsize <= 4 * 2 ** 20:
+            tw = cand
+            break
+    if tw is None:
+        return None
+    th = None
+    for cand in (128, 64, 32, 16, 8):
+        if H % cand == 0 and cand * C * tw * 4 <= 4 * 2 ** 20:
+            th = cand
+            break
+    if th is None:
+        return None
+    # full-H output block + the H-interp operator must also fit
+    if H * tw * 4 > 6 * 2 ** 20 or H * h * itemsize > 2 * 2 ** 20:
+        return None
+    return th, tw
+
+
+def _head_kernel(nh: int, th: int, C: int, tw: int,
+                 mh_ref, z_ref, out_ref):
+    h = z_ref.shape[1]
+    z2 = z_ref[0].reshape(h, C * tw)
+    for hi in range(nh):
+        # H-interpolation for one output tile on the MXU
+        t = jnp.dot(mh_ref[hi * th:(hi + 1) * th, :], z2,
+                    preferred_element_type=jnp.float32)      # (th, C*tw)
+        # channel argmax over the C static lane-slices; strict > keeps the
+        # lowest index on exact ties, matching jnp.argmax
+        best = t[:, 0:tw]
+        idx = jnp.zeros((th, tw), jnp.int32)
+        for c in range(1, C):
+            v = t[:, c * tw:(c + 1) * tw]
+            take = v > best
+            best = jnp.where(take, v, best)
+            idx = jnp.where(take, c, idx)
+        out_ref[0, hi * th:(hi + 1) * th, :] = idx
+
+
+def resize_argmax(x: jnp.ndarray, size, align_corners: bool = True,
+                  interpret: Optional[bool] = None) -> jnp.ndarray:
+    """argmax over channels of the bilinear-resized NHWC `x`, fused.
+
+    Semantically `jnp.argmax(resize_bilinear(x, size, align_corners), -1)`
+    (int32), computed without materializing the resized tensor when the
+    Pallas tiling applies.
+    """
+    B, h, w, C = x.shape
+    H, W = _pair(size)
+    if (h, w) == (H, W):
+        return jnp.argmax(x, axis=-1).astype(jnp.int32)
+    if interpret is None:
+        interpret = jax.devices()[0].platform != 'tpu'
+    tiles = _choose_tiles(h, C, H, W, x.dtype.itemsize)
+    if tiles is None or C < 2:
+        return _argmax_ref(x, size, align_corners)
+    th, tw = tiles
+    dtype = x.dtype
+    exact = dtype == jnp.float32
+    prec = 'highest' if exact else None
+    mw = jnp.asarray(_interp_matrix(w, W, align_corners), dtype)
+    mh = jnp.asarray(_interp_matrix(h, H, align_corners), dtype)
+    # stage 1: W-interp at low height, channel-major output for the kernel
+    z = jnp.einsum('Ww,nhwc->nhcW', mw, x, precision=prec)
+    nh = H // th
+    out = pl.pallas_call(
+        partial(_head_kernel, nh, th, C, tw),
+        grid=(B, W // tw),
+        in_specs=[
+            pl.BlockSpec((H, h), lambda b, wi: (0, 0)),
+            pl.BlockSpec((1, h, C, tw), lambda b, wi: (b, 0, 0, wi)),
+        ],
+        out_specs=pl.BlockSpec((1, H, tw), lambda b, wi: (b, 0, wi)),
+        out_shape=jax.ShapeDtypeStruct((B, H, W), jnp.int32),
+        interpret=interpret,
+    )(mh, z)
+    return out

@@ -77,6 +77,39 @@ def resize_bilinear(x: jnp.ndarray, size: Size2, align_corners: bool = True
     return out.astype(dtype)
 
 
+_DEFER_FINAL_UPSAMPLE = False
+
+
+def set_defer_final_upsample(on: bool) -> None:
+    """Trace-time switch for the fused serving head (ops/fused_head.py).
+
+    When on, `final_upsample` returns the low-resolution class logits
+    unchanged so the eval/predict step can fuse the upsample with the
+    argmax. Pinned per-builder by train/step.py (same pattern as
+    nn.set_bn_axis); reset by the test conftest."""
+    global _DEFER_FINAL_UPSAMPLE
+    _DEFER_FINAL_UPSAMPLE = bool(on)
+
+
+def get_defer_final_upsample() -> bool:
+    return _DEFER_FINAL_UPSAMPLE
+
+
+def final_upsample(x: jnp.ndarray, size: Size2,
+                   align_corners: bool = True) -> jnp.ndarray:
+    """A model's LAST op: bilinear-upsample class logits to label
+    resolution (the reference zoo's trailing F.interpolate, e.g. reference
+    models/fast_scnn.py classifier) — or, in deferred mode, hand the
+    low-res logits to the caller's fused upsample+argmax head.
+
+    Models must call this only on the value they return from the top-level
+    `__call__` (tests/test_fused_head.py checks every zoo entry: deferred
+    output, re-upsampled, must equal the normal output exactly)."""
+    if _DEFER_FINAL_UPSAMPLE:
+        return x
+    return resize_bilinear(x, size, align_corners=align_corners)
+
+
 def resize_nearest(x: jnp.ndarray, size: Size2) -> jnp.ndarray:
     """Nearest resize of NHWC `x`, matching torch F.interpolate(mode='nearest')
     index math: src = floor(dst * in / out)."""

@@ -1,0 +1,124 @@
+"""Fused serving head (ops/fused_head.py + the final_upsample deferral).
+
+Pins: (1) resize_argmax == argmax(resize_bilinear(...)) — exactly on
+well-separated logits, and within a tiny near-tie mismatch budget on random
+continuous logits (the fused path interpolates W-then-H; the materializing
+path H-then-W — identical in exact arithmetic); (2) every zoo model's
+deferred low-res logits, re-upsampled, reproduce its normal output, so the
+deferral really is the model's last op.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rtseg_tpu.ops import (final_upsample, resize_argmax, resize_bilinear,
+                           set_defer_final_upsample)
+from rtseg_tpu.ops.fused_head import _choose_tiles
+
+
+def _ref(x, size):
+    return jnp.argmax(resize_bilinear(x, size, align_corners=True),
+                      axis=-1).astype(jnp.int32)
+
+
+def test_tiles_exist_for_serving_shapes():
+    # Cityscapes val (1024x2048) and half-res, 19 classes, bf16 + f32
+    assert _choose_tiles(128, 19, 1024, 2048, 2) is not None
+    assert _choose_tiles(128, 19, 1024, 2048, 4) is not None
+    assert _choose_tiles(64, 19, 512, 1024, 4) is not None
+    # untileable width -> fallback signal
+    assert _choose_tiles(128, 19, 1024, 2050, 4) is None
+
+
+def test_fused_matches_ref_separated_logits():
+    # integer-valued logits: mismatches can only occur where two channels'
+    # interpolated values tie almost exactly (class-boundary crossings,
+    # where either answer is defensible) — bound that set tightly
+    rng = np.random.RandomState(0)
+    x = rng.randint(-8, 8, (2, 16, 32, 7)).astype(np.float32) * 4.0
+    out = np.asarray(resize_argmax(jnp.asarray(x), (128, 256)))
+    ref = np.asarray(_ref(jnp.asarray(x), (128, 256)))
+    mismatch = (out != ref).mean()
+    assert mismatch <= 1e-4, f'mismatch rate {mismatch:.2e}'
+
+
+def test_fused_matches_ref_random_logits():
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(2, 32, 64, 19).astype(np.float32))
+    out = np.asarray(resize_argmax(x, (256, 512)))
+    ref = np.asarray(_ref(x, (256, 512)))
+    mismatch = (out != ref).mean()
+    assert mismatch <= 1e-4, f'near-tie mismatch rate {mismatch:.2e}'
+
+
+def test_fused_identity_size_is_plain_argmax():
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(1, 16, 16, 5).astype(np.float32))
+    np.testing.assert_array_equal(
+        np.asarray(resize_argmax(x, (16, 16))),
+        np.asarray(jnp.argmax(x, -1).astype(jnp.int32)))
+
+
+def test_fallback_path_untileable_shape():
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(1, 10, 13, 6).astype(np.float32))
+    out = resize_argmax(x, (37, 53))           # no valid tiling
+    np.testing.assert_array_equal(np.asarray(out),
+                                  np.asarray(_ref(x, (37, 53))))
+
+
+def test_tie_breaking_matches_argmax():
+    # exact ties: lowest class index must win, like jnp.argmax
+    x = jnp.zeros((1, 8, 8, 5), jnp.float32)
+    out = np.asarray(resize_argmax(x, (64, 128)))
+    assert (out == 0).all()
+
+
+def test_defer_final_upsample_context():
+    x = jnp.ones((1, 8, 8, 4), jnp.float32)
+    try:
+        set_defer_final_upsample(True)
+        assert final_upsample(x, (32, 32)).shape == (1, 8, 8, 4)
+    finally:
+        set_defer_final_upsample(False)
+    assert final_upsample(x, (32, 32)).shape == (1, 32, 32, 4)
+
+
+@pytest.mark.slow
+def test_zoo_deferral_is_last_op():
+    """Every registered model: deferred low-res logits, re-upsampled with
+    the same bilinear op, must exactly reproduce the normal forward."""
+    from rtseg_tpu.config import SegConfig
+    from rtseg_tpu.models import get_model
+    from rtseg_tpu.models.registry import MODEL_NAMES
+
+    for name in MODEL_NAMES:
+        cfg = SegConfig(dataset='synthetic', model=name, num_class=11,
+                        compute_dtype='float32',
+                        save_dir='/tmp/rtseg_fused_head')
+        cfg.resolve(num_devices=1)
+        model = get_model(cfg)
+        x = jnp.asarray(
+            np.random.RandomState(4).rand(1, 64, 64, 3).astype(np.float32))
+        set_defer_final_upsample(False)
+        variables = model.init(jax.random.PRNGKey(0), x, False)
+        ref = model.apply(variables, x, False)
+        try:
+            set_defer_final_upsample(True)
+            low = model.apply(variables, x, False)
+        finally:
+            set_defer_final_upsample(False)
+        assert low.shape[0] == 1 and low.shape[-1] == 11, \
+            f'{name}: deferred output shape {low.shape}'
+        if low.shape == ref.shape:
+            # model emits full-res logits natively (no trailing resize):
+            # deferral must be a no-op
+            np.testing.assert_array_equal(np.asarray(low), np.asarray(ref))
+            continue
+        up = resize_bilinear(low, ref.shape[1:3], align_corners=True)
+        np.testing.assert_allclose(np.asarray(up), np.asarray(ref),
+                                   rtol=0, atol=0,
+                                   err_msg=f'{name}: final_upsample is not '
+                                           f'the last op')
