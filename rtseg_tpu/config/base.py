@@ -185,6 +185,17 @@ class SegConfig:
     # +2.7%, fastscnn +5.7% eval imgs/sec). None = auto: the kernel on
     # TPU, the einsum elsewhere (interpret-mode Pallas is slow on CPU).
     use_pallas_metrics: Optional[bool] = None
+    # fused serving head: models defer their trailing bilinear upsample
+    # (ops/resize.final_upsample) and the eval/predict steps fuse
+    # upsample+argmax in one Pallas kernel that never materializes the
+    # full-resolution logit tensor (ops/fused_head.resize_argmax; the
+    # materializing path measured 39% of the fastscnn full-res eval step).
+    # Exact same predictions up to float-associativity on near-ties.
+    # None = auto: on for TPU, off elsewhere (interpret-mode Pallas is
+    # slow on CPU). Spatial (GSPMD) meshes always use the materializing
+    # path — a Pallas custom call cannot be auto-partitioned over the
+    # sharded batch.
+    fused_head: Optional[bool] = None
     # stdc/ddrnet/ppliteseg: rematerialize the highest-resolution encoder
     # stages in backward (the generalization of bisenetv2's detail_remat —
     # drop the big early-stage residuals, keep the cheap deep ones). Math

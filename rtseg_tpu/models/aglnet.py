@@ -11,7 +11,7 @@ import jax
 from flax import linen as nn
 
 from ..nn import Activation, BatchNorm, Conv, ConvBNAct
-from ..ops import global_avg_pool, resize_bilinear
+from ..ops import global_avg_pool, resize_bilinear, final_upsample
 from .enet import InitialBlock as DownsamplingUnit
 from .lednet import SSnbtUnit
 
@@ -100,4 +100,4 @@ class AGLNet(nn.Module):
         x = GAUM(64, 64, a)(x, x_s2, train)
         x = GAUM(32, 32, a)(x, x_s1, train)
         x = Conv(self.num_class, 1)(x)
-        return resize_bilinear(x, size, align_corners=True)
+        return final_upsample(x, size)

@@ -14,7 +14,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from ..nn import Conv, ConvBNAct, SegHead
-from ..ops import global_avg_pool, resize_bilinear
+from ..ops import global_avg_pool, resize_bilinear, final_upsample
 from .backbone import ResNet
 
 
@@ -96,4 +96,4 @@ class BiSeNetv1(nn.Module):
         x_c = ContextPath(256, self.backbone_type, self.act_type)(x, train)
         x = FeatureFusionModule(256, self.act_type)(x_s, x_c, train)
         x = SegHead(self.num_class, self.act_type)(x, train)
-        return resize_bilinear(x, size, align_corners=True)
+        return final_upsample(x, size)

@@ -16,7 +16,7 @@ import jax
 from ..nn import (Activation, BatchNorm, Conv, ConvBNAct, DWConvBNAct,
                   PWConvBNAct, SegHead)
 from ..nn.packed import PackedConvBNAct, can_pack
-from ..ops import global_avg_pool, max_pool, avg_pool, resize_bilinear
+from ..ops import global_avg_pool, max_pool, avg_pool, resize_bilinear, final_upsample
 from ..ops.s2d import (depth_to_space2, packed_concat,
                        packed_max_pool3x3_s2, space_to_depth2)
 
@@ -200,6 +200,13 @@ class BiSeNetv2(nn.Module):
     # eval-only S2D(2) compute layout for the full-res stem + detail
     # stages (config.pack_fullres); exact, same params — see nn/packed.py
     pack_fullres: bool = False
+    # rematerialize the SemanticBranch too (config.hires_remat): at the
+    # reference's 1024^2 train crop the semantic stem/GE stages' 1/4-1/8
+    # activations are the residuals detail_remat does NOT drop — together
+    # the two remats free nearly the whole forward's activation HBM while
+    # keeping the (cheap, 1/8-res) aggregation+head residuals live. Param
+    # paths unchanged (pinned scope names).
+    hires_remat: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -210,13 +217,15 @@ class BiSeNetv2(nn.Module):
         # CheckpointDetailBranch_0, breaking checkpoint/transplant paths
         x_d = detail_cls(128, self.act_type, packed=self.pack_fullres,
                          name='DetailBranch_0')(x, train)
-        x_s, aux = SemanticBranch(128, self.num_class, self.act_type,
-                                  self.use_aux,
-                                  packed=self.pack_fullres)(x, train)
+        sem_cls = (nn.remat(SemanticBranch, static_argnums=(2,))
+                   if self.hires_remat else SemanticBranch)
+        x_s, aux = sem_cls(128, self.num_class, self.act_type,
+                           self.use_aux, packed=self.pack_fullres,
+                           name='SemanticBranch_0')(x, train)
         x = BilateralGuidedAggregationLayer(128, self.act_type)(
             x_d, x_s, train)
         x = SegHead(self.num_class, self.act_type)(x, train)
-        x = resize_bilinear(x, size, align_corners=True)
+        x = final_upsample(x, size)
         if self.use_aux and train:
             return x, tuple(aux)
         return x

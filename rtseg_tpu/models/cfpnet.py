@@ -14,7 +14,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from ..nn import ConvBNAct
-from ..ops import resize_bilinear
+from ..ops import resize_bilinear, final_upsample
 from .enet import InitialBlock as DownsamplingBlock
 
 
@@ -96,4 +96,4 @@ class CFPNet(nn.Module):
         x = jnp.concatenate([x, inj[2]], axis=-1)
 
         x = ConvBNAct(self.num_class, 1, act_type=a)(x, train)
-        return resize_bilinear(x, size, align_corners=True)
+        return final_upsample(x, size)

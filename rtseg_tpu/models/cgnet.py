@@ -12,7 +12,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from ..nn import Activation, BatchNorm, Conv, ConvBNAct
-from ..ops import global_avg_pool, resize_bilinear
+from ..ops import global_avg_pool, resize_bilinear, final_upsample
 
 
 class InitBlock(nn.Module):
@@ -92,4 +92,4 @@ class CGNet(nn.Module):
 
         x = jnp.concatenate([x, x3], axis=-1)
         x = Conv(self.num_class, 1)(x)
-        return resize_bilinear(x, size, align_corners=True)
+        return final_upsample(x, size)

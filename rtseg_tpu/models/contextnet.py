@@ -11,7 +11,7 @@ from flax import linen as nn
 
 from ..nn import (Activation, Conv, ConvBNAct, DSConvBNAct, DWConvBNAct,
                   PWConvBNAct)
-from ..ops import resize_bilinear
+from ..ops import resize_bilinear, final_upsample
 
 
 class InvertedResidual(nn.Module):
@@ -90,4 +90,4 @@ class ContextNet(nn.Module):
         low = Branch4(128, self.act_type)(x_low, train)
         x = FeatureFusion(128, self.act_type)(full, low, train)
         x = ConvBNAct(self.num_class, 1, act_type=self.act_type)(x, train)
-        return resize_bilinear(x, size, align_corners=True)
+        return final_upsample(x, size)

@@ -11,7 +11,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from ..nn import Conv, ConvBNAct, DWConvBNAct
-from ..ops import avg_pool, resize_bilinear
+from ..ops import avg_pool, resize_bilinear, final_upsample
 from .enet import InitialBlock
 
 
@@ -65,4 +65,4 @@ class DABNet(nn.Module):
         x = jnp.concatenate([x, block2, x_d8], axis=-1)
 
         x = Conv(self.num_class, 1)(x)
-        return resize_bilinear(x, size, align_corners=True)
+        return final_upsample(x, size)

@@ -16,7 +16,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from ..nn import Activation, Conv, ConvBNAct, SegHead
-from ..ops import avg_pool, global_avg_pool, resize_bilinear
+from ..ops import avg_pool, global_avg_pool, resize_bilinear, final_upsample
 
 ARCH_HUB = {
     'DDRNet-23-slim': {'init_channel': 32, 'repeat_times': (2, 2, 2, 0, 2, 1)},
@@ -190,7 +190,7 @@ class DDRNet(nn.Module):
         x_h = Blocks(RBB, ch * 4, 1, rep[5], a)(x_h, train) + x_low
 
         x = SegHead(self.num_class, a, name='seg_head')(x_h, train)
-        x = resize_bilinear(x, size, align_corners=True)
+        x = final_upsample(x, size)
         if self.use_aux and train:
             return x, (x_aux,)
         return x

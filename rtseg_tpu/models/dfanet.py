@@ -14,7 +14,7 @@ from flax import linen as nn
 
 from ..nn import (Activation, Conv, ConvBNAct, DSConvBNAct, DWConvBNAct,
                   SegHead)
-from ..ops import adaptive_max_pool, resize_bilinear
+from ..ops import adaptive_max_pool, resize_bilinear, final_upsample
 
 
 class XceptionBlock(nn.Module):
@@ -110,7 +110,8 @@ class Decoder(nn.Module):
         f1 = up(SegHead(self.num_class, a)(fc1, train), 4)
         f2 = up(SegHead(self.num_class, a)(fc2, train), 8)
         f3 = up(SegHead(self.num_class, a)(fc3, train), 16)
-        return up(enc + f1 + f2 + f3, 4)
+        y = enc + f1 + f2 + f3
+        return final_upsample(y, (y.shape[1] * 4, y.shape[2] * 4))
 
 
 class DFANet(nn.Module):
@@ -135,8 +136,7 @@ class DFANet(nn.Module):
                                 name='backbone1')(x, train=train)
         if not self.use_extra_backbone:
             x = SegHead(self.num_class, a)(x, train)
-            return resize_bilinear(x, (x.shape[1] * 16, x.shape[2] * 16),
-                                   align_corners=True)
+            return final_upsample(x, (x.shape[1] * 16, x.shape[2] * 16))
 
         enc1, fc1 = e2, x
         x = resize_bilinear(x, (x.shape[1] * 4, x.shape[2] * 4),

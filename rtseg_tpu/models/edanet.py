@@ -11,7 +11,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from ..nn import Activation, BatchNorm, Conv, ConvBNAct
-from ..ops import max_pool, resize_bilinear
+from ..ops import max_pool, resize_bilinear, final_upsample
 
 
 class DownsamplingBlock(nn.Module):
@@ -63,4 +63,4 @@ class EDANet(nn.Module):
         for d in (2, 2, 4, 4, 8, 8, 16, 16):
             x = EDAModule(self.k, d, a)(x, train)
         x = Conv(self.num_class, 1)(x)
-        return resize_bilinear(x, size, align_corners=True)
+        return final_upsample(x, size)

@@ -13,7 +13,7 @@ from flax import linen as nn
 import jax.numpy as jnp
 
 from ..nn import Conv, ConvBNAct, DeConvBNAct
-from ..ops import resize_bilinear
+from ..ops import resize_bilinear, final_upsample
 
 
 class ESPModule(nn.Module):
@@ -128,4 +128,4 @@ class ESPNet(nn.Module):
             x = ConvBNAct(self.num_class, 1, act_type=a)(x, train)
             return Decoder(self.num_class, a)(x, x_l1, x_l2, train)
         x = Conv(self.num_class, 1)(x)
-        return resize_bilinear(x, size, align_corners=True)
+        return final_upsample(x, size)

@@ -12,7 +12,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from ..nn import Conv, ConvBNAct, DSConvBNAct, PyramidPoolingModule, SegHead
-from ..ops import avg_pool, resize_bilinear
+from ..ops import avg_pool, resize_bilinear, final_upsample
 
 
 class EESPModule(nn.Module):
@@ -80,4 +80,4 @@ class ESPNetv2(nn.Module):
         x = jnp.concatenate([x, x3], axis=-1)
         x = PyramidPoolingModule(256, act_type=a, bias=True)(x, train)
         x = SegHead(self.num_class, a)(x, train)
-        return resize_bilinear(x, size, align_corners=True)
+        return final_upsample(x, size)

@@ -12,7 +12,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from ..nn import Conv, ConvBNAct, DWConvBNAct
-from ..ops import pixel_shuffle, resize_bilinear
+from ..ops import pixel_shuffle, resize_bilinear, final_upsample
 from .backbone import ResNet
 
 
@@ -63,4 +63,4 @@ class FarSeeNet(nn.Module):
         _, _, x_low, x_high = ResNet(self.backbone_type,
                                      name='frontend')(x, train)
         x = FASPP(self.num_class, self.act_type)(x_high, x_low, train)
-        return resize_bilinear(x, size, align_corners=True)
+        return final_upsample(x, size)

@@ -14,7 +14,7 @@ from flax import linen as nn
 
 from ..nn import (Activation, BatchNorm, Conv, ConvBNAct, DSConvBNAct,
                   DWConvBNAct, PWConvBNAct, PyramidPoolingModule)
-from ..ops import resize_bilinear
+from ..ops import resize_bilinear, final_upsample
 
 
 class InvertedResidual(nn.Module):
@@ -97,4 +97,4 @@ class FastSCNN(nn.Module):
         lower = GlobalFeatureExtractor(128, act_type=self.act_type)(higher, train)
         x = FeatureFusionModule(128, act_type=self.act_type)(higher, lower, train)
         x = Classifier(self.num_class, self.act_type)(x, train)
-        return resize_bilinear(x, size, align_corners=True)
+        return final_upsample(x, size)

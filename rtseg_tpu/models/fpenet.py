@@ -14,7 +14,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from ..nn import ConvBNAct, DWConvBNAct
-from ..ops import global_avg_pool, resize_bilinear
+from ..ops import global_avg_pool, resize_bilinear, final_upsample
 
 
 class FPEBlock(nn.Module):
@@ -93,4 +93,4 @@ class FPENet(nn.Module):
         x = MEUModule(64, a)(x2, x, train)
         x = MEUModule(32, a)(x1, x, train)
         x = ConvBNAct(self.num_class, 1, act_type=a)(x, train)
-        return resize_bilinear(x, size, align_corners=True)
+        return final_upsample(x, size)

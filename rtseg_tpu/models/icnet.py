@@ -19,7 +19,7 @@ from __future__ import annotations
 from flax import linen as nn
 
 from ..nn import Activation, ConvBNAct, PyramidPoolingModule, SegHead
-from ..ops import resize_bilinear
+from ..ops import resize_bilinear, final_upsample
 from .backbone import ResNet
 
 
@@ -105,7 +105,7 @@ class ICNet(nn.Module):
         xh = resize_bilinear(xh, (xh.shape[1] * 2, xh.shape[2] * 2),
                              align_corners=True)
         xh = self.seg_head(xh, train)
-        xh = resize_bilinear(xh, size, align_corners=True)
+        xh = final_upsample(xh, size)
         if self.use_aux and train:
             return xh, (aux2, aux3)
         return xh

@@ -12,7 +12,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from ..nn import Activation, Conv, ConvBNAct
-from ..ops import channel_shuffle, global_avg_pool, resize_bilinear
+from ..ops import channel_shuffle, global_avg_pool, resize_bilinear, final_upsample
 from .enet import InitialBlock as DownsampleUnit
 
 
@@ -93,4 +93,4 @@ class LEDNet(nn.Module):
         for d in (1, 2, 5, 9, 2, 5, 9, 17):
             x = SSnbtUnit(d, a)(x, train)
         x = AttentionPyramidNetwork(self.num_class, a)(x, train)
-        return resize_bilinear(x, size, align_corners=True)
+        return final_upsample(x, size)

@@ -16,7 +16,7 @@ from flax import linen as nn
 
 from ..nn import Conv, ConvBNAct, DSConvBNAct, DWConvBNAct
 from ..ops import (adaptive_avg_pool, channel_shuffle, global_avg_pool,
-                   resize_bilinear, resize_nearest)
+                   resize_bilinear, resize_nearest, final_upsample)
 
 ARCH_HUB = {'litehrnet18': (2, 4, 2), 'litehrnet30': (3, 8, 3)}
 
@@ -241,4 +241,4 @@ class LiteHRNet(nn.Module):
         x = jnp.concatenate(ups, axis=-1)
         x = DSConvBNAct(128, 3, act_type=a)(x, train)
         x = Conv(self.num_class, 1)(x)
-        return resize_bilinear(x, size, align_corners=True)
+        return final_upsample(x, size)

@@ -11,7 +11,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from ..nn import Conv, ConvBNAct
-from ..ops import global_avg_pool, resize_bilinear
+from ..ops import global_avg_pool, resize_bilinear, final_upsample
 from .backbone import Mobilenetv2, ResNet
 
 
@@ -59,4 +59,4 @@ class LiteSeg(nn.Module):
         x = ConvBNAct(256, 3, act_type=a)(x, train)
         x = ConvBNAct(128, 3, act_type=a)(x, train)
         x = Conv(self.num_class, 1)(x)
-        return resize_bilinear(x, size, align_corners=True)
+        return final_upsample(x, size)

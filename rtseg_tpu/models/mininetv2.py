@@ -12,7 +12,7 @@ from typing import Sequence
 from flax import linen as nn
 
 from ..nn import DWConvBNAct, DeConvBNAct, PWConvBNAct
-from ..ops import resize_bilinear
+from ..ops import resize_bilinear, final_upsample
 from .enet import InitialBlock as DownsamplingUnit
 
 
@@ -58,4 +58,4 @@ class MiniNetv2(nn.Module):
         for _ in range(4):
             y = MultiDilationDSConv(64, act_type=a)(y, train)
         y = DeConvBNAct(self.num_class, act_type=a)(y, train)
-        return resize_bilinear(y, size, align_corners=True)
+        return final_upsample(y, size)

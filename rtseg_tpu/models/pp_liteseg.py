@@ -16,7 +16,7 @@ from flax import linen as nn
 
 from ..nn import Conv, ConvBNAct
 from ..ops import (adaptive_avg_pool, adaptive_max_pool, avg_pool,
-                   global_avg_pool, resize_bilinear)
+                   global_avg_pool, resize_bilinear, final_upsample)
 
 DECODER_CHANNEL_HUB = {'stdc1': (32, 64, 128), 'stdc2': (64, 96, 128)}
 REPEAT_TIMES_HUB = {'stdc1': (1, 1, 1), 'stdc2': (3, 4, 2)}
@@ -147,4 +147,4 @@ class PPLiteSeg(nn.Module):
         x = UAFM(dc[1], self.fusion_type)(x, x3, train)
         x = ConvBNAct(dc[2])(x, train)
         x = ConvBNAct(self.num_class, 3, act_type=a)(x, train)
-        return resize_bilinear(x, size, align_corners=True)
+        return final_upsample(x, size)

@@ -55,6 +55,10 @@ MODEL_REGISTRY = {
     'swiftnet': ('swiftnet', 'SwiftNet'),
 }
 
+#: all registered architecture names (excludes the 'smp' hub entry, which
+#: dispatches on encoder/decoder instead of a fixed class)
+MODEL_NAMES = tuple(MODEL_REGISTRY)
+
 AUX_MODELS = ['bisenetv2', 'ddrnet', 'icnet']
 DETAIL_HEAD_MODELS = ['stdc']
 
@@ -82,7 +86,8 @@ def get_model(config):
     if name == 'bisenetv2':
         return cls(num_class=config.num_class, use_aux=config.use_aux,
                    detail_remat=getattr(config, 'detail_remat', False),
-                   pack_fullres=getattr(config, 'pack_fullres', False))
+                   pack_fullres=getattr(config, 'pack_fullres', False),
+                   hires_remat=hires)
     if name == 'ddrnet':
         return cls(num_class=config.num_class, use_aux=config.use_aux,
                    hires_remat=hires)

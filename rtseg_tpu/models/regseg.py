@@ -18,7 +18,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from ..nn import Activation, Conv, ConvBNAct
-from ..ops import avg_pool, global_avg_pool, resize_bilinear
+from ..ops import avg_pool, global_avg_pool, resize_bilinear, final_upsample
 
 DEFAULT_DILATIONS = ((1, 1), (1, 2), (1, 2), (1, 3), (2, 3), (2, 7), (2, 3),
                      (2, 6), (2, 5), (2, 9), (2, 11), (4, 7), (5, 14))
@@ -116,4 +116,4 @@ class RegSeg(nn.Module):
         x_d16 = DBlock(320, 2, self.dilations[-1][0], self.dilations[-1][1],
                        act_type=a)(x, train)
         x = Decoder(self.num_class, a)(x_d4, x_d8, x_d16, train)
-        return resize_bilinear(x, size, align_corners=True)
+        return final_upsample(x, size)

@@ -12,7 +12,7 @@ from typing import Sequence
 from flax import linen as nn
 
 from ..nn import Conv, ConvBNAct, DeConvBNAct, Activation
-from ..ops import resize_bilinear
+from ..ops import resize_bilinear, final_upsample
 from .backbone import ResNet
 
 
@@ -91,4 +91,4 @@ class ShelfNet(nn.Module):
             x_a, x_b, x_c, train)
         x = DecoderBlock(hc, a, name='decoder4')(x_a, x_b, x_c, x_d, train)
         x = Conv(self.num_class, 1)(x)
-        return resize_bilinear(x, size, align_corners=True)
+        return final_upsample(x, size)

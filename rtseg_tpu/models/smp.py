@@ -49,7 +49,7 @@ from flax import linen as nn
 from ..nn import (BatchNorm, Conv, ConvBNAct, DeConvBNAct, Dropout,
                   Dropout2d)
 from ..ops import (adaptive_avg_pool, global_avg_pool, max_pool,
-                   resize_bilinear, resize_nearest)
+                   resize_bilinear, resize_nearest, final_upsample)
 from .backbone import Mobilenetv2, ResNet, RESNET_LAYERS
 
 SMP_DECODERS = ('deeplabv3', 'deeplabv3p', 'fpn', 'linknet', 'manet', 'pan',
@@ -543,7 +543,7 @@ class GenericSegModel(nn.Module):
         k = 3 if dec in HEAD_K3_DECODERS else 1
         y = Conv(self.num_class, k, use_bias=True, name='seg_head')(y)
         if y.shape[1:3] != tuple(size):
-            y = resize_bilinear(y, size, align_corners=True)
+            y = final_upsample(y, size)
         return y
 
 

@@ -16,7 +16,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from ..nn import Conv, ConvBNAct, SegHead
-from ..ops import avg_pool, global_avg_pool, resize_bilinear
+from ..ops import avg_pool, global_avg_pool, resize_bilinear, final_upsample
 from .bisenetv1 import AttentionRefinementModule, FeatureFusionModule
 
 REPEAT_TIMES_HUB = {'stdc1': (1, 1, 1), 'stdc2': (3, 4, 2)}
@@ -136,7 +136,7 @@ class STDC(nn.Module):
                              align_corners=True)
         x = self.ffm(x4, x3, train)
         x = self.seg_head(x, train)
-        x = resize_bilinear(x, size, align_corners=True)
+        x = final_upsample(x, size)
 
         if self.use_detail_head and (train or self.is_initializing()):
             x_detail = self.detail_head(x3, train)

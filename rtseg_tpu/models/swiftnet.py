@@ -10,7 +10,7 @@ from __future__ import annotations
 from flax import linen as nn
 
 from ..nn import ConvBNAct, PyramidPoolingModule
-from ..ops import resize_bilinear
+from ..ops import resize_bilinear, final_upsample
 from .backbone import Mobilenetv2, ResNet
 
 
@@ -43,4 +43,4 @@ class SwiftNet(nn.Module):
         x = ConvBNAct(c, 3, act_type=a)(x, train)
         x = resize_bilinear(x, x1.shape[1:3], align_corners=True) + x1
         x = ConvBNAct(self.num_class, 3, act_type=a)(x, train)
-        return resize_bilinear(x, size, align_corners=True)
+        return final_upsample(x, size)

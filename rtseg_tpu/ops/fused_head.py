@@ -6,8 +6,9 @@ final F.interpolate followed by tensor.argmax(1)). Done naively on TPU that
 materializes a [B, H, W, C] full-resolution logit tensor in HBM — at the
 Cityscapes serving shape (bs128, 1024x2048, 19 classes) that is ~10 GB of
 write+read traffic per step just to pick the max channel, plus a separate
-full-size argmax reduce and int cast (measured 39% of the fastscnn eval
-step, BENCHMARKS.md round-4 "fused head" section).
+full-size argmax reduce and int cast (the materializing upsample+argmax
+measured 39% of the fastscnn full-res eval step — BENCHMARKS.md
+"Fused serving head" section for the measured effect of this op).
 
 This op never builds the full-res tensor:
 

@@ -85,8 +85,11 @@ def set_defer_final_upsample(on: bool) -> None:
 
     When on, `final_upsample` returns the low-resolution class logits
     unchanged so the eval/predict step can fuse the upsample with the
-    argmax. Pinned per-builder by train/step.py (same pattern as
-    nn.set_bn_axis); reset by the test conftest."""
+    argmax (ops/fused_head.resize_argmax). Trace-time global, pinned
+    per-builder by train/step.py's step wrappers (same pattern as
+    nn.set_bn_axis — every builder pins its own value immediately before
+    each call, so coexisting jitted steps with different settings never
+    see each other's state) and reset by the test conftest."""
     global _DEFER_FINAL_UPSAMPLE
     _DEFER_FINAL_UPSAMPLE = bool(on)
 

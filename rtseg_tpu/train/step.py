@@ -27,7 +27,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..losses import (get_detail_loss_fn, get_kd_loss_fn, get_loss_fn,
                       laplacian_pyramid)
 from ..nn import set_bn_axis
-from ..ops import resize_bilinear, resize_nearest
+from ..ops import resize_argmax, resize_bilinear, resize_nearest
 from ..parallel import batch_spec
 from ..utils.metrics import confusion_matrix
 from .state import TrainState, ema_update
@@ -38,24 +38,34 @@ def _shard_map(fn, mesh, in_specs, out_specs):
                          out_specs=out_specs, check_vma=False)
 
 
-def _pin_bn_axis(fn: Callable, axis, config=None) -> Callable:
+def _pin_bn_axis(fn: Callable, axis, config=None,
+                 defer_upsample: bool = False) -> Callable:
     """jit traces lazily (on first call), but BN modules read the global
-    collective axis — and Conv the s2d_stem switch — at trace time: pin
-    this builder's values right before every call so builders with
-    different strategies/configs can coexist (a later get_model for an
-    unrelated config cannot silently flip this step's stem packing)."""
+    collective axis — Conv the s2d_stem switch, and final_upsample the
+    fused-head deferral flag — at trace time: pin this builder's values
+    right before every call so builders with different strategies/configs
+    can coexist (a later get_model for an unrelated config cannot silently
+    flip this step's stem packing, and an eval builder's deferral cannot
+    leak into a train step's trace)."""
     from ..nn import set_stem_packing
+    from ..ops import set_defer_final_upsample
     s2d = bool(getattr(config, 's2d_stem', False)) if config is not None \
         else None
 
-    def wrapper(*args, **kwargs):
+    def pin():
         set_bn_axis(axis)
         if s2d is not None:
             set_stem_packing(s2d)
+        set_defer_final_upsample(defer_upsample)
+
+    def wrapper(*args, **kwargs):
+        pin()
         return fn(*args, **kwargs)
     wrapper.jitted = fn          # expose for AOT lower()/compile() analysis
+    wrapper.pin = pin            # AOT users must pin before .jitted.lower()
     wrapper.bn_axis = axis
     wrapper.s2d_stem = s2d
+    wrapper.defer_upsample = defer_upsample
     return wrapper
 
 
@@ -310,7 +320,16 @@ def build_eval_step(config, model, mesh: Mesh, use_ema: bool = True
     psum'd over the mesh (replaces torchmetrics' internal sync,
     core/seg_trainer.py:131-137). Runs the EMA weights, like the reference
     validate (core/seg_trainer.py:130). GSPMD path for spatial meshes (same
-    halo-exchange rationale as build_train_step)."""
+    halo-exchange rationale as build_train_step).
+
+    With config.fused_head (auto-on for TPU), the model's trailing bilinear
+    upsample is deferred (ops/resize.final_upsample returns low-res logits)
+    and upsample+argmax run as one Pallas kernel (ops/fused_head) that never
+    materializes the [B, H, W, C] logit tensor — the reference semantics of
+    interpolate-then-argmax (core/seg_trainer.py:128-131) with an order of
+    magnitude less HBM traffic at the Cityscapes serving shape. Spatial
+    (GSPMD) meshes keep the materializing path: a Pallas custom call can't
+    be auto-partitioned over the sharded batch axis."""
     from ..parallel.mesh import SPATIAL_AXIS
     axes = _mesh_axes(mesh)
     compute_dtype = jnp.dtype(config.compute_dtype)
@@ -322,16 +341,27 @@ def build_eval_step(config, model, mesh: Mesh, use_ema: bool = True
         cm_fn = confusion_matrix_pallas
     else:
         cm_fn = confusion_matrix
+    spatial = SPATIAL_AXIS in mesh.axis_names
+    fused = getattr(config, 'fused_head', None)
+    if fused is None:           # auto: fused on TPU, materialize elsewhere
+        fused = jax.devices()[0].platform == 'tpu'
+    fused = fused and not spatial
 
     def forward_cm(state: TrainState, images, masks):
         params = state.ema_params if use_ema else state.params
         bs = state.ema_batch_stats if use_ema else state.batch_stats
         out = model.apply({'params': params, 'batch_stats': bs},
                           images.astype(compute_dtype), False)
-        preds = jnp.argmax(out, axis=-1)
+        if fused:
+            # deferred low-res logits -> fused upsample+argmax at the
+            # label resolution (identity-size shortcut if the model
+            # natively emits full-res logits)
+            preds = resize_argmax(out, images.shape[1:3])
+        else:
+            preds = jnp.argmax(out, axis=-1)
         return cm_fn(preds, masks, config.num_class, config.ignore_index)
 
-    if SPATIAL_AXIS in mesh.axis_names:
+    if spatial:
         from ..parallel import batch_sharding, replicated
         return _pin_bn_axis(
             jax.jit(forward_cm,
@@ -345,16 +375,31 @@ def build_eval_step(config, model, mesh: Mesh, use_ema: bool = True
     bspec = batch_spec(mesh)
     sharded = _shard_map(step, mesh, in_specs=(P(), bspec, bspec),
                          out_specs=P())
-    return _pin_bn_axis(jax.jit(sharded), None, config)
+    return _pin_bn_axis(jax.jit(sharded), None, config,
+                        defer_upsample=fused)
 
 
 def build_predict_step(config, model, mesh: Optional[Mesh] = None) -> Callable:
-    """argmax inference step (reference predict, core/seg_trainer.py:170-172)."""
+    """argmax inference step (reference predict, core/seg_trainer.py:170-172).
+
+    Same fused-head policy as build_eval_step: with config.fused_head
+    (auto-on for TPU) the model defers its trailing upsample and the
+    upsample+argmax run fused (ops/fused_head.resize_argmax) — except on
+    spatial (GSPMD) meshes, where the materializing path is kept for the
+    same cannot-auto-partition-a-custom-call reason."""
+    from ..parallel.mesh import SPATIAL_AXIS
     compute_dtype = jnp.dtype(config.compute_dtype)
+    spatial = mesh is not None and SPATIAL_AXIS in mesh.axis_names
+    fused = getattr(config, 'fused_head', None)
+    if fused is None:
+        fused = jax.devices()[0].platform == 'tpu'
+    fused = fused and not spatial
 
     @jax.jit
     def step(variables, images):
         out = model.apply(variables, images.astype(compute_dtype), False)
+        if fused:
+            return resize_argmax(out, images.shape[1:3])
         return jnp.argmax(out, axis=-1).astype(jnp.int32)
 
-    return _pin_bn_axis(step, None, config)
+    return _pin_bn_axis(step, None, config, defer_upsample=fused)

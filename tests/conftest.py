@@ -45,13 +45,17 @@ def mesh8():
 
 
 @pytest.fixture(autouse=True)
-def _reset_bn_axis():
-    """The collective BN axis and the stem-packing switch are process-global
-    and set by step builders; reset both so bare model.apply() outside
-    shard_map never sees stale state from a previous test."""
+def _reset_trace_globals():
+    """The collective BN axis, the stem-packing switch, and the fused-head
+    deferral flag are process-global and set by step builders; reset all
+    three so bare model.apply() outside shard_map never sees stale state
+    from a previous test."""
     from rtseg_tpu.nn import set_bn_axis, set_stem_packing
+    from rtseg_tpu.ops import set_defer_final_upsample
     set_bn_axis(None)
     set_stem_packing(False)
+    set_defer_final_upsample(False)
     yield
     set_bn_axis(None)
     set_stem_packing(False)
+    set_defer_final_upsample(False)

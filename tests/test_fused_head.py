@@ -86,14 +86,30 @@ def test_defer_final_upsample_context():
     assert final_upsample(x, (32, 32)).shape == (1, 32, 32, 4)
 
 
+# Models whose trailing op is the bilinear class-logit upsample
+# (final_upsample): deferral MUST change the output shape for these.
+# The rest end in learned deconv/unpool heads that natively emit full-res
+# logits (e.g. enet, segnet) — or, for espnet's default arch, a learned
+# decoder — so deferral is a no-op there by design.
+DEFER_MODELS = frozenset({
+    'aglnet', 'bisenetv1', 'bisenetv2', 'cfpnet', 'cgnet', 'contextnet',
+    'dabnet', 'ddrnet', 'dfanet', 'edanet', 'espnetv2', 'farseenet',
+    'fastscnn', 'fpenet', 'icnet', 'lednet', 'lite_hrnet', 'liteseg',
+    'mininetv2', 'ppliteseg', 'regseg', 'shelfnet', 'stdc', 'swiftnet',
+})
+
+
 @pytest.mark.slow
 def test_zoo_deferral_is_last_op():
     """Every registered model: deferred low-res logits, re-upsampled with
-    the same bilinear op, must exactly reproduce the normal forward."""
+    the same bilinear op, must exactly reproduce the normal forward — and
+    the DEFER_MODELS set must actually defer (shape changes), so the test
+    can never pass vacuously."""
     from rtseg_tpu.config import SegConfig
     from rtseg_tpu.models import get_model
     from rtseg_tpu.models.registry import MODEL_NAMES
 
+    deferred = set()
     for name in MODEL_NAMES:
         cfg = SegConfig(dataset='synthetic', model=name, num_class=11,
                         compute_dtype='float32',
@@ -117,8 +133,56 @@ def test_zoo_deferral_is_last_op():
             # deferral must be a no-op
             np.testing.assert_array_equal(np.asarray(low), np.asarray(ref))
             continue
+        deferred.add(name)
         up = resize_bilinear(low, ref.shape[1:3], align_corners=True)
         np.testing.assert_allclose(np.asarray(up), np.asarray(ref),
                                    rtol=0, atol=0,
                                    err_msg=f'{name}: final_upsample is not '
                                            f'the last op')
+    assert deferred == DEFER_MODELS, (
+        f'deferral set drifted: unexpectedly deferring '
+        f'{sorted(deferred - DEFER_MODELS)}, unexpectedly NOT deferring '
+        f'{sorted(DEFER_MODELS - deferred)}')
+
+
+def test_eval_and_predict_steps_fused_matches_materializing():
+    """build_eval_step / build_predict_step with fused_head=True produce the
+    same confusion matrix / predictions as the materializing path (fp32,
+    well-separated synthetic weights make near-ties measure-zero)."""
+    import dataclasses
+    from jax.sharding import Mesh
+    from rtseg_tpu.config import SegConfig
+    from rtseg_tpu.models import get_model
+    from rtseg_tpu.train.state import create_train_state
+    from rtseg_tpu.train.step import build_eval_step, build_predict_step
+    from rtseg_tpu.train.optim import get_optimizer
+
+    cfg = SegConfig(dataset='synthetic', model='fastscnn', num_class=7,
+                    compute_dtype='float32', use_ema=False,
+                    train_bs=1, total_epoch=2,
+                    save_dir='/tmp/rtseg_fused_step')
+    cfg.resolve(num_devices=1)
+    cfg.resolve_schedule(train_num=16)
+    mesh = Mesh(np.array(jax.devices()[:1]), ('data',))
+    model = get_model(cfg)
+    rng = np.random.RandomState(5)
+    images = jnp.asarray(rng.rand(2, 64, 64, 3).astype(np.float32))
+    masks = jnp.asarray(rng.randint(0, 7, (2, 64, 64)).astype(np.int32))
+    optimizer = get_optimizer(cfg)
+    state = create_train_state(model, optimizer, jax.random.PRNGKey(0),
+                               jnp.zeros((2, 64, 64, 3), jnp.float32))
+
+    cms, preds = {}, {}
+    for fused in (False, True):
+        c = dataclasses.replace(cfg, fused_head=fused)
+        ev = build_eval_step(c, model, mesh, use_ema=False)
+        assert ev.defer_upsample == fused
+        cms[fused] = np.asarray(ev(state, images, masks))
+        pr = build_predict_step(c, model, mesh)
+        variables = {'params': state.params,
+                     'batch_stats': state.batch_stats}
+        preds[fused] = np.asarray(pr(variables, images))
+    np.testing.assert_array_equal(cms[True], cms[False])
+    np.testing.assert_array_equal(preds[True], preds[False])
+    assert preds[True].shape == (2, 64, 64)
+    assert cms[True].sum() == 2 * 64 * 64

@@ -43,6 +43,9 @@ def _tree_paths(tree):
     ('stdc', {'use_aux': True}),
     ('ddrnet', {'use_aux': True}),
     ('ppliteseg', {}),
+    # bisenetv2 hires_remat = SemanticBranch remat (round 5; composes with
+    # detail_remat to cover both branches at the 1024^2 train crop)
+    ('bisenetv2', {'use_aux': True, 'detail_remat': True}),
 ])
 def test_hires_remat_equivalence(name, kw):
     rng = np.random.RandomState(0)

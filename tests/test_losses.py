@@ -80,6 +80,48 @@ def test_ohem_bisection_path_matches_torch(scale):
     np.testing.assert_allclose(got, want, rtol=5e-3)
 
 
+def test_ohem_bisection_unbounded_loss_spikes():
+    """The bisection bracket is the batch's own max loss, not a fixed
+    ceiling: with the n_min-th largest pixel CE far above the old 18.0
+    bound (bf16-spike regime), the quantile search must still land on the
+    true n_min cut instead of saturating and over-keeping."""
+    rng = np.random.RandomState(13)
+    n_hard = 20000
+    logits = np.zeros((2, 384, 384, 6), np.float32)
+    labels = rng.randint(1, 6, (2, 384, 384)).astype(np.int32)
+    # easy pixels: logit 30 on the target class -> CE ~ 0
+    logits[np.arange(2)[:, None, None], np.arange(384)[:, None],
+           np.arange(384)[None, :], labels] = 30.0
+    # hard cluster: CE ~ uniform[19, 26] via a wrong-class margin
+    flat_lab = labels.reshape(-1)
+    idx = rng.choice(flat_lab.size, n_hard, replace=False)
+    margins = rng.uniform(19.0, 26.0, n_hard).astype(np.float32)
+    fl = logits.reshape(-1, 6)
+    fl[idx, :] = 0.0
+    fl[idx, 0] = 0.0
+    # target class gets -margin relative to class 0 -> CE ~= margin
+    fl[idx, flat_lab[idx]] = -margins
+    # some don't-care ignored pixels
+    labels.reshape(-1)[idx[:50]] = 255
+    from rtseg_tpu.losses.losses import _OHEM_SORT_LIMIT
+    assert flat_lab.size > _OHEM_SORT_LIMIT
+    # thresh chosen so loss_thresh (-log) ~= 27.6 sits ABOVE the hard
+    # cluster: the n_min floor is what keeps pixels, exactly the regime
+    # the old fixed 18.0 ceiling broke (kth capped -> all 20k kept)
+    thresh = 1e-12
+    got = float(losses.ohem_cross_entropy(jnp.asarray(logits),
+                                          jnp.asarray(labels), thresh))
+    want = _torch_ohem(logits, labels, thresh)
+    np.testing.assert_allclose(got, want, rtol=5e-3)
+    # and the result must be the top-n_min mean, clearly distinct from the
+    # saturated-bisection failure mode (mean over the whole hard cluster)
+    pix = losses.cross_entropy(jnp.asarray(logits), jnp.asarray(labels),
+                               reduction='none')
+    pixn = np.asarray(pix).reshape(-1)
+    saturated = pixn[pixn >= 18.0].mean()
+    assert abs(got - want) < 0.2 * abs(got - saturated)
+
+
 def test_dice_matches_reference_raw_logit_behavior():
     rng = np.random.RandomState(0)
     logits = rng.randn(3, 1, 6, 6).astype(np.float32)

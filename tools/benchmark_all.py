@@ -96,7 +96,8 @@ BENCH_S2D = {'on': False,        # set by --s2d; threaded via SegConfig
              'hires_remat': False,
              'segnet_pack': False,
              'pack_fullres': False,
-             'pallas_cm': None}   # None = production auto (kernel on TPU)
+             'pallas_cm': None,   # None = production auto (kernel on TPU)
+             'fused_head': None}  # None = production auto (fused on TPU)
 
 
 def bench_forward(name, batch, h, w, queue, trials):
@@ -150,6 +151,7 @@ def _setup_state(name, batch, h, w, **cfg_overrides):
                     hires_remat=BENCH_S2D['hires_remat'],
                     pack_fullres=BENCH_S2D['pack_fullres'],
                     use_pallas_metrics=BENCH_S2D['pallas_cm'],
+                    fused_head=BENCH_S2D['fused_head'],
                     save_dir='/tmp/rtseg_bench', **cfg_overrides)
     cfg.resolve(num_devices=1)
     cfg.resolve_schedule(train_num=batch * 1000)
@@ -177,6 +179,7 @@ def bench_eval(name, batch, h, w, queue, trials):
     cfg, model, _, mesh, state, images, masks = _setup_state(
         name, batch, h, w, use_ema=True)
     eval_step = build_eval_step(cfg, model, mesh)
+    eval_step.pin()
     compiled = eval_step.jitted.lower(
         jax.device_get(state), images, masks).compile()
     flops = _compiled_flops(compiled)
@@ -188,7 +191,6 @@ def bench_eval(name, batch, h, w, queue, trials):
 def bench_train(name, batch, h, w, queue, trials):
     import jax
     from rtseg_tpu.models.registry import AUX_MODELS, DETAIL_HEAD_MODELS
-    from rtseg_tpu.nn import set_bn_axis
     from rtseg_tpu.train.step import build_train_step
 
     cfg, model, opt, mesh, state, images, masks = _setup_state(
@@ -198,7 +200,7 @@ def bench_train(name, batch, h, w, queue, trials):
         use_ema=True, loss_type='ohem')
     step = build_train_step(cfg, model, opt, mesh)
 
-    set_bn_axis(step.bn_axis)
+    step.pin()
     compiled = step.jitted.lower(
         jax.device_get(state), images, masks).compile()
     flops = _compiled_flops(compiled)
@@ -254,6 +256,14 @@ def main() -> int:
                     action='store_false',
                     help='eval mode: force the one-hot-einsum CM (the '
                          'A/B baseline)')
+    ap.add_argument('--fused-head', action='store_true', default=None,
+                    help='eval mode: force the fused upsample+argmax '
+                         'serving head (config.fused_head); default None '
+                         'follows production auto (fused on TPU)')
+    ap.add_argument('--no-fused-head', dest='fused_head',
+                    action='store_false',
+                    help='eval mode: force the materializing '
+                         'upsample-then-argmax path (the A/B baseline)')
     ap.add_argument('--peak-flops', type=float, default=None,
                     help='override the per-chip peak FLOP/s used for MFU '
                          '(required on device kinds not in '
@@ -266,6 +276,7 @@ def main() -> int:
     BENCH_S2D['hires_remat'] = args.hires_remat
     BENCH_S2D['pack_fullres'] = args.pack_fullres
     BENCH_S2D['pallas_cm'] = args.pallas_cm
+    BENCH_S2D['fused_head'] = args.fused_head
     peak, device_kind = peak_flops(args.peak_flops)
     kind = 'train' if args.train else 'eval' if args.eval else 'forward'
     rows = []

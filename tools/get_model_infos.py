@@ -44,7 +44,6 @@ def cal_train_step_memory(config, imgh=1024, imgw=1024, batch=None):
     how much temp HBM a (crop, batch, remat) combination needs, without
     running anything. No reference equivalent; sizes TPU training runs."""
     from jax.sharding import Mesh
-    from rtseg_tpu.nn import set_bn_axis
     from rtseg_tpu.parallel.mesh import DATA_AXIS
     from rtseg_tpu.train.optim import get_optimizer
     from rtseg_tpu.train.state import create_train_state
@@ -61,7 +60,7 @@ def cal_train_step_memory(config, imgh=1024, imgw=1024, batch=None):
     step = build_train_step(config, model, opt, mesh)
     images = jax.ShapeDtypeStruct((batch, imgh, imgw, 3), jnp.float32)
     masks = jax.ShapeDtypeStruct((batch, imgh, imgw), jnp.int32)
-    set_bn_axis(step.bn_axis)
+    step.pin()
     m = step.jitted.lower(jax.device_get(state), images, masks) \
         .compile().memory_analysis()
     gib = 2.0 ** 30

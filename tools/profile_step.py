@@ -38,7 +38,6 @@ def capture(model_name, batch, h, w, trace_dir, iters, hires_remat=False,
     from rtseg_tpu.config import SegConfig
     from rtseg_tpu.models import get_model
     from rtseg_tpu.models.registry import AUX_MODELS, DETAIL_HEAD_MODELS
-    from rtseg_tpu.nn import set_bn_axis
     from rtseg_tpu.parallel.mesh import DATA_AXIS
     from rtseg_tpu.train.optim import get_optimizer
     from rtseg_tpu.train.state import create_train_state
@@ -66,7 +65,7 @@ def capture(model_name, batch, h, w, trace_dir, iters, hires_remat=False,
         rng.randint(0, 19, (batch, h, w)).astype(np.int32))
     if eval_mode:
         step = build_eval_step(cfg, model, mesh)
-        set_bn_axis(step.bn_axis)
+        step.pin()
         compiled = step.jitted.lower(
             jax.device_get(state), images, masks).compile()
         cm = compiled(state, images, masks)
@@ -77,7 +76,7 @@ def capture(model_name, batch, h, w, trace_dir, iters, hires_remat=False,
             jax.block_until_ready(cm)
         return float(np.asarray(cm).sum())
     step = build_train_step(cfg, model, opt, mesh)
-    set_bn_axis(step.bn_axis)
+    step.pin()
     compiled = step.jitted.lower(
         jax.device_get(state), images, masks).compile()
     state, _ = compiled(state, images, masks)      # warmup / compile check
