@@ -1,0 +1,32 @@
+"""segcheck — repo-native static analysis + trace audit.
+
+Two halves (see tools/segcheck.py for the CLI):
+
+  * AST lint (pure stdlib `ast`, no jax import): import hygiene, registry
+    consistency, trace purity, evidence citations.  Each rule is a function
+    `check_*(root) -> list[Finding]` in its own module.
+  * trace audit (imports jax, still CPU-safe): `jax.eval_shape` sweep over
+    the whole model zoo (shape_audit) and the runtime recompile guard
+    (recompile) that the trainer hooks behind config.recompile_guard.
+
+The lint half must stay importable without jax/flax installed — it is the
+cheap CI gate; keep heavyweight imports inside the audit modules.
+"""
+
+from .core import Finding, iter_python_files, repo_root, run_lints
+from .lint_imports import check_import_hygiene
+from .lint_registry import check_registry_consistency
+from .lint_trace import check_trace_purity
+from .lint_evidence import check_evidence_citations
+# audit modules defer their jax imports to call time, so importing the
+# package stays jax-free
+from .recompile import RecompileError, RecompileGuard, guard_step
+from .shape_audit import AuditResult, audit_model, audit_zoo, zoo_variants
+
+__all__ = [
+    'Finding', 'iter_python_files', 'repo_root', 'run_lints',
+    'check_import_hygiene', 'check_registry_consistency',
+    'check_trace_purity', 'check_evidence_citations',
+    'RecompileError', 'RecompileGuard', 'guard_step',
+    'AuditResult', 'audit_model', 'audit_zoo', 'zoo_variants',
+]

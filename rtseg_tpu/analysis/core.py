@@ -1,0 +1,136 @@
+"""Shared plumbing for the segcheck lint rules.
+
+A Finding is one violation at one source location; rules return lists of
+them and never print or exit themselves (the CLI owns presentation and exit
+codes, the tests assert on the structured findings directly).
+
+Suppression: a line comment `# segcheck: disable=<rule>` (comma-separated
+rule ids, or `all`) suppresses findings reported on that physical line.
+Suppressions are collected per file up front so rules stay pure AST walks.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterator, List, Optional, Sequence, Set
+
+#: rule identifiers, stable across releases (used in suppressions and docs)
+RULE_IMPORTS = 'import-hygiene'
+RULE_REGISTRY = 'registry-consistency'
+RULE_TRACE = 'trace-purity'
+RULE_EVIDENCE = 'evidence-citation'
+ALL_RULES = (RULE_IMPORTS, RULE_REGISTRY, RULE_TRACE, RULE_EVIDENCE)
+
+_SUPPRESS_RE = re.compile(r'#\s*segcheck:\s*disable=([\w,\- ]+)')
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str          # repo-relative
+    line: int
+    message: str
+
+    def __str__(self) -> str:
+        return f'{self.path}:{self.line}: [{self.rule}] {self.message}'
+
+
+def repo_root(start: Optional[str] = None) -> str:
+    """Nearest ancestor of `start` (default: this package) containing the
+    rtseg_tpu package directory — the tree every rule scans."""
+    d = os.path.abspath(start or os.path.join(os.path.dirname(__file__),
+                                              '..', '..'))
+    while True:
+        if os.path.isdir(os.path.join(d, 'rtseg_tpu')):
+            return d
+        parent = os.path.dirname(d)
+        if parent == d:
+            raise FileNotFoundError(
+                'could not locate the rtseg_tpu package root')
+        d = parent
+
+
+def iter_python_files(root: str, subdirs: Sequence[str] = ('rtseg_tpu',
+                                                           'tools')
+                      ) -> Iterator[str]:
+    """Yield repo-relative paths of runtime .py files under `subdirs`."""
+    for sub in subdirs:
+        base = os.path.join(root, sub)
+        if not os.path.isdir(base):
+            continue
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames if d != '__pycache__']
+            for fn in sorted(filenames):
+                if fn.endswith('.py'):
+                    yield os.path.relpath(os.path.join(dirpath, fn), root)
+
+
+@dataclass
+class SourceFile:
+    """One parsed runtime module: AST + per-line suppressions."""
+    root: str
+    relpath: str
+    text: str
+    tree: ast.Module
+    suppressed: Dict[int, Set[str]] = field(default_factory=dict)
+
+    @classmethod
+    def load(cls, root: str, relpath: str) -> 'SourceFile':
+        path = os.path.join(root, relpath)
+        with tokenize.open(path) as f:   # honors PEP 263 encodings
+            text = f.read()
+        tree = ast.parse(text, filename=relpath)
+        sf = cls(root=root, relpath=relpath, text=text, tree=tree)
+        for lineno, line in enumerate(text.splitlines(), start=1):
+            m = _SUPPRESS_RE.search(line)
+            if m:
+                rules = {r.strip() for r in m.group(1).split(',') if r.strip()}
+                sf.suppressed[lineno] = rules
+        return sf
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        rules = self.suppressed.get(line, ())
+        return 'all' in rules or rule in rules
+
+    def finding(self, rule: str, line: int, message: str
+                ) -> Optional[Finding]:
+        if self.is_suppressed(rule, line):
+            return None
+        return Finding(rule=rule, path=self.relpath, line=line,
+                       message=message)
+
+
+def load_tree(root: str, subdirs: Sequence[str] = ('rtseg_tpu', 'tools')
+              ) -> List[SourceFile]:
+    return [SourceFile.load(root, rel)
+            for rel in iter_python_files(root, subdirs)]
+
+
+def run_lints(root: Optional[str] = None,
+              rules: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Run the selected AST lint rules over the repo; returns all findings
+    sorted by location. No jax import — safe as a bare CI gate."""
+    from .lint_imports import check_import_hygiene
+    from .lint_registry import check_registry_consistency
+    from .lint_trace import check_trace_purity
+    from .lint_evidence import check_evidence_citations
+    table: Dict[str, Callable[..., List[Finding]]] = {
+        RULE_IMPORTS: check_import_hygiene,
+        RULE_REGISTRY: check_registry_consistency,
+        RULE_TRACE: check_trace_purity,
+        RULE_EVIDENCE: check_evidence_citations,
+    }
+    root = root or repo_root()
+    selected = list(rules) if rules is not None else list(ALL_RULES)
+    unknown = [r for r in selected if r not in table]
+    if unknown:
+        raise ValueError(f'unknown rule(s) {unknown}; valid: {ALL_RULES}')
+    files = load_tree(root)     # parse once, share across all rules
+    findings: List[Finding] = []
+    for rule in selected:
+        findings.extend(table[rule](root, files=files))
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))

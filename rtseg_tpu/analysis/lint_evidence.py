@@ -1,0 +1,137 @@
+"""Rule `evidence-citation`: measurement claims must cite real evidence.
+
+Rounds 4 and 5 of review both caught docstrings citing benchmark
+measurements that do not exist (a "measured 39%" pointing at a
+BENCHMARKS.md section that was never written). This rule makes that
+failure structural instead of re-litigated: any comment/docstring that
+*claims a measurement* must, in the same block, anchor it to evidence that
+is actually in the repo. Claims are:
+
+  * the word "measured", or
+  * "<N>% of ... step/time/eval" cost attributions, or
+  * an explicit section citation of the benchmarks doc (a quoted or
+    §-prefixed section name next to the file name).
+
+Valid anchors, checked against the tree:
+
+  * a BENCHMARKS.md mention in the block — and if a section name
+    accompanies it, that name must be a (case-insensitive) substring of a
+    real heading there;
+  * a committed evidence artifact (*.log / *.json) that exists at the repo
+    root or under tools/.
+
+Unmeasured expectations are fine — write "unmeasured on hardware" or
+phrase them as estimates; the rule only fires on claim language.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from typing import Iterator, List, Optional, Tuple
+
+from .core import Finding, RULE_EVIDENCE, SourceFile, load_tree
+
+_CLAIM_RES = (
+    re.compile(r'\bmeasured\b', re.IGNORECASE),
+    re.compile(r'\d(?:\.\d+)?\s*%(?:[ \t]|\n)*of\b[^.;!?]{0,80}'
+               r'\b(?:step|time|eval)\b', re.IGNORECASE | re.DOTALL),
+)
+_BENCH_MENTION = re.compile(r'BENCHMARKS\.md')
+_BENCH_SECTION = re.compile(
+    r'BENCHMARKS\.md[^"\'§]{0,40}(?:["\'“]([^"\'”\n]{2,80})["\'”]'
+    r'|§\s*([^".;)\n]{2,60}))')
+_EVIDENCE_FILE = re.compile(r'\b([\w][\w.-]*\.(?:log|json))\b')
+
+
+def _headings(root: str) -> List[str]:
+    path = os.path.join(root, 'BENCHMARKS.md')
+    if not os.path.exists(path):
+        return []
+    with open(path, 'r') as f:
+        return [line.lstrip('#').strip().lower()
+                for line in f if line.startswith('#')]
+
+
+def _blocks(sf: SourceFile) -> Iterator[Tuple[int, str]]:
+    """Yield (start_line, text) for every docstring and every run of
+    consecutive comment lines."""
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.Module, ast.ClassDef, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            doc = ast.get_docstring(node, clean=False)
+            if doc and node.body:
+                yield node.body[0].lineno, doc
+    cur_start, cur_lines, last_line = None, [], None
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(sf.text).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            line = tok.start[0]
+            if cur_start is not None and last_line is not None \
+                    and line == last_line + 1:
+                cur_lines.append(tok.string)
+            else:
+                if cur_start is not None:
+                    yield cur_start, '\n'.join(cur_lines)
+                cur_start, cur_lines = line, [tok.string]
+            last_line = line
+    except tokenize.TokenError:
+        pass
+    if cur_start is not None:
+        yield cur_start, '\n'.join(cur_lines)
+
+
+def _anchor_ok(root: str, text: str, headings: List[str]
+               ) -> Tuple[bool, Optional[str], int]:
+    """(has_valid_anchor, error, error_offset) — error is set when a cited
+    BENCHMARKS.md section does not resolve to a real heading, with the
+    offset of the failing citation (so the finding lands on its line, not
+    on an earlier, valid citation in the same block)."""
+    for m in _BENCH_SECTION.finditer(text):
+        section = (m.group(1) or m.group(2) or '').strip()
+        if section and not any(section.lower() in h for h in headings):
+            return False, (f'cites BENCHMARKS.md section {section!r}, which '
+                           f'matches no heading in BENCHMARKS.md'), m.start()
+    if _BENCH_MENTION.search(text):
+        return True, None, 0
+    for m in _EVIDENCE_FILE.finditer(text):
+        fname = m.group(1)
+        if os.path.exists(os.path.join(root, fname)) \
+                or os.path.exists(os.path.join(root, 'tools', fname)):
+            return True, None, 0
+    return False, None, 0
+
+
+def check_evidence_citations(root: str, files=None) -> List[Finding]:
+    headings = _headings(root)
+    findings: List[Finding] = []
+    for sf in (files if files is not None else load_tree(root)):
+        for start, text in _blocks(sf):
+            claims = [m for rx in _CLAIM_RES for m in rx.finditer(text)]
+            has_section_ref = _BENCH_SECTION.search(text) is not None
+            if not claims and not has_section_ref:
+                continue
+            ok, err, err_off = _anchor_ok(root, text, headings)
+            if ok:
+                continue
+            if err is not None:
+                line = start + text[:err_off].count('\n')
+                msg = err
+            else:
+                first = min(claims, key=lambda m: m.start())
+                line = start + text[:first.start()].count('\n')
+                msg = (f'measurement claim {first.group(0)!r} has no '
+                       f'evidence anchor — cite a BENCHMARKS.md heading or '
+                       f'a committed *.log/*.json, or reword as '
+                       f'"unmeasured on hardware"')
+            # suppressible on the claim line or on the block's first line
+            if sf.is_suppressed(RULE_EVIDENCE, line) \
+                    or sf.is_suppressed(RULE_EVIDENCE, start):
+                continue
+            findings.append(Finding(RULE_EVIDENCE, sf.relpath, line, msg))
+    return findings

@@ -1,0 +1,68 @@
+"""Rule `import-hygiene`: torch never loads at runtime-module import time.
+
+The zoo is torch-free on the hot path by design: torch exists only as an
+offline weight-import bridge (utils/torch_import.py, utils/transplant.py)
+and in test stubs. A module-top-level `import torch` anywhere under
+rtseg_tpu/ or tools/ would make every production entry point pay torch's
+import cost — or crash outright on TPU images that don't ship it. Only
+function-body imports (executed on the explicit offline path) are allowed;
+utils/torch_import.py is the one module exempt even at top level, so the
+bridge itself stays free to organize its imports.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .core import Finding, RULE_IMPORTS, load_tree
+
+FORBIDDEN_ROOTS = ('torch', 'torchvision')
+
+#: modules whose whole file is the offline torch bridge
+EXEMPT_FILES = ('rtseg_tpu/utils/torch_import.py',)
+
+
+def _forbidden_root(modname: str) -> bool:
+    head = modname.split('.', 1)[0]
+    return head in FORBIDDEN_ROOTS
+
+
+def _module_scope_imports(tree: ast.Module):
+    """Yield (node, module_name) for imports NOT inside a function body.
+
+    Class bodies and module-level `if`/`try` blocks still execute at import
+    time, so they count as module scope; only def/async-def bodies defer
+    execution to call time."""
+    def walk(node, in_function):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from walk(child, True)
+                continue
+            if not in_function:
+                if isinstance(child, ast.Import):
+                    for alias in child.names:
+                        yield child, alias.name
+                elif isinstance(child, ast.ImportFrom):
+                    if child.module is not None and child.level == 0:
+                        yield child, child.module
+            yield from walk(child, in_function)
+    yield from walk(tree, False)
+
+
+def check_import_hygiene(root: str, files=None) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in (files if files is not None else load_tree(root)):
+        if sf.relpath.replace('\\', '/') in EXEMPT_FILES:
+            continue
+        for node, modname in _module_scope_imports(sf.tree):
+            if not _forbidden_root(modname):
+                continue
+            f = sf.finding(
+                RULE_IMPORTS, node.lineno,
+                f'module-scope import of {modname!r}: torch/torchvision '
+                f'may only be imported inside function bodies (offline '
+                f'weight-import paths) or utils/torch_import.py')
+            if f:
+                findings.append(f)
+    return findings

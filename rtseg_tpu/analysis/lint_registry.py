@@ -1,0 +1,101 @@
+"""Rule `registry-consistency`: models/ and MODEL_REGISTRY agree exactly.
+
+The registry (models/registry.py) is the zoo's single public index — every
+downstream surface (trainer dispatch, benchmark sweeps, the eval_shape zoo
+audit) iterates it. Two drift modes have to be impossible:
+
+  * a registry entry pointing at a missing submodule or a class name that
+    does not exist there (crashes at get_model time, long after CI), and
+  * an architecture file landing in models/ without a registry entry
+    (silently absent from every sweep — "the zoo has 36 models" rots).
+
+Pure AST: the registry dict literal is read without importing the models
+package, so this rule runs without jax/flax.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Tuple
+
+from .core import Finding, RULE_REGISTRY, SourceFile
+
+REGISTRY_FILE = 'rtseg_tpu/models/registry.py'
+MODELS_DIR = 'rtseg_tpu/models'
+
+#: shared infrastructure modules in models/ that are NOT zoo architectures:
+#: the package init, the registry itself, shared backbones, the smp generic
+#: encoder-decoder hub and its MiT (SegFormer) encoder. Anything else must
+#: be registered.
+NON_MODEL_MODULES = frozenset({'__init__', 'registry', 'backbone', 'smp',
+                               'mit'})
+
+
+def _parse_registry(sf: SourceFile) -> Tuple[Dict[str, Tuple[str, str]], int]:
+    """Extract the MODEL_REGISTRY literal: name -> (submodule, class)."""
+    for node in sf.tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
+        if 'MODEL_REGISTRY' not in targets:
+            continue
+        entries: Dict[str, Tuple[str, str]] = {}
+        if isinstance(node.value, ast.Dict):
+            for k, v in zip(node.value.keys, node.value.values):
+                try:
+                    key = ast.literal_eval(k)
+                    sub, cls = ast.literal_eval(v)
+                except (ValueError, TypeError):
+                    continue
+                entries[key] = (sub, cls)
+        return entries, node.lineno
+    return {}, 1
+
+
+def _class_names(path: str) -> set:
+    with open(path, 'r') as f:
+        tree = ast.parse(f.read(), filename=path)
+    return {n.name for n in tree.body if isinstance(n, ast.ClassDef)}
+
+
+def check_registry_consistency(root: str, files=None) -> List[Finding]:
+    findings: List[Finding] = []
+    reg_path = os.path.join(root, REGISTRY_FILE)
+    if not os.path.exists(reg_path):
+        return [Finding(RULE_REGISTRY, REGISTRY_FILE, 1,
+                        'registry module is missing')]
+    sf = next((f for f in (files or ())
+               if f.relpath.replace('\\', '/') == REGISTRY_FILE), None) \
+        or SourceFile.load(root, REGISTRY_FILE)
+    registry, reg_line = _parse_registry(sf)
+    if not registry:
+        return [Finding(RULE_REGISTRY, REGISTRY_FILE, reg_line,
+                        'could not parse a MODEL_REGISTRY dict literal')]
+
+    models_dir = os.path.join(root, MODELS_DIR)
+    files = {fn[:-3] for fn in os.listdir(models_dir)
+             if fn.endswith('.py')}
+
+    def emit(line: int, msg: str) -> None:
+        f = sf.finding(RULE_REGISTRY, line, msg)
+        if f:
+            findings.append(f)
+
+    # registry -> files: submodule exists, class defined in it
+    for name, (sub, cls) in sorted(registry.items()):
+        if sub not in files:
+            emit(reg_line, f'registry entry {name!r} points at missing '
+                           f'submodule models/{sub}.py')
+            continue
+        if cls not in _class_names(os.path.join(models_dir, f'{sub}.py')):
+            emit(reg_line, f'registry entry {name!r} declares class '
+                           f'{cls!r}, not defined in models/{sub}.py')
+
+    # files -> registry: every architecture module is registered
+    registered_subs = {sub for sub, _ in registry.values()}
+    for fn in sorted(files - NON_MODEL_MODULES - registered_subs):
+        emit(reg_line, f'models/{fn}.py has no MODEL_REGISTRY entry (add '
+                       f'one, or list it in analysis.lint_registry.'
+                       f'NON_MODEL_MODULES if it is shared infrastructure)')
+    return findings

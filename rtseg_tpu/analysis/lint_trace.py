@@ -1,0 +1,192 @@
+"""Rule `trace-purity`: no host-side effects inside jit-traced functions.
+
+A `print`, `np.random.*`, or `time`/`datetime` call inside a function that
+jax traces does not do what it looks like: it fires once at trace time
+(then never again — or worse, again on every silent retrace), bakes a
+host-generated "random" constant into the compiled program, or timestamps
+trace time instead of run time. All three are classic staleness bugs in a
+framework whose whole premise is trace-once-run-forever.
+
+Scope: the compiled-step builders (train/step.py) and every op kernel
+(ops/*.py). The rule finds jit ROOTS — functions decorated with jit, or
+passed by name into jax.jit / pjit / shard_map / pallas_call /
+jax.checkpoint / value_and_grad / grad / vmap — then walks the
+reference-graph (a bare-name reference to a scanned function counts as an
+edge, so helpers called from inside a traced closure are covered, across
+files too) and flags forbidden calls in any reachable function.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding, RULE_TRACE, SourceFile, iter_python_files
+
+#: files whose functions may end up inside a jax trace
+TARGET_PREFIXES = ('rtseg_tpu/train/step.py', 'rtseg_tpu/ops/')
+
+#: call names (last dotted segment) that receive functions destined for
+#: tracing — a function passed by name into one of these is a jit root
+JIT_WRAPPERS = frozenset({
+    'jit', 'pjit', 'shard_map', '_shard_map', 'pallas_call', 'checkpoint',
+    'remat', 'value_and_grad', 'grad', 'vmap', 'custom_vjp', 'custom_jvp',
+    'eval_shape',
+})
+
+#: dotted-prefix -> reason, for forbidden calls inside traced code
+FORBIDDEN_PREFIXES: Tuple[Tuple[str, str], ...] = (
+    ('np.random.', 'host RNG is baked in as a trace-time constant'),
+    ('numpy.random.', 'host RNG is baked in as a trace-time constant'),
+    ('time.', 'runs at trace time, not step time'),
+    ('datetime.', 'runs at trace time, not step time'),
+)
+
+
+def _dotted(func: ast.expr) -> Optional[str]:
+    parts: List[str] = []
+    node = func
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return '.'.join(reversed(parts))
+    return None
+
+
+class _FnInfo:
+    def __init__(self, sf: SourceFile, node: ast.AST, qualname: str):
+        self.sf = sf
+        self.node = node
+        self.qualname = qualname
+        self.is_root = False
+        self.refs: Set[str] = set()        # bare names referenced in body
+
+
+def _decorated_jit(node) -> bool:
+    for dec in node.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = _dotted(target)
+        if name and name.split('.')[-1] in JIT_WRAPPERS:
+            return True
+        # functools.partial(jax.jit, ...) style decorators
+        if isinstance(dec, ast.Call):
+            for arg in dec.args:
+                d = _dotted(arg)
+                if d and d.split('.')[-1] in JIT_WRAPPERS:
+                    return True
+    return False
+
+
+def _index_file(sf: SourceFile) -> Tuple[Dict[str, _FnInfo], Set[str]]:
+    """Return (functions by bare name, names passed into jit wrappers)."""
+    fns: Dict[str, _FnInfo] = {}
+    root_refs: Set[str] = set()
+
+    def visit(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f'{prefix}{child.name}'
+                info = _FnInfo(sf, child, qual)
+                info.is_root = _decorated_jit(child)
+                for sub in ast.walk(child):
+                    if isinstance(sub, ast.Name):
+                        info.refs.add(sub.id)
+                # keep the outermost definition under a given bare name;
+                # same-name nested closures merge their refs conservatively
+                if child.name in fns:
+                    fns[child.name].refs |= info.refs
+                    fns[child.name].is_root |= info.is_root
+                else:
+                    fns[child.name] = info
+                visit(child, f'{qual}.')
+            else:
+                visit(child, prefix)
+
+    visit(sf.tree, '')
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _dotted(node.func)
+        if not name or name.split('.')[-1] not in JIT_WRAPPERS:
+            continue
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            # unwrap functools.partial(fn, ...) around the traced callable
+            if isinstance(arg, ast.Call):
+                fname = _dotted(arg.func)
+                if fname and fname.split('.')[-1] == 'partial':
+                    for inner in arg.args:
+                        d = _dotted(inner)
+                        if d:
+                            root_refs.add(d.split('.')[-1])
+                continue
+            d = _dotted(arg)
+            if d:
+                root_refs.add(d.split('.')[-1])
+    return fns, root_refs
+
+
+def _forbidden(call: ast.Call) -> Optional[str]:
+    name = _dotted(call.func)
+    if name is None:
+        return None
+    if name == 'print':
+        return 'print() fires at trace time only (use jax.debug.print)'
+    for prefix, why in FORBIDDEN_PREFIXES:
+        if name.startswith(prefix):
+            return f'{name}(): {why}'
+    return None
+
+
+def check_trace_purity(root: str, files=None) -> List[Finding]:
+    if files is not None:
+        files = [sf for sf in files
+                 if sf.relpath.replace('\\', '/').startswith(TARGET_PREFIXES)]
+    else:
+        targets = [rel for rel in iter_python_files(root)
+                   if rel.replace('\\', '/').startswith(TARGET_PREFIXES)]
+        files = [SourceFile.load(root, rel) for rel in targets]
+
+    # global function index by bare name (cross-file edges resolve here)
+    all_fns: Dict[str, List[_FnInfo]] = {}
+    roots: Set[str] = set()
+    wrapper_refs: Set[str] = set()
+    for sf in files:
+        fns, root_refs = _index_file(sf)
+        for name, info in fns.items():
+            all_fns.setdefault(name, []).append(info)
+            if info.is_root:
+                roots.add(name)
+        wrapper_refs |= root_refs
+    roots |= {r for r in wrapper_refs if r in all_fns}
+
+    # reachability over bare-name reference edges
+    reachable: Set[str] = set()
+    frontier = [r for r in roots if r in all_fns]
+    while frontier:
+        name = frontier.pop()
+        if name in reachable:
+            continue
+        reachable.add(name)
+        for info in all_fns.get(name, ()):
+            for ref in info.refs:
+                if ref in all_fns and ref not in reachable:
+                    frontier.append(ref)
+
+    findings: List[Finding] = []
+    for name in sorted(reachable):
+        for info in all_fns[name]:
+            for node in ast.walk(info.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                why = _forbidden(node)
+                if why is None:
+                    continue
+                f = info.sf.finding(
+                    RULE_TRACE, node.lineno,
+                    f'{why} — inside {info.qualname!r}, which is reachable '
+                    f'from a jit entry point')
+                if f:
+                    findings.append(f)
+    return findings

@@ -1,0 +1,93 @@
+"""Runtime recompile guard for the compiled train/eval/predict steps.
+
+The framework's performance story assumes trace-once-run-forever: every
+step after warmup reuses one compiled executable. A silent retrace (a
+shape drifting batch, a config toggle flipping a trace-time global, a
+weakly-typed scalar changing dtype) costs seconds of XLA compile on the
+hot path and usually signals a correctness hazard, but jit hides it —
+steps just get slower.
+
+Opt-in via config.recompile_guard: the trainer wraps each compiled step so
+that after `warmup` calls, any growth of the step's jit cache raises
+RecompileError naming the step, instead of silently eating the compile.
+Reads only the public-ish `_cache_size` introspection on the jitted
+callable; if a future jax drops it the guard degrades to a no-op with a
+one-time warning rather than breaking training.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Any, Callable, Optional
+
+
+class RecompileError(RuntimeError):
+    """A compiled step retraced after its warmup window."""
+
+
+def _cache_size(jitted: Any) -> Optional[int]:
+    size_fn = getattr(jitted, '_cache_size', None)
+    if size_fn is None:
+        return None
+    try:
+        return int(size_fn())
+    except Exception:   # noqa: BLE001 — introspection must never kill a step
+        return None
+
+
+class RecompileGuard:
+    """Tracks one compiled step's jit-cache size across calls."""
+
+    def __init__(self, name: str, warmup: int = 1):
+        self.name = name
+        self.warmup = max(int(warmup), 1)
+        self.calls = 0
+        self.baseline: Optional[int] = None
+        self._warned_no_introspection = False
+
+    def after_call(self, jitted: Any) -> None:
+        size = _cache_size(jitted)
+        if size is None:
+            if not self._warned_no_introspection:
+                warnings.warn(
+                    f'recompile_guard: {self.name} exposes no jit cache '
+                    f'introspection; guard is inert', stacklevel=2)
+                self._warned_no_introspection = True
+            return
+        self.calls += 1
+        if self.calls <= self.warmup:
+            self.baseline = size
+            return
+        if self.baseline is not None and size > self.baseline:
+            raise RecompileError(
+                f'{self.name} retraced after warmup: jit cache grew '
+                f'{self.baseline} -> {size} at call {self.calls}. A '
+                f'compiled step must keep static shapes/dtypes after its '
+                f'first {self.warmup} call(s) — look for drifting batch '
+                f'shapes, weak-typed scalars, or trace-time globals '
+                f'flipping between calls.')
+
+
+#: step-wrapper attributes to mirror (train/step.py _pin_bn_axis contract)
+_MIRRORED_ATTRS = ('jitted', 'pin', 'bn_axis', 's2d_stem', 'defer_upsample')
+
+
+def guard_step(step_fn: Callable, name: str, warmup: int = 1) -> Callable:
+    """Wrap a built step so every call is followed by a cache-growth check.
+
+    Accepts either a bare jitted callable or the _pin_bn_axis wrapper
+    (whose `.jitted` is the actual jit object holding the cache)."""
+    jitted = getattr(step_fn, 'jitted', step_fn)
+    guard = RecompileGuard(name, warmup=warmup)
+
+    def wrapper(*args, **kwargs):
+        out = step_fn(*args, **kwargs)
+        guard.after_call(jitted)
+        return out
+
+    for attr in _MIRRORED_ATTRS:
+        if hasattr(step_fn, attr):
+            setattr(wrapper, attr, getattr(step_fn, attr))
+    wrapper.guard = guard
+    wrapper.__wrapped__ = step_fn
+    return wrapper

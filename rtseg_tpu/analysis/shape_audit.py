@@ -1,0 +1,130 @@
+"""eval_shape sweep over the whole model zoo — the cheap half of CI.
+
+`jax.eval_shape` traces a model's init and forward with abstract values
+only: no weights are materialized, no kernel runs on any device, so
+auditing all 36 registry architectures (plus aux/detail variants) costs
+seconds of CPU. What it proves per model:
+
+  * the module still builds from a SegConfig (registry wiring is live),
+  * eval forward emits [B, H, W, num_class] logits in the input dtype
+    (the contract every step builder and the fused head rely on),
+  * train forward emits the declared aux/detail structure with num_class
+    (or 1, detail) channels and spatially-divisor aux resolutions,
+  * the whole forward traces without concrete-value leaks — a model that
+    branches on traced data fails here, before it ever reaches a TPU.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+
+@dataclass
+class AuditResult:
+    label: str                 # e.g. 'bisenetv2', 'bisenetv2+aux'
+    ok: bool
+    message: str = ''
+    out_shape: Optional[Tuple[int, ...]] = None
+
+    def __str__(self) -> str:
+        status = 'ok' if self.ok else 'FAIL'
+        tail = f' {self.message}' if self.message else ''
+        return f'{self.label}: {status}{tail}'
+
+
+def zoo_variants(model_names: Optional[Sequence[str]] = None
+                 ) -> List[Tuple[str, dict]]:
+    """(label, config overrides) for every audited zoo entry: each registry
+    model plain, plus the aux/detail variants the registry declares."""
+    from ..models.registry import (AUX_MODELS, DETAIL_HEAD_MODELS,
+                                   MODEL_NAMES)
+    names = list(model_names) if model_names is not None else \
+        list(MODEL_NAMES)
+    variants: List[Tuple[str, dict]] = []
+    for name in names:
+        variants.append((name, {'model': name}))
+        if name in AUX_MODELS:
+            variants.append((f'{name}+aux', {'model': name,
+                                             'use_aux': True}))
+        if name in DETAIL_HEAD_MODELS:
+            variants.append((f'{name}+detail',
+                             {'model': name, 'use_detail_head': True}))
+    return variants
+
+
+def _leaf_shapes(tree):
+    import jax
+    return [tuple(l.shape) for l in jax.tree.leaves(tree)]
+
+
+def audit_model(label: str, overrides: dict, num_class: int = 19,
+                image_shape: Tuple[int, int, int, int] = (1, 64, 64, 3)
+                ) -> AuditResult:
+    """Shape/dtype-contract audit of one zoo entry, weights never built."""
+    import jax
+    import jax.numpy as jnp
+    from ..config import SegConfig
+    from ..models import get_model
+
+    B, H, W, _ = image_shape
+    cfg = SegConfig(dataset='synthetic', num_class=num_class,
+                    compute_dtype='float32', save_dir='/tmp/rtseg_audit',
+                    **overrides)
+    cfg.resolve(num_devices=1)
+    try:
+        model = get_model(cfg)
+        x = jax.ShapeDtypeStruct(image_shape, jnp.float32)
+        rng = jax.random.PRNGKey(0)
+        variables = jax.eval_shape(lambda r, xx: model.init(r, xx, False),
+                                   rng, x)
+        out = jax.eval_shape(lambda v, xx: model.apply(v, xx, False),
+                             variables, x)
+    except Exception as e:                     # noqa: BLE001 — report, don't crash the sweep
+        return AuditResult(label, False, f'{type(e).__name__}: {e}')
+
+    want = (B, H, W, num_class)
+    if tuple(out.shape) != want:
+        return AuditResult(label, False,
+                           f'eval output {tuple(out.shape)} != {want}',
+                           tuple(out.shape))
+    if out.dtype != jnp.float32:
+        return AuditResult(label, False,
+                           f'eval output dtype {out.dtype} != float32',
+                           tuple(out.shape))
+
+    if cfg.use_aux or cfg.use_detail_head:
+        try:
+            tout = jax.eval_shape(
+                lambda v, xx: model.apply(v, xx, True,
+                                          mutable=['batch_stats'],
+                                          rngs={'dropout':
+                                                jax.random.PRNGKey(1)}),
+                variables, x)
+        except Exception as e:                 # noqa: BLE001
+            return AuditResult(label, False,
+                               f'train trace: {type(e).__name__}: {e}')
+        (main, extras), _ = tout
+        if tuple(main.shape) != want:
+            return AuditResult(label, False,
+                               f'train main {tuple(main.shape)} != {want}')
+        extras = extras if isinstance(extras, (tuple, list)) else [extras]
+        want_c = 1 if cfg.use_detail_head else num_class
+        for i, ex in enumerate(extras):
+            eb, eh, ew, ec = ex.shape
+            if eb != B or ec != want_c or H % eh or W % ew:
+                return AuditResult(
+                    label, False,
+                    f'head {i} shape {tuple(ex.shape)} breaks the '
+                    f'(B, H/k, W/k, {want_c}) contract for input {want}')
+    return AuditResult(label, True, out_shape=tuple(out.shape))
+
+
+def audit_zoo(model_names: Optional[Sequence[str]] = None,
+              num_class: int = 19,
+              image_shape: Tuple[int, int, int, int] = (1, 64, 64, 3)
+              ) -> List[AuditResult]:
+    """Audit every zoo variant; always returns the full report (callers
+    decide whether failures are fatal)."""
+    return [audit_model(label, ov, num_class, image_shape)
+            for label, ov in zoo_variants(model_names)]

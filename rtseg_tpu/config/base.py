@@ -109,9 +109,10 @@ class SegConfig:
     # 'float32', None (default) defers to compute_dtype.
     amp_training: Optional[bool] = None
     # rematerialize the training forward in backward (jax.checkpoint):
-    # trades recompute FLOPs for HBM. Whole-forward granularity — measured
-    # ~20% temp-HBM saving on bisenetv2 @1024^2 bs16 (12.0 -> 9.6 GiB);
-    # for larger inputs the bigger levers are spatial_partition and
+    # trades recompute FLOPs for HBM. Whole-forward granularity — coarse;
+    # superseded as a batch-unlock lever by the targeted detail_remat /
+    # hires_remat flags (BENCHMARKS.md "Generalizing trace-guided remat").
+    # For larger inputs the bigger levers are spatial_partition and
     # smaller per-device batch
     remat: bool = False
     resume_training: bool = True
@@ -189,7 +190,9 @@ class SegConfig:
     # (ops/resize.final_upsample) and the eval/predict steps fuse
     # upsample+argmax in one Pallas kernel that never materializes the
     # full-resolution logit tensor (ops/fused_head.resize_argmax; the
-    # materializing path measured 39% of the fastscnn full-res eval step).
+    # materializing path's cost is the HBM-traffic arithmetic bound in
+    # ops/fused_head.py — its isolated share of the eval step is
+    # unmeasured on hardware).
     # Exact same predictions up to float-associativity on near-ties.
     # None = auto: on for TPU, off elsewhere (interpret-mode Pallas is
     # slow on CPU). Spatial (GSPMD) meshes always use the materializing
@@ -201,10 +204,16 @@ class SegConfig:
     # drop the big early-stage residuals, keep the cheap deep ones). Math
     # identical; param paths unchanged (function-scope nn.remat).
     hires_remat: bool = False
+    # runtime recompile guard (analysis/recompile.py): wraps the compiled
+    # train/eval/predict steps so that after each step's warmup call, any
+    # jit-cache growth — a silent retrace from drifting batch shapes,
+    # weak-typed scalars, or trace-time globals — raises RecompileError
+    # instead of silently eating an XLA compile on the hot path
+    recompile_guard: bool = False
     # bisenetv2: eval-only S2D(2) compute layout for the full-res stem +
     # detail stages (the generalization of segnet_pack — the stem's thin-
-    # channel tensors are 38.7% of the full-res eval step). Exact, same
-    # param tree; see nn/packed.py.
+    # channel tensors dominate the full-res eval step, BENCHMARKS.md
+    # round-4 profile). Exact, same param tree; see nn/packed.py.
     pack_fullres: bool = False
 
     # ----- Derived fields (filled by resolve(); never set by hand) -----

@@ -44,7 +44,8 @@ def cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray,
     # select the target-class log-prob via a fused one-hot reduction, NOT
     # take_along_axis: the gather's backward is a scatter-add into a
     # [B,H,W,C] zero tensor, which serializes on TPU (~290ms/step at bs32
-    # 1024x512x19 vs ~3ms for the one-hot multiply, measured on v5e). XLA
+    # 1024x512x19 vs ~3ms for the one-hot multiply, measured on v5e —
+    # BENCHMARKS.md "Train step" history note). XLA
     # fuses the iota==label comparison into the reduction, so the one-hot
     # is never materialized and the backward is a broadcast multiply.
     onehot = (safe[..., None] ==

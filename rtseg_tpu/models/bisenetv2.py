@@ -101,8 +101,9 @@ class DetailBranch(nn.Module):
     out_channels: int = 128
     act_type: str = 'relu'
     # eval-only S2D(2) layout for the first three convs (the 1/1-1/2-res
-    # 64-channel stages — 20% of the full-res eval step, half-empty lanes
-    # unpacked); exact rewrite, same param tree
+    # 64-channel stages — 20% of the full-res eval step, BENCHMARKS.md
+    # round-4 profile, half-empty lanes unpacked); exact rewrite, same
+    # param tree
     packed: bool = False
 
     @nn.compact

@@ -1,7 +1,7 @@
 from .resize import (resize_bilinear, resize_nearest, pixel_shuffle,
                      scale_resize, final_upsample, set_defer_final_upsample,
                      get_defer_final_upsample)
-from .fused_head import resize_argmax
+from .fused_head import fused_path, resize_argmax
 from .pool import (max_pool, avg_pool, max_pool_argmax_2x2, max_unpool_2x2,
                    adaptive_avg_pool, adaptive_max_pool, global_avg_pool)
 from .shuffle import channel_shuffle, channel_split
@@ -9,7 +9,7 @@ from .shuffle import channel_shuffle, channel_split
 __all__ = [
     'resize_bilinear', 'resize_nearest', 'pixel_shuffle', 'scale_resize',
     'final_upsample', 'set_defer_final_upsample', 'get_defer_final_upsample',
-    'resize_argmax',
+    'fused_path', 'resize_argmax',
     'max_pool', 'avg_pool', 'max_pool_argmax_2x2', 'max_unpool_2x2',
     'adaptive_avg_pool', 'adaptive_max_pool', 'global_avg_pool',
     'channel_shuffle', 'channel_split',

@@ -5,10 +5,9 @@ and argmaxes (reference core/seg_trainer.py:128-131,170-172 — the model's
 final F.interpolate followed by tensor.argmax(1)). Done naively on TPU that
 materializes a [B, H, W, C] full-resolution logit tensor in HBM — at the
 Cityscapes serving shape (bs128, 1024x2048, 19 classes) that is ~10 GB of
-write+read traffic per step just to pick the max channel, plus a separate
-full-size argmax reduce and int cast (the materializing upsample+argmax
-measured 39% of the fastscnn full-res eval step — BENCHMARKS.md
-"Fused serving head" section for the measured effect of this op).
+write+read traffic per step just to pick the max channel (arithmetic bound;
+the op's isolated cost share is unmeasured on hardware), plus a separate
+full-size argmax reduce and int cast.
 
 This op never builds the full-res tensor:
 
@@ -93,6 +92,23 @@ def _head_kernel(nh: int, th: int, C: int, tw: int,
         out_ref[0, hi * th:(hi + 1) * th, :] = idx
 
 
+def fused_path(in_shape: Tuple[int, int, int, int], size,
+               dtype=jnp.float32) -> str:
+    """Which path `resize_argmax` takes for this (static) input signature:
+    'identity' (sizes already match -> plain argmax), 'pallas' (the fused
+    kernel), or 'materialize' (untileable -> the materializing fallback).
+    Trace-time deterministic, so callers/tests can assert the path instead
+    of silently exercising the fallback."""
+    _, h, w, C = in_shape
+    H, W = _pair(size)
+    if (h, w) == (H, W):
+        return 'identity'
+    if C < 2 or _choose_tiles(h, C, H, W,
+                              jnp.dtype(dtype).itemsize) is None:
+        return 'materialize'
+    return 'pallas'
+
+
 def resize_argmax(x: jnp.ndarray, size, align_corners: bool = True,
                   interpret: Optional[bool] = None) -> jnp.ndarray:
     """argmax over channels of the bilinear-resized NHWC `x`, fused.
@@ -103,14 +119,14 @@ def resize_argmax(x: jnp.ndarray, size, align_corners: bool = True,
     """
     B, h, w, C = x.shape
     H, W = _pair(size)
-    if (h, w) == (H, W):
+    path = fused_path(x.shape, size, x.dtype)
+    if path == 'identity':
         return jnp.argmax(x, axis=-1).astype(jnp.int32)
+    if path == 'materialize':
+        return _argmax_ref(x, size, align_corners)
     if interpret is None:
         interpret = jax.devices()[0].platform != 'tpu'
-    tiles = _choose_tiles(h, C, H, W, x.dtype.itemsize)
-    if tiles is None or C < 2:
-        return _argmax_ref(x, size, align_corners)
-    th, tw = tiles
+    th, tw = _choose_tiles(h, C, H, W, x.dtype.itemsize)
     dtype = x.dtype
     exact = dtype == jnp.float32
     prec = 'highest' if exact else None

@@ -109,6 +109,17 @@ def final_upsample(x: jnp.ndarray, size: Size2,
     `__call__` (tests/test_fused_head.py checks every zoo entry: deferred
     output, re-upsampled, must equal the normal output exactly)."""
     if _DEFER_FINAL_UPSAMPLE:
+        if align_corners is not True:
+            # the fused head re-applies the upsample with
+            # align_corners=True unconditionally (ops/fused_head.
+            # resize_argmax default); deferring a non-default flag would
+            # silently change eval semantics, so refuse until the deferral
+            # contract carries the flag
+            raise ValueError(
+                'final_upsample(align_corners=False) cannot be deferred: '
+                'the fused serving head re-applies align_corners=True. '
+                'Disable config.fused_head for this model or extend the '
+                'deferral contract to thread the flag.')
         return x
     return resize_bilinear(x, size, align_corners=align_corners)
 

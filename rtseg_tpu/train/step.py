@@ -34,8 +34,16 @@ from .state import TrainState, ema_update
 
 
 def _shard_map(fn, mesh, in_specs, out_specs):
-    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
-                         out_specs=out_specs, check_vma=False)
+    # jax moved shard_map from jax.experimental (<=0.4.x, check_rep) to the
+    # top level (check_vma); dispatch on what this jax provides so the
+    # compiled steps build on both
+    sm = getattr(jax, 'shard_map', None)
+    if sm is not None:
+        return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=False)
+    from jax.experimental.shard_map import shard_map as sm_experimental
+    return sm_experimental(fn, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, check_rep=False)
 
 
 def _pin_bn_axis(fn: Callable, axis, config=None,
@@ -76,9 +84,11 @@ def _mesh_axes(mesh: Mesh) -> Tuple[str, ...]:
 def _make_apply_train(config, model):
     """Training-mode forward; with config.remat, the forward is
     rematerialized in the backward pass (jax.checkpoint), trading one extra
-    forward of FLOPs for temp HBM (measured ~20% on bisenetv2 @1024^2 —
-    whole-forward granularity, so XLA still materializes residuals during
-    the recompute; see config.remat comment for the bigger levers)."""
+    forward of FLOPs for temp HBM. Whole-forward granularity, so XLA still
+    materializes residuals during the recompute — the targeted
+    detail_remat/hires_remat flags supersede this as batch-unlock levers
+    (BENCHMARKS.md "Generalizing trace-guided remat"); see the config.remat
+    comment for the bigger levers."""
     def apply_train(params, batch_stats, x, rng):
         return model.apply({'params': params, 'batch_stats': batch_stats},
                            x, True, mutable=['batch_stats'],
@@ -314,6 +324,19 @@ def _build_train_step_gspmd(config, model, optimizer, mesh: Mesh,
                                 donate_argnums=(0,)), None, config)
 
 
+def _resolve_fused_head(config, spatial: bool) -> bool:
+    """The one fused-head policy for the eval/predict builders:
+    config.fused_head, with None meaning auto — fused exactly where the
+    Pallas kernel runs natively (TPU; mirrors resize_argmax's interpret
+    auto-detection) — and always off on spatial (GSPMD) meshes, where a
+    Pallas custom call cannot be auto-partitioned over the sharded batch.
+    Resolved at build time and baked into the trace."""
+    fused = getattr(config, 'fused_head', None)
+    if fused is None:
+        fused = jax.devices()[0].platform == 'tpu'
+    return bool(fused) and not spatial
+
+
 def build_eval_step(config, model, mesh: Mesh, use_ema: bool = True
                     ) -> Callable:
     """Returns eval_step(state, images, masks) -> (C, C) confusion matrix,
@@ -342,10 +365,7 @@ def build_eval_step(config, model, mesh: Mesh, use_ema: bool = True
     else:
         cm_fn = confusion_matrix
     spatial = SPATIAL_AXIS in mesh.axis_names
-    fused = getattr(config, 'fused_head', None)
-    if fused is None:           # auto: fused on TPU, materialize elsewhere
-        fused = jax.devices()[0].platform == 'tpu'
-    fused = fused and not spatial
+    fused = _resolve_fused_head(config, spatial)
 
     def forward_cm(state: TrainState, images, masks):
         params = state.ema_params if use_ema else state.params
@@ -390,10 +410,7 @@ def build_predict_step(config, model, mesh: Optional[Mesh] = None) -> Callable:
     from ..parallel.mesh import SPATIAL_AXIS
     compute_dtype = jnp.dtype(config.compute_dtype)
     spatial = mesh is not None and SPATIAL_AXIS in mesh.axis_names
-    fused = getattr(config, 'fused_head', None)
-    if fused is None:
-        fused = jax.devices()[0].platform == 'tpu'
-    fused = fused and not spatial
+    fused = _resolve_fused_head(config, spatial)
 
     @jax.jit
     def step(variables, images):

@@ -31,7 +31,7 @@ from ..config import SegConfig
 from ..data import get_loader, get_test_loader
 from ..models import get_model, get_teacher_model
 from ..parallel import (batch_sharding, init_multihost, main_rank,
-                        make_global_array, make_mesh)
+                        make_global_array, make_mesh, replicated)
 from ..utils import (TBWriter, get_colormap, get_logger, iou_from_cm,
                      log_config, mkdir, save_config, set_seed)
 from .checkpoint import (load_meta, restore_train_ckpt, restore_weights,
@@ -70,9 +70,14 @@ class SegTrainer:
         self.optimizer = get_optimizer(config)
 
         sample = jnp.zeros((1, config.crop_h, config.crop_w, 3), jnp.float32)
-        self.state = create_train_state(
+        # replicate the fresh state on the mesh up front: the compiled
+        # train step returns mesh-replicated state (out_specs P()), so a
+        # single-device initial placement would make step 2's args differ
+        # from step 1's and silently retrace the step (caught by
+        # config.recompile_guard)
+        self.state = self._replicate(create_train_state(
             self.model, self.optimizer,
-            jax.random.PRNGKey(config.random_seed), sample)
+            jax.random.PRNGKey(config.random_seed), sample))
         self._load_pretrained_backbone()
 
         teacher_model, teacher_vars = None, None
@@ -89,6 +94,12 @@ class SegTrainer:
                                            self.mesh, teacher_model,
                                            teacher_vars)
         self.eval_step = build_eval_step(config, self.model, self.mesh)
+        if config.recompile_guard:
+            # fail loudly on any post-warmup retrace of a compiled step
+            # (static-shape promise; see analysis/recompile.py)
+            from ..analysis.recompile import guard_step
+            self.train_step = guard_step(self.train_step, 'train_step')
+            self.eval_step = guard_step(self.eval_step, 'eval_step')
         self._batch_sharding = batch_sharding(self.mesh)
         self.load_ckpt()
 
@@ -112,12 +123,23 @@ class SegTrainer:
                                    params[scope], bstats.get(scope, {}))
         params[scope] = jax.tree.map(jnp.asarray, p)
         bstats[scope] = jax.tree.map(jnp.asarray, b)
+        params, bstats = self._replicate(params), self._replicate(bstats)
         self.state = self.state.replace(
             params=params, batch_stats=bstats,
             ema_params=jax.tree.map(jnp.copy, params),
             ema_batch_stats=jax.tree.map(jnp.copy, bstats))
         self.logger.info(
             f'Imported pretrained backbone from {cfg.backbone_ckpt}')
+
+    def _replicate(self, tree):
+        """Place a (possibly host-numpy) weight tree replicated on the
+        mesh — the sharding the trained state already carries. Checkpoint
+        restores hand back numpy leaves; feeding those straight into a
+        compiled step changes the args' sharding (single-device) and
+        silently retraces it (caught by config.recompile_guard)."""
+        # one pytree-level device_put: batched transfer, no per-leaf
+        # default-device round trip
+        return jax.device_put(tree, replicated(self.mesh))
 
     # ------------------------------------------------------------------ ckpt
     def load_ckpt(self) -> None:
@@ -130,8 +152,9 @@ class SegTrainer:
         meta = load_meta(path) or {}
         if cfg.resume_training and meta.get('kind') == 'train':
             try:
-                self.state, self.cur_epoch, self.best_score = \
+                restored, self.cur_epoch, self.best_score = \
                     restore_train_ckpt(path, self.state)
+                self.state = self._replicate(restored)
             # tree-structure mismatches only — I/O and permission errors
             # propagate unchanged so users don't delete a valid checkpoint
             # on a transient failure
@@ -152,6 +175,7 @@ class SegTrainer:
         else:
             p, bs = restore_weights(path, self.state.params,
                                     self.state.batch_stats)
+            p, bs = self._replicate(p), self._replicate(bs)
             self.state = self.state.replace(
                 params=p, batch_stats=bs,
                 ema_params=jax.tree.map(jnp.copy, p),
@@ -337,7 +361,8 @@ class SegTrainer:
             return self.validate(val_best=True)
         p, bs = restore_weights(best_path, self.state.ema_params,
                                 self.state.ema_batch_stats)
-        self.state = self.state.replace(ema_params=p, ema_batch_stats=bs)
+        self.state = self.state.replace(ema_params=self._replicate(p),
+                                        ema_batch_stats=self._replicate(bs))
         return self.validate(val_best=True)
 
     # --------------------------------------------------------------- predict
@@ -360,6 +385,10 @@ class SegTrainer:
             self.logger.info(f'Loaded weights from {cfg.load_ckpt_path}')
         self.predict_vars = {'params': params, 'batch_stats': batch_stats}
         self.predict_step = build_predict_step(cfg, self.model)
+        if cfg.recompile_guard:
+            from ..analysis.recompile import guard_step
+            self.predict_step = guard_step(self.predict_step,
+                                           'predict_step')
 
     def predict(self) -> None:
         """Reference core/seg_trainer.py:154-191: argmax -> colormap LUT ->

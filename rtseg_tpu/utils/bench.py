@@ -12,8 +12,8 @@ from __future__ import annotations
 
 import time
 
-# Reference RTX-2080 FPS at 1024x512 bs1 (reference README.md:133-203,
-# measured by its tools/test_speed.py).
+# Reference RTX-2080 FPS at 1024x512 bs1 as the reference repo reports
+# them (README.md:133-203, produced by its tools/test_speed.py).
 REFERENCE_FPS = {
     'adscnet': 89, 'aglnet': 61, 'bisenetv1': 88, 'bisenetv2': 142,
     'canet': 76, 'cfpnet': 64, 'cgnet': 157, 'contextnet': 80,

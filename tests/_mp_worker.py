@@ -130,4 +130,13 @@ def train_step_cross_process(mesh, sharding):
 
 
 if __name__ == '__main__':
-    main()
+    try:
+        main()
+    except Exception as e:  # noqa: BLE001 — capability probe, see below
+        # old jaxlib CPU backends cannot run cross-process computations at
+        # all; surface that as a sentinel the test converts to a skip
+        # (any other failure stays a loud non-zero exit)
+        if "aren't implemented on the CPU backend" in str(e):
+            print('MP_UNSUPPORTED_BACKEND', flush=True)
+            raise SystemExit(0)
+        raise

@@ -13,8 +13,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from rtseg_tpu.ops import (final_upsample, resize_argmax, resize_bilinear,
-                           set_defer_final_upsample)
+from rtseg_tpu.ops import (final_upsample, fused_path, resize_argmax,
+                           resize_bilinear, set_defer_final_upsample)
 from rtseg_tpu.ops.fused_head import _choose_tiles
 
 
@@ -51,6 +51,21 @@ def test_fused_matches_ref_random_logits():
     ref = np.asarray(_ref(x, (256, 512)))
     mismatch = (out != ref).mean()
     assert mismatch <= 1e-4, f'near-tie mismatch rate {mismatch:.2e}'
+
+
+def test_fused_matches_ref_random_logits_bf16():
+    # the production eval dtype: bf16 stage-1 einsum + fp32 MXU
+    # accumulation in the kernel vs the all-bf16 materializing path —
+    # near-tie divergence is larger than fp32 (~0.5% on this seed) but
+    # must stay bounded; this pins the dtype eval actually runs
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(2, 32, 64, 19).astype(np.float32)
+                    ).astype(jnp.bfloat16)
+    assert fused_path(x.shape, (256, 512), x.dtype) == 'pallas'
+    out = np.asarray(resize_argmax(x, (256, 512)))
+    ref = np.asarray(_ref(x, (256, 512)))
+    mismatch = (out != ref).mean()
+    assert mismatch <= 8e-3, f'bf16 near-tie mismatch rate {mismatch:.2e}'
 
 
 def test_fused_identity_size_is_plain_argmax():
@@ -148,7 +163,12 @@ def test_zoo_deferral_is_last_op():
 def test_eval_and_predict_steps_fused_matches_materializing():
     """build_eval_step / build_predict_step with fused_head=True produce the
     same confusion matrix / predictions as the materializing path (fp32,
-    well-separated synthetic weights make near-ties measure-zero)."""
+    well-separated synthetic weights make near-ties measure-zero).
+
+    128x128 inputs so the deferred logits' output width tiles (min tile
+    width 128): at the previous 64x64 this silently exercised the
+    materializing fallback inside resize_argmax — asserted via fused_path
+    below so it can never regress to testing the wrong path."""
     import dataclasses
     from jax.sharding import Mesh
     from rtseg_tpu.config import SegConfig
@@ -166,11 +186,24 @@ def test_eval_and_predict_steps_fused_matches_materializing():
     mesh = Mesh(np.array(jax.devices()[:1]), ('data',))
     model = get_model(cfg)
     rng = np.random.RandomState(5)
-    images = jnp.asarray(rng.rand(2, 64, 64, 3).astype(np.float32))
-    masks = jnp.asarray(rng.randint(0, 7, (2, 64, 64)).astype(np.int32))
+    images = jnp.asarray(rng.rand(2, 128, 128, 3).astype(np.float32))
+    masks = jnp.asarray(rng.randint(0, 7, (2, 128, 128)).astype(np.int32))
     optimizer = get_optimizer(cfg)
     state = create_train_state(model, optimizer, jax.random.PRNGKey(0),
-                               jnp.zeros((2, 64, 64, 3), jnp.float32))
+                               jnp.zeros((2, 128, 128, 3), jnp.float32))
+    variables = {'params': state.params, 'batch_stats': state.batch_stats}
+
+    # the fused step must actually drive the Pallas kernel at this shape:
+    # check the path resize_argmax takes for the model's deferred logits
+    try:
+        set_defer_final_upsample(True)
+        low = model.apply(variables, images, False)
+    finally:
+        set_defer_final_upsample(False)
+    assert low.shape[1:3] != (128, 128), 'fastscnn no longer defers?'
+    assert fused_path(low.shape, images.shape[1:3], low.dtype) == 'pallas', \
+        f'deferred logits {low.shape} do not tile — test would silently ' \
+        f'exercise the materializing fallback'
 
     cms, preds = {}, {}
     for fused in (False, True):
@@ -179,10 +212,8 @@ def test_eval_and_predict_steps_fused_matches_materializing():
         assert ev.defer_upsample == fused
         cms[fused] = np.asarray(ev(state, images, masks))
         pr = build_predict_step(c, model, mesh)
-        variables = {'params': state.params,
-                     'batch_stats': state.batch_stats}
         preds[fused] = np.asarray(pr(variables, images))
     np.testing.assert_array_equal(cms[True], cms[False])
     np.testing.assert_array_equal(preds[True], preds[False])
-    assert preds[True].shape == (2, 64, 64)
-    assert cms[True].sum() == 2 * 64 * 64
+    assert preds[True].shape == (2, 128, 128)
+    assert cms[True].sum() == 2 * 128 * 128

@@ -45,6 +45,9 @@ def test_two_process_global_batch_assembly(dev_per_proc):
             p.kill()
         pytest.fail('multi-process workers timed out:\n' +
                     '\n'.join(o or '' for o in outs))
+    if any('MP_UNSUPPORTED_BACKEND' in (o or '') for o in outs):
+        pytest.skip('this jaxlib CPU backend does not implement '
+                    'multi-process computations')
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f'worker {i} failed:\n{out}'
         assert f'MP_WORKER_OK {i}' in out, f'worker {i} output:\n{out}'

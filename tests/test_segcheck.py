@@ -1,0 +1,424 @@
+"""segcheck (rtseg_tpu/analysis): the gate must be green on the real tree,
+and every rule must actually catch a seeded violation — a lint that cannot
+fail its negative test is decoration, not enforcement.
+
+Layout: one positive run of all AST rules on the real repo, one seeded
+violation per rule in a throwaway mini-tree, the eval_shape zoo audit
+(fast subset here; the full 36-model sweep is @slow and is also what
+`python tools/segcheck.py` runs), and the recompile guard (positive +
+forced retrace + trainer integration via config.recompile_guard)."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from rtseg_tpu.analysis import (audit_model, audit_zoo,
+                                check_evidence_citations,
+                                check_import_hygiene,
+                                check_registry_consistency,
+                                check_trace_purity, guard_step,
+                                run_lints, zoo_variants, RecompileError)
+from rtseg_tpu.analysis.core import (ALL_RULES, RULE_EVIDENCE, RULE_IMPORTS,
+                                     RULE_REGISTRY, RULE_TRACE, repo_root)
+
+REPO = repo_root()
+
+
+# --------------------------------------------------------------- mini tree
+def _write(root, relpath, text):
+    path = os.path.join(str(root), relpath)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, 'w') as f:
+        f.write(textwrap.dedent(text))
+
+
+@pytest.fixture
+def mini(tmp_path):
+    """A minimal clean tree every negative test perturbs."""
+    _write(tmp_path, 'rtseg_tpu/models/registry.py', '''
+        MODEL_REGISTRY = {
+            'good': ('good', 'Good'),
+        }
+        ''')
+    _write(tmp_path, 'rtseg_tpu/models/good.py', '''
+        class Good:
+            pass
+        ''')
+    _write(tmp_path, 'BENCHMARKS.md', '''
+        # BENCHMARKS
+        ## Forward (inference), full zoo
+        ''')
+    return tmp_path
+
+
+# ---------------------------------------------------------- positive gate
+def test_real_tree_is_clean():
+    """The committed tree passes every lint rule — the actual CI gate."""
+    findings = run_lints(REPO)
+    assert findings == [], '\n'.join(str(f) for f in findings)
+
+
+def test_unknown_rule_rejected():
+    with pytest.raises(ValueError):
+        run_lints(REPO, rules=['no-such-rule'])
+
+
+# --------------------------------------------------------- import hygiene
+def test_import_hygiene_catches_toplevel_torch(mini):
+    _write(mini, 'rtseg_tpu/bad.py', '''
+        import torch
+
+        def f():
+            return torch.zeros(1)
+        ''')
+    fs = check_import_hygiene(str(mini))
+    assert [f.rule for f in fs] == [RULE_IMPORTS]
+    assert fs[0].path == 'rtseg_tpu/bad.py' and fs[0].line == 2
+
+
+def test_import_hygiene_catches_from_and_guarded_blocks(mini):
+    # module-level try/except and `from torch import` still execute at
+    # import time -> both flagged
+    _write(mini, 'rtseg_tpu/bad2.py', '''
+        try:
+            from torchvision import transforms
+        except ImportError:
+            transforms = None
+        ''')
+    assert len(check_import_hygiene(str(mini))) == 1
+
+
+def test_import_hygiene_allows_function_body_and_bridge(mini):
+    _write(mini, 'rtseg_tpu/ok.py', '''
+        def load(path):
+            import torch
+            return torch.load(path)
+        ''')
+    _write(mini, 'rtseg_tpu/utils/torch_import.py', '''
+        import torch
+        ''')
+    assert check_import_hygiene(str(mini)) == []
+
+
+def test_import_hygiene_suppression(mini):
+    _write(mini, 'rtseg_tpu/sup.py',
+           'import torch  # segcheck: disable=import-hygiene\n')
+    assert check_import_hygiene(str(mini)) == []
+
+
+# ---------------------------------------------------- registry consistency
+def test_registry_clean_mini(mini):
+    assert check_registry_consistency(str(mini)) == []
+
+
+def test_registry_catches_missing_submodule(mini):
+    _write(mini, 'rtseg_tpu/models/registry.py', '''
+        MODEL_REGISTRY = {
+            'good': ('good', 'Good'),
+            'ghost': ('ghost', 'Ghost'),
+        }
+        ''')
+    fs = check_registry_consistency(str(mini))
+    assert len(fs) == 1 and 'missing submodule' in fs[0].message
+
+
+def test_registry_catches_wrong_class(mini):
+    _write(mini, 'rtseg_tpu/models/registry.py', '''
+        MODEL_REGISTRY = {
+            'good': ('good', 'Gooood'),
+        }
+        ''')
+    fs = check_registry_consistency(str(mini))
+    assert len(fs) == 1 and 'not defined' in fs[0].message
+
+
+def test_registry_catches_unregistered_model_file(mini):
+    _write(mini, 'rtseg_tpu/models/orphan.py', '''
+        class Orphan:
+            pass
+        ''')
+    fs = check_registry_consistency(str(mini))
+    assert len(fs) == 1 and 'orphan' in fs[0].message
+
+
+# ------------------------------------------------------------ trace purity
+def test_trace_purity_catches_effects_in_jit(mini):
+    _write(mini, 'rtseg_tpu/ops/noisy.py', '''
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def noisy(x):
+            print('tracing')
+            return x + np.random.rand()
+        ''')
+    fs = check_trace_purity(str(mini))
+    assert {f.line for f in fs} == {7, 8}    # the print and the np.random
+    assert all(f.rule == RULE_TRACE for f in fs)
+
+
+def test_trace_purity_follows_helper_and_closure(mini):
+    # the jit root is a closure passed into jax.jit by a builder, and the
+    # violation lives in a helper it calls — both hops must be followed
+    _write(mini, 'rtseg_tpu/ops/indirect.py', '''
+        import jax
+        import time
+
+        def _helper(x):
+            return x * time.time()
+
+        def build():
+            def step(x):
+                return _helper(x)
+            return jax.jit(step)
+        ''')
+    fs = check_trace_purity(str(mini))
+    assert len(fs) == 1 and 'time.time' in fs[0].message
+
+
+def test_trace_purity_ignores_untraced_code(mini):
+    # module-level prints and functions never handed to jit are host code
+    _write(mini, 'rtseg_tpu/ops/host.py', '''
+        import numpy as np
+
+        print('import-time banner is host code')
+
+        def cli_main():
+            print(np.random.rand())
+        ''')
+    assert check_trace_purity(str(mini)) == []
+
+
+def test_trace_purity_real_step_and_ops_reach_kernels():
+    """On the real tree the analysis must see through the builder pattern:
+    the shard_map'd step closures and the Pallas kernels are reachable
+    (otherwise the rule is vacuously green)."""
+    from rtseg_tpu.analysis.lint_trace import (TARGET_PREFIXES, _index_file)
+    from rtseg_tpu.analysis.core import SourceFile, iter_python_files
+    names = set()
+    refs = set()
+    for rel in iter_python_files(REPO):
+        if not rel.startswith(TARGET_PREFIXES):
+            continue
+        fns, rr = _index_file(SourceFile.load(REPO, rel))
+        names |= {n for n, i in fns.items() if i.is_root}
+        refs |= rr
+    roots = names | refs
+    for expected in ('forward_loss', 'step', '_head_kernel'):
+        assert expected in roots, f'{expected} not recognized as jit root'
+
+
+# ------------------------------------------------------ evidence citations
+def test_evidence_catches_unanchored_claim(mini):
+    _write(mini, 'rtseg_tpu/claims.py', '''
+        # this kernel measured 40% faster than the baseline
+        X = 1
+        ''')
+    fs = check_evidence_citations(str(mini))
+    assert len(fs) == 1 and fs[0].rule == RULE_EVIDENCE and fs[0].line == 2
+
+
+def test_evidence_catches_nonexistent_section(mini):
+    _write(mini, 'rtseg_tpu/claims2.py', '''
+        """Docs citing BENCHMARKS.md "Imaginary Section" for the effect."""
+        ''')
+    fs = check_evidence_citations(str(mini))
+    assert len(fs) == 1 and 'Imaginary Section' in fs[0].message
+
+
+def test_evidence_accepts_real_heading_and_logs(mini):
+    _write(mini, 'evidence_r1.log', 'raw numbers\n')
+    _write(mini, 'rtseg_tpu/ok_claims.py', '''
+        """Measured 2x on v5e (BENCHMARKS.md "Forward (inference)")."""
+
+        # measured again in evidence_r1.log
+        X = 1
+        ''')
+    assert check_evidence_citations(str(mini)) == []
+
+
+def test_evidence_bad_section_line_after_good_one(mini):
+    # the finding must anchor to the FAILING citation's line, not an
+    # earlier valid citation in the same block (suppressions are per-line)
+    _write(mini, 'rtseg_tpu/claims3.py', '''
+        """Multi-citation block.
+
+        Backed: BENCHMARKS.md "Forward (inference)" covers the sweep.
+        Unbacked: BENCHMARKS.md "Ghost Section" covers nothing.
+        """
+        ''')
+    fs = check_evidence_citations(str(mini))
+    assert len(fs) == 1 and 'Ghost Section' in fs[0].message
+    assert fs[0].line == 5
+
+
+def test_evidence_percent_of_step_pattern(mini):
+    _write(mini, 'rtseg_tpu/pct.py', '''
+        # the upsample is 39% of the full-res eval step
+        X = 1
+        ''')
+    fs = check_evidence_citations(str(mini))
+    assert len(fs) == 1
+
+
+def test_evidence_suppression(mini):
+    _write(mini, 'rtseg_tpu/sup2.py', '''
+        # measured 40% faster  # segcheck: disable=evidence-citation
+        X = 1
+        ''')
+    assert check_evidence_citations(str(mini)) == []
+
+
+# --------------------------------------------------------------------- CLI
+def test_cli_lint_only_green_on_real_tree():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, 'tools', 'segcheck.py'),
+         '--lint-only'], capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_exit_code_on_findings(mini):
+    _write(mini, 'rtseg_tpu/bad.py', 'import torch\n')
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, 'tools', 'segcheck.py'),
+         '--lint-only', '--root', str(mini)],
+        capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 1
+    assert 'import-hygiene' in proc.stdout
+
+
+# ------------------------------------------------------- eval_shape audit
+#: fast representative subset for tier-1: the flagship, an aux model, the
+#: detail-head model, and a natively-full-res decoder
+AUDIT_SUBSET = ('fastscnn', 'bisenetv2', 'stdc', 'enet')
+
+
+def test_zoo_audit_subset_passes():
+    report = audit_zoo(model_names=AUDIT_SUBSET, num_class=7,
+                       image_shape=(1, 32, 32, 3))
+    assert [r.label for r in report] == ['fastscnn', 'bisenetv2',
+                                        'bisenetv2+aux', 'stdc',
+                                        'stdc+detail', 'enet']
+    bad = [r for r in report if not r.ok]
+    assert not bad, '\n'.join(str(r) for r in bad)
+
+
+def test_zoo_variants_cover_whole_registry():
+    from rtseg_tpu.models.registry import MODEL_NAMES
+    labels = [label for label, _ in zoo_variants()]
+    assert len(MODEL_NAMES) == 36          # the paper's zoo size
+    for name in MODEL_NAMES:
+        assert name in labels
+    # aux/detail variants included
+    for extra in ('bisenetv2+aux', 'ddrnet+aux', 'icnet+aux',
+                  'stdc+detail'):
+        assert extra in labels
+    assert len(labels) == 40
+
+
+@pytest.mark.slow
+def test_zoo_audit_full_sweep():
+    report = audit_zoo()
+    bad = [r for r in report if not r.ok]
+    assert len(report) == 40
+    assert not bad, '\n'.join(str(r) for r in bad)
+
+
+def test_audit_catches_wrong_output_shape(monkeypatch):
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    class WrongC(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            self.param('w', nn.initializers.zeros, (1,))
+            return jnp.zeros(x.shape[:3] + (5,), jnp.float32)
+
+    import rtseg_tpu.models
+    monkeypatch.setattr(rtseg_tpu.models, 'get_model',
+                        lambda cfg: WrongC())
+    r = audit_model('seeded', {'model': 'fastscnn'}, num_class=19,
+                    image_shape=(1, 32, 32, 3))
+    assert not r.ok and '!=' in r.message
+
+
+def test_audit_catches_wrong_dtype(monkeypatch):
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    class Bf16(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            self.param('w', nn.initializers.zeros, (1,))
+            return jnp.zeros(x.shape[:3] + (19,), jnp.bfloat16)
+
+    import rtseg_tpu.models
+    monkeypatch.setattr(rtseg_tpu.models, 'get_model', lambda cfg: Bf16())
+    r = audit_model('seeded', {'model': 'fastscnn'}, num_class=19,
+                    image_shape=(1, 32, 32, 3))
+    assert not r.ok and 'dtype' in r.message
+
+
+def test_audit_reports_build_failure(monkeypatch):
+    import rtseg_tpu.models
+
+    def boom(cfg):
+        raise RuntimeError('no such arch')
+    monkeypatch.setattr(rtseg_tpu.models, 'get_model', boom)
+    r = audit_model('seeded', {'model': 'fastscnn'})
+    assert not r.ok and 'RuntimeError' in r.message
+
+
+# --------------------------------------------------------- recompile guard
+def test_recompile_guard_allows_steady_state():
+    import jax
+    import jax.numpy as jnp
+    step = jax.jit(lambda x: x * 2)
+    g = guard_step(step, 'steady')
+    for _ in range(5):
+        g(jnp.zeros((2, 4)))
+    assert g.guard.calls == 5
+
+
+def test_recompile_guard_catches_retrace():
+    import jax
+    import jax.numpy as jnp
+    step = jax.jit(lambda x: x * 2)
+    g = guard_step(step, 'drifty')
+    g(jnp.zeros((2, 4)))
+    with pytest.raises(RecompileError, match='drifty retraced'):
+        g(jnp.zeros((3, 4)))       # shape drift -> silent retrace -> loud
+
+
+def test_recompile_guard_mirrors_step_attrs():
+    import jax
+    import jax.numpy as jnp
+    from rtseg_tpu.train.step import _pin_bn_axis
+    wrapped = _pin_bn_axis(jax.jit(lambda x: x + 1), None)
+    g = guard_step(wrapped, 'train_step')
+    assert g.jitted is wrapped.jitted
+    assert g.defer_upsample is wrapped.defer_upsample
+    np.testing.assert_array_equal(np.asarray(g(jnp.ones(2))),
+                                  np.asarray(jnp.ones(2) + 1))
+
+
+def test_trainer_recompile_guard_integration(tmp_path):
+    """config.recompile_guard wires the guard into the trainer's compiled
+    steps, and a static-shape synthetic run never trips it."""
+    from rtseg_tpu.config import SegConfig
+    from rtseg_tpu.train import SegTrainer
+    cfg = SegConfig(dataset='synthetic', model='fastscnn', num_class=5,
+                    crop_size=32, train_bs=1, val_bs=1, total_epoch=1,
+                    val_interval=1, compute_dtype='float32',
+                    save_dir=str(tmp_path / 'save'), use_tb=False,
+                    base_workers=0, synthetic_len=8,
+                    recompile_guard=True)
+    cfg.resolve()
+    trainer = SegTrainer(cfg)
+    trainer.run()
+    assert trainer.train_step.guard.calls > 0
+    assert trainer.eval_step.guard.calls > 0

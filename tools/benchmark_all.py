@@ -1,6 +1,6 @@
 """Multi-model throughput table — the README FPS column, TPU-native
-(reference README.md:133-203 FPS measured via tools/test_speed.py on RTX 2080
-at 1024x512 bs1).
+(the reference reports its FPS in README.md:133-203, produced by its
+tools/test_speed.py on RTX 2080 at 1024x512 bs1).
 
 Forward mode measures jit'd inference imgs/sec/chip; --train measures the
 full compiled train step (forward+loss+backward+optimizer+EMA) on synthetic
@@ -26,7 +26,7 @@ from rtseg_tpu.utils.bench import REFERENCE_FPS, fenced_throughput
 
 DEFAULT_MODELS = 'fastscnn,bisenetv2,ddrnet,stdc,ppliteseg,enet'
 
-# Per-chip bf16 peaks by device kind (public TPU specs). MFU is measured
+# Per-chip bf16 peaks by device kind (public TPU specs). MFU is computed
 # against the bf16 peak of the *detected* device; unknown kinds need
 # --peak-flops or MFU is omitted rather than silently wrong.
 PEAK_BF16_BY_KIND = {
@@ -173,8 +173,8 @@ def bench_eval(name, batch, h, w, queue, trials):
     import jax
     from rtseg_tpu.train.step import build_eval_step
 
-    # use_ema=True so the measured config states what it measures (the EMA
-    # slots mirror params at init either way, but the claim should not
+    # use_ema=True so the benchmarked config states what it exercises (the
+    # EMA slots mirror params at init either way, but the claim should not
     # depend on that invariant)
     cfg, model, _, mesh, state, images, masks = _setup_state(
         name, batch, h, w, use_ema=True)

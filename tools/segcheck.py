@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""segcheck — static analysis + trace audit gate for rtseg_tpu.
+
+Usage:
+    python tools/segcheck.py                 # all lint rules + zoo audit
+    python tools/segcheck.py --lint-only     # AST rules only (no jax)
+    python tools/segcheck.py --rules import-hygiene,evidence-citation
+    python tools/segcheck.py --audit-only    # eval_shape zoo sweep only
+
+Rules (suppress one finding with `# segcheck: disable=<rule>` on its line):
+    import-hygiene        torch/torchvision never import at module scope
+    registry-consistency  models/ files <-> MODEL_REGISTRY, classes exist
+    trace-purity          no print/np.random/time/datetime in jit'd code
+    evidence-citation     measurement claims cite real BENCHMARKS.md
+                          headings or committed logs
+
+Audit: jax.eval_shape sweep of every registry model (aux/detail variants
+included) asserting the [B, H, W, num_class] eval contract — no weights
+materialized, CPU-safe.
+
+Exit codes: 0 clean, 1 findings/audit failures, 2 usage or internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from rtseg_tpu.analysis.core import ALL_RULES, repo_root, run_lints  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog='segcheck', description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument('--root', default=None,
+                    help='repo root (default: auto-detected)')
+    ap.add_argument('--rules', default=None,
+                    help=f'comma-separated rule subset of {ALL_RULES}')
+    ap.add_argument('--lint-only', action='store_true',
+                    help='skip the eval_shape zoo audit (no jax import)')
+    ap.add_argument('--audit-only', action='store_true',
+                    help='run only the eval_shape zoo audit')
+    ap.add_argument('--num-class', type=int, default=19,
+                    help='audit num_class (default 19, Cityscapes)')
+    ap.add_argument('-q', '--quiet', action='store_true',
+                    help='print findings only, no summary')
+    args = ap.parse_args(argv)
+    if args.lint_only and args.audit_only:
+        ap.error('--lint-only and --audit-only are mutually exclusive')
+
+    try:
+        root = args.root or repo_root()
+    except FileNotFoundError as e:
+        print(f'segcheck: {e}', file=sys.stderr)
+        return 2
+
+    failures = 0
+    if not args.audit_only:
+        rules = [r.strip() for r in args.rules.split(',')] \
+            if args.rules else None
+        try:
+            findings = run_lints(root, rules)
+        except ValueError as e:
+            print(f'segcheck: {e}', file=sys.stderr)
+            return 2
+        for f in findings:
+            print(f)
+        failures += len(findings)
+        if not args.quiet:
+            n = len(findings)
+            print(f'segcheck lint: {n} finding(s)'
+                  f' across {len(rules or ALL_RULES)} rule(s)')
+
+    if not args.lint_only:
+        # deferred import: the lint half must work without jax installed.
+        # The audit needs no accelerator (eval_shape is pure tracing), so
+        # default to CPU — and pin it through jax.config too, because the
+        # axon sitecustomize overrides JAX_PLATFORMS at interpreter start
+        # (same counter-override as tests/conftest.py)
+        os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+        import jax
+        try:
+            jax.config.update('jax_platforms', os.environ['JAX_PLATFORMS'])
+        except Exception:
+            pass
+        from rtseg_tpu.analysis.shape_audit import audit_zoo
+        report = audit_zoo(num_class=args.num_class)
+        bad = [r for r in report if not r.ok]
+        for r in bad:
+            print(f'audit: {r}')
+        failures += len(bad)
+        if not args.quiet:
+            print(f'segcheck audit: {len(report) - len(bad)}/{len(report)} '
+                  f'zoo variants pass the shape/dtype contract')
+
+    return 1 if failures else 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
