@@ -1,6 +1,6 @@
 """segcheck — repo-native static analysis + trace audit.
 
-Two halves (see tools/segcheck.py for the CLI):
+Three tiers (see tools/segcheck.py for the CLI):
 
   * AST lint (pure stdlib `ast`, no jax import): import hygiene, registry
     consistency, trace purity, evidence citations.  Each rule is a function
@@ -8,12 +8,20 @@ Two halves (see tools/segcheck.py for the CLI):
   * trace audit (imports jax, still CPU-safe): `jax.eval_shape` sweep over
     the whole model zoo (shape_audit) and the runtime recompile guard
     (recompile) that the trainer hooks behind config.recompile_guard.
+  * deep audit (segaudit, `--deep`): jaxpr/HLO-level analysis of the real
+    compiled step artifacts — buffer donation intent + XLA acceptance
+    (audit_donation), bf16 precision flow through the train-step jaxpr
+    (audit_precision), compiled collective counts gated by the committed
+    SEGAUDIT.json budget (audit_collectives), and loss->param dependence
+    slicing for dead zoo params (audit_params), all built abstractly over
+    step_harness (no weights materialized).
 
 The lint half must stay importable without jax/flax installed — it is the
 cheap CI gate; keep heavyweight imports inside the audit modules.
 """
 
-from .core import Finding, iter_python_files, repo_root, run_lints
+from .core import (ALL_RULES, DEEP_RULES, Finding, iter_python_files,
+                   repo_root, run_lints, suppressed_at)
 from .lint_imports import check_import_hygiene
 from .lint_registry import check_registry_consistency
 from .lint_trace import check_trace_purity
@@ -22,11 +30,27 @@ from .lint_evidence import check_evidence_citations
 # package stays jax-free
 from .recompile import RecompileError, RecompileGuard, guard_step
 from .shape_audit import AuditResult, audit_model, audit_zoo, zoo_variants
+from .step_harness import (StepArtifacts, build_step_artifacts, iter_eqns,
+                           needed_invars)
+from .audit_donation import (audit_donation, check_donation_acceptance,
+                             check_donation_intent)
+from .audit_precision import (audit_train_precision, find_silent_upcasts,
+                              trace_for_precision)
+from .audit_collectives import (audit_collective_budget, compare_counts,
+                                count_collectives)
+from .audit_params import audit_dead_params, dead_param_paths
 
 __all__ = [
+    'ALL_RULES', 'DEEP_RULES',
     'Finding', 'iter_python_files', 'repo_root', 'run_lints',
+    'suppressed_at',
     'check_import_hygiene', 'check_registry_consistency',
     'check_trace_purity', 'check_evidence_citations',
     'RecompileError', 'RecompileGuard', 'guard_step',
     'AuditResult', 'audit_model', 'audit_zoo', 'zoo_variants',
+    'StepArtifacts', 'build_step_artifacts', 'iter_eqns', 'needed_invars',
+    'audit_donation', 'check_donation_acceptance', 'check_donation_intent',
+    'audit_train_precision', 'find_silent_upcasts', 'trace_for_precision',
+    'audit_collective_budget', 'compare_counts', 'count_collectives',
+    'audit_dead_params', 'dead_param_paths',
 ]

@@ -1,0 +1,141 @@
+"""Collective/resharding auditor — gate the data-mesh step's comms.
+
+The data-parallel train step should communicate exactly once per gradient
+leaf (the pmean tree) plus the loss/metric/BN reductions; a resharding
+regression (an annotation change, a new un-sharded intermediate, an op XLA
+decides to all-gather) shows up as extra collectives in the compiled HLO
+long before it shows up in a profile. This audit compiles the data-mesh
+train step AOT from abstract values, counts every collective op in the
+optimized module, and compares against the committed per-step budget in
+SEGAUDIT.json.
+
+Budget semantics (README "Static analysis"): entries are keyed by
+platform + data-mesh size (e.g. "cpu@data=8" — counts are a property of
+the compiled program, so CPU CI numbers are pinned separately from TPU
+numbers). The comparison is exact in both directions: counts above budget
+fail (comms regression), counts below fail too (stale budget — re-run
+`tools/segcheck.py --deep --update-budget` and commit the diff so the
+budget keeps matching reality). A missing key for the current
+platform/mesh is reported once so new environments get pinned on first
+run.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Dict, List, Optional
+
+from .core import Finding, RULE_COLLECTIVES, repo_root
+from .step_harness import (AUDIT_HW, AUDIT_MODEL, AUDIT_NUM_CLASS,
+                           build_step_artifacts)
+
+BUDGET_FILE = 'SEGAUDIT.json'
+
+#: the HLO collective families the budget tracks
+COLLECTIVE_OPS = ('all-reduce', 'all-gather', 'reduce-scatter',
+                  'collective-permute', 'all-to-all')
+
+# opcode use sites look like `f32[4]{0} all-reduce(...` or the async pair
+# `all-reduce-start(...` / `all-reduce-done(...`; count the op once (skip
+# -done), and never count instruction *names* (`%all-reduce.3 = ...`),
+# which are followed by ` = `, not `(`.
+_COLLECTIVE_RE = re.compile(
+    r'\b(' + '|'.join(COLLECTIVE_OPS) + r')(-start|-done)?\(')
+
+
+def count_collectives(hlo_text: str) -> Dict[str, int]:
+    counts = {op: 0 for op in COLLECTIVE_OPS}
+    for m in _COLLECTIVE_RE.finditer(hlo_text):
+        if m.group(2) != '-done':
+            counts[m.group(1)] += 1
+    return counts
+
+
+def budget_key(model_name: str = AUDIT_MODEL) -> str:
+    """Budget entries are per platform + data-mesh size + audited model."""
+    import jax
+    return (f'{jax.devices()[0].platform}'
+            f'@data={len(jax.devices())}:{model_name}')
+
+
+def load_budget(root: Optional[str] = None) -> dict:
+    root = root or repo_root()
+    path = os.path.join(root, BUDGET_FILE)
+    if not os.path.exists(path):
+        return {}
+    with open(path) as f:
+        return json.load(f)
+
+
+def compare_counts(counts: Dict[str, int], budget: Dict[str, int],
+                   label: str) -> List[Finding]:
+    """Exact two-sided comparison of one compile's collective counts
+    against a budget entry."""
+    findings: List[Finding] = []
+    for op in COLLECTIVE_OPS:
+        got = int(counts.get(op, 0))
+        want = int(budget.get(op, 0))
+        if got > want:
+            findings.append(Finding(
+                rule=RULE_COLLECTIVES, path=BUDGET_FILE, line=1,
+                message=(f'{label}: {got} {op} ops in the compiled step '
+                         f'exceed the budget of {want} — a resharding or '
+                         f'collective regression; inspect the HLO before '
+                         f'raising the budget')))
+        elif got < want:
+            findings.append(Finding(
+                rule=RULE_COLLECTIVES, path=BUDGET_FILE, line=1,
+                message=(f'{label}: {got} {op} ops under the budgeted '
+                         f'{want} — the budget is stale; re-run '
+                         f'tools/segcheck.py --deep --update-budget and '
+                         f'commit the SEGAUDIT.json diff')))
+    return findings
+
+
+def audit_collective_budget(root: Optional[str] = None,
+                            compiled_text: Optional[str] = None,
+                            update: bool = False,
+                            model_name: str = AUDIT_MODEL
+                            ) -> List[Finding]:
+    """Compile the data-mesh train step (unless the caller hands in its
+    HLO) and gate its collective counts against SEGAUDIT.json. With
+    `update`, rewrite the current platform/mesh entry instead of failing
+    on mismatch."""
+    root = root or repo_root()
+    if compiled_text is None:
+        art = build_step_artifacts(kind='train', model_name=model_name)
+        compiled_text = art.lower().compile().as_text()
+    counts = count_collectives(compiled_text)
+    key = budget_key(model_name)
+    data = load_budget(root)
+    table = data.setdefault('collective_budget', {})
+    if update:
+        table[key] = {
+            'model': model_name,
+            'batch': 'one image per data shard',
+            'image_hw': list(AUDIT_HW),
+            'num_class': AUDIT_NUM_CLASS,
+            'counts': counts,
+        }
+        with open(os.path.join(root, BUDGET_FILE), 'w') as f:
+            json.dump(data, f, indent=2, sort_keys=True)
+            f.write('\n')
+        return []
+    entry = table.get(key)
+    if entry is None:
+        return [Finding(
+            rule=RULE_COLLECTIVES, path=BUDGET_FILE, line=1,
+            message=(f'no collective budget for {key} (this compile '
+                     f'counted { {k: v for k, v in counts.items() if v} }); '
+                     f'pin it with tools/segcheck.py --deep '
+                     f'--update-budget'))]
+    if entry.get('model') != model_name:
+        return [Finding(
+            rule=RULE_COLLECTIVES, path=BUDGET_FILE, line=1,
+            message=(f'{key}: budget was pinned for model '
+                     f'{entry.get("model")!r} but the audit compiled '
+                     f'{model_name!r}; re-pin with --update-budget'))]
+    return compare_counts(counts, entry.get('counts', {}),
+                          f'train[{model_name}]@{key}')

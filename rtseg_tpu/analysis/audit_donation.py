@@ -1,0 +1,163 @@
+"""Donation auditor — are the step buffers actually reused in place?
+
+The train step updates a ~full-model-sized state pytree every iteration;
+without `donate_argnums` the old and new state coexist in HBM and the
+framework's batch-size headroom story (BENCHMARKS.md) is silently halved.
+The builder contract this audit enforces mechanically:
+
+  * train steps donate the state argument (every leaf marked donated in
+    the lowered program), and XLA accepts the donations — the compiled
+    executable's input_output_alias map aliases (almost) every donated
+    leaf onto an output buffer. A donation XLA rejects is the "buffers
+    were not donated" warning nobody reads, i.e. a step that silently
+    keeps two copies of that leaf resident.
+  * eval and predict steps donate NOTHING: their state is reused by the
+    caller across every validation batch; a donated eval state would be
+    freed under the trainer's feet after one batch.
+
+Intent is read from `Lowered.args_info` (no XLA work); acceptance needs
+the compiled executable, which the collective auditor builds anyway — pass
+its `compiled` in so one XLA compile serves both audits.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Sequence
+
+from .core import Finding, RULE_DONATION
+from .step_harness import StepArtifacts, build_step_artifacts
+
+#: findings anchor at the step-builder module — donation is decided there
+STEP_PATH = 'rtseg_tpu/train/step.py'
+
+# alias-map entries look like `{0}: (17, {}, may-alias)` — output shape
+# index: (param number, param index, kind). The map nests braces, so the
+# header is located by line and entries matched by their specific shape
+# rather than by balancing the outer braces.
+_ALIAS_ENTRY_RE = re.compile(r'\{[\d,\s]*\}:\s*\((\d+),')
+
+
+def _donation_flags(lowered) -> List[List[bool]]:
+    """Per positional argument, the flat list of leaf `donated` flags from
+    the lowered program (jax.stages.ArgInfo)."""
+    import jax
+    info_args, _ = lowered.args_info
+    return [[a.donated for a in jax.tree.leaves(info)]
+            for info in info_args]
+
+
+def aliased_param_indices(compiled_text: str) -> set:
+    """The entry-parameter indices the executable aliases onto outputs
+    (the accepted donations), from the HloModule header."""
+    for line in compiled_text.splitlines():
+        if 'input_output_alias=' in line:
+            section = line.split('input_output_alias=', 1)[1]
+            return {int(e) for e in _ALIAS_ENTRY_RE.findall(section)}
+    return set()
+
+
+def check_donation_intent(art: StepArtifacts,
+                          lowered=None) -> List[Finding]:
+    """Lowering-level check: train steps donate every state leaf, eval and
+    predict steps donate nothing. Cheap (no XLA compile)."""
+    if lowered is None:
+        lowered = art.lower()
+    flags = _donation_flags(lowered)
+    findings: List[Finding] = []
+    for argpos, leaf_flags in enumerate(flags):
+        donated = sum(leaf_flags)
+        if art.kind == 'train':
+            # contract: the state must be fully donated; donating OTHER
+            # train-step args (a fresh batch buffer each call) is a valid
+            # optimization, not a defect — no finding for those
+            if argpos == 0 and donated < len(leaf_flags):
+                findings.append(Finding(
+                    rule=RULE_DONATION, path=STEP_PATH, line=1,
+                    message=(f'{art.label}: only {donated}/'
+                             f'{len(leaf_flags)} state leaves marked '
+                             f'donated — the train-step builder must jit '
+                             f'with donate_argnums=(0,) so the old state '
+                             f'is reused in place')))
+        elif donated:
+            what = ('state' if argpos == 0 else f'argument {argpos}')
+            findings.append(Finding(
+                rule=RULE_DONATION, path=STEP_PATH, line=1,
+                message=(f'{art.label}: {donated} leaf buffer(s) of '
+                         f'{what} marked donated — {art.kind} steps must '
+                         f'not donate: the caller reuses these arrays '
+                         f'across batches (donation frees them after one '
+                         f'call)')))
+    return findings
+
+
+def check_donation_acceptance(art: StepArtifacts,
+                              compiled_text: str,
+                              max_rejected: Optional[int] = None
+                              ) -> List[Finding]:
+    """Executable-level check: XLA's input_output_alias map covers the
+    donated state leaves. Aliased entries are *counted* rather than
+    matched by parameter index — jit prunes unused arguments from the
+    entry computation (keep_unused=False), which renumbers parameters, and
+    only donated buffers can appear in a jit program's alias map, so the
+    count is the robust accounting. `max_rejected` tolerates XLA declining
+    a handful of leaves for layout reasons (observed: single BN-stat EMA
+    leaves); default max(2, 1% of leaves)."""
+    n = art.n_state_leaves
+    if max_rejected is None:
+        max_rejected = max(2, n // 100)
+    accepted = len(aliased_param_indices(compiled_text))
+    rejected = max(0, n - accepted)
+    if rejected > max_rejected:
+        return [Finding(
+            rule=RULE_DONATION, path=STEP_PATH, line=1,
+            message=(f'{art.label}: XLA aliased only {accepted}/{n} '
+                     f'donated state leaves into outputs '
+                     f'({rejected} rejected > tolerance {max_rejected}) — '
+                     f'the step keeps extra state copies resident; look '
+                     f'for output leaves whose shape/dtype stopped '
+                     f'matching the input state'))]
+    return []
+
+
+def audit_donation(model_name: Optional[str] = None,
+                   kinds: Sequence[str] = ('train', 'eval', 'predict'),
+                   spatial: bool = True,
+                   compiled_text: Optional[str] = None,
+                   train_artifact: Optional[StepArtifacts] = None,
+                   train_lowered=None) -> List[Finding]:
+    """Donation audit across the step builders and mesh modes — the ONE
+    home of the audited builder matrix (the CLI gate and the tests both
+    call this; keep policy changes here).
+
+    Lowers each builder abstractly and checks donation intent; when the
+    caller hands in `compiled_text` (the collective auditor's compiled
+    train-step HLO), also checks XLA's acceptance on the data-mesh train
+    step. A caller that already built/lowered the data-mesh train step
+    passes `train_artifact`/`train_lowered` so it isn't rebuilt. A
+    spatial (GSPMD) train/eval pair is audited when the process has >= 2
+    devices."""
+    import jax
+    from .step_harness import AUDIT_MODEL
+    model_name = model_name or AUDIT_MODEL
+    findings: List[Finding] = []
+    train_art = None
+    for kind in kinds:
+        if kind == 'train' and train_artifact is not None:
+            train_art = train_artifact
+            findings.extend(check_donation_intent(train_artifact,
+                                                  train_lowered))
+            continue
+        art = build_step_artifacts(kind=kind, model_name=model_name)
+        if kind == 'train':
+            train_art = art
+        findings.extend(check_donation_intent(art))
+    if spatial and len(jax.devices()) >= 2:
+        for kind in [k for k in kinds if k != 'predict']:
+            art = build_step_artifacts(kind=kind, model_name=model_name,
+                                       spatial_partition=2)
+            findings.extend(check_donation_intent(art))
+    if compiled_text is not None and train_art is not None:
+        findings.extend(
+            check_donation_acceptance(train_art, compiled_text))
+    return findings

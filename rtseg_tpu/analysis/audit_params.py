@@ -1,0 +1,126 @@
+"""Dead-parameter detector — every zoo param must influence the loss.
+
+A ported architecture can build cleanly, pass the eval_shape contract, and
+still be mis-wired: a branch whose output never reaches the head, an aux
+classifier constructed but dropped, a param consumed only by dead code.
+Such a param trains to noise, silently bloats the checkpoint/EMA/optimizer
+state, and — worst — means the architecture is not the one the paper
+benchmarked.
+
+Detection is structural, with no weights materialized: trace the model's
+prediction outputs abstractly (`jax.make_jaxpr` on ShapeDtypeStructs, the
+eval_shape discipline of shape_audit), then take a backward dependence
+slice from the outputs over the jaxpr (step_harness.needed_invars —
+precise through pjit/remat/custom_* call bodies). Any param leaf whose
+jaxpr input the slice never reaches is reported by its pytree path.
+
+Train-mode tracing is used for aux/detail variants so their extra heads
+count as reachable outputs, mirroring what the train step optimizes.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from .core import Finding, RULE_DEAD_PARAM
+from .shape_audit import zoo_variants
+from .step_harness import needed_invars
+
+
+def dead_param_paths(model, variables, image_shape: Tuple[int, ...],
+                     train: bool = False,
+                     detail_head: bool = False) -> List[str]:
+    """Pytree paths (keystr) of param leaves with no dataflow route to any
+    model output. `variables` may be abstract (ShapeDtypeStructs).
+
+    With `detail_head`, the model's `detail_targets` method (the stop-grad
+    detail ground-truth conv the train step applies separately,
+    train/step.py _make_forward_loss) counts as an output too — its params
+    influence the loss value even though no gradient flows to them."""
+    import jax
+    import jax.numpy as jnp
+    from ..nn import set_bn_axis
+    from ..ops import set_defer_final_upsample
+
+    # this trace runs bare model.apply outside any shard_map: clear the
+    # trace-time globals a previously built step may have pinned (same
+    # hygiene as tests/conftest.py _reset_trace_globals)
+    set_bn_axis(None)
+    set_defer_final_upsample(False)
+
+    params = variables['params']
+    batch_stats = variables.get('batch_stats', {})
+    rng = jax.random.PRNGKey(0)
+
+    def outputs_sum(p, bs, x):
+        if train:
+            out, _ = model.apply({'params': p, 'batch_stats': bs}, x,
+                                 True, mutable=['batch_stats'],
+                                 rngs={'dropout': rng})
+        else:
+            out = model.apply({'params': p, 'batch_stats': bs}, x, False)
+        # reduce every head to one scalar so the slice sees all outputs
+        total = sum(jnp.sum(leaf.astype(jnp.float32))
+                    for leaf in jax.tree.leaves(out))
+        if detail_head:
+            # the detail GT path: pyramid has the laplacian_pyramid output
+            # shape (B, H, W, 3), same as the image input here
+            dgt = model.apply({'params': p}, x, method='detail_targets')
+            total = total + jnp.sum(dgt.astype(jnp.float32))
+        return total
+
+    x = jax.ShapeDtypeStruct(image_shape, jnp.float32)
+    closed = jax.make_jaxpr(outputs_sum)(params, batch_stats, x)
+    leaves, _ = jax.tree_util.tree_flatten_with_path(params)
+    n_params = len(leaves)
+    # invars order == flattened (params, batch_stats, x)
+    param_invars = closed.jaxpr.invars[:n_params]
+    needed = needed_invars(closed.jaxpr)
+    return [jax.tree_util.keystr(leaves[i][0])
+            for i, v in enumerate(param_invars) if v not in needed]
+
+
+def audit_dead_params(model_names: Optional[Sequence[str]] = None,
+                      num_class: int = 7,
+                      image_shape: Tuple[int, ...] = (1, 64, 64, 3)
+                      ) -> List[Finding]:
+    """Sweep zoo variants (same coverage as the eval_shape audit: every
+    registry model plus its declared aux/detail variants) for params that
+    never influence the outputs."""
+    from ..config import SegConfig
+    from ..models import get_model
+    from ..models.registry import MODEL_REGISTRY
+
+    findings: List[Finding] = []
+    for label, overrides in zoo_variants(model_names):
+        name = overrides['model']
+        submodule = MODEL_REGISTRY.get(name, (name,))[0]
+        model_path = f'rtseg_tpu/models/{submodule}.py'
+        cfg = SegConfig(dataset='synthetic', num_class=num_class,
+                        compute_dtype='float32',
+                        save_dir='/tmp/rtseg_segaudit', **overrides)
+        cfg.resolve(num_devices=1)
+        train = bool(cfg.use_aux or cfg.use_detail_head)
+        try:
+            import jax
+            model = get_model(cfg)
+            variables = jax.eval_shape(
+                lambda r, xx: model.init(r, xx, False),
+                jax.random.PRNGKey(0),
+                jax.ShapeDtypeStruct(image_shape, jax.numpy.float32))
+            dead = dead_param_paths(model, variables, image_shape,
+                                    train=train,
+                                    detail_head=bool(cfg.use_detail_head))
+        except Exception as e:   # noqa: BLE001 — report, don't kill the sweep
+            findings.append(Finding(
+                rule=RULE_DEAD_PARAM, path=model_path, line=1,
+                message=f'{label}: dependence trace failed: '
+                        f'{type(e).__name__}: {e}'))
+            continue
+        for path in dead:
+            findings.append(Finding(
+                rule=RULE_DEAD_PARAM, path=model_path, line=1,
+                message=(f'{label}: param {path} has no dataflow route to '
+                         f'any model output — it trains to noise and '
+                         f'bloats state; wire it in or delete it')))
+    return findings

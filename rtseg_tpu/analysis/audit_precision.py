@@ -1,0 +1,147 @@
+"""Precision-flow analyzer — the bf16 hot path stays bf16.
+
+The framework's AMP replacement is structural: inputs are cast to
+config.compute_dtype once, the whole forward/backward runs in bf16, and
+fp32 appears only at sanctioned islands — loss accumulation
+(losses/losses.py log-softmax), BN statistics (nn/modules.py), pooling
+accumulation (ops/pool.py), and the optimizer/EMA update in fp32 master
+params (train/step.py, train/state.py). A stray `astype(jnp.float32)` in a
+model file silently doubles that tensor's MXU and HBM cost and never shows
+up in tests, because the math still matches.
+
+This audit walks the train-step jaxpr (every equation, recursing through
+the shard_map/pjit bodies), finds leaf ops that widen narrow floats to
+f32 — explicit `convert_element_type` AND convert-free widenings such as
+a dot/conv with preferred_element_type=f32 — and attributes each to the
+innermost user stack frame jax recorded for it. Widenings attributed to the allow-listed modules (or to
+library internals — flax's own promotion discipline) pass; anything else —
+above all, a model file — is a finding at the exact file:line, suppressible
+like any AST rule with `# segcheck: disable=precision-flow`.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+from .core import Finding, RULE_PRECISION, repo_root, suppressed_at
+from .step_harness import build_step_artifacts, iter_eqns, user_frames
+
+#: repo locations sanctioned to widen bf16 -> f32: loss accumulation,
+#: BN statistics, pooling/resize accumulation, and the fp32 optimizer/EMA
+#: islands in the step itself. Callers auditing other surfaces (e.g. the
+#: eval step's confusion-matrix assembly in utils/metrics.py) pass their
+#: own `allowed` instead of widening this default.
+ALLOWED_UPCAST_PREFIXES: Tuple[str, ...] = (
+    'rtseg_tpu/losses/',
+    'rtseg_tpu/nn/',
+    'rtseg_tpu/ops/',
+    'rtseg_tpu/train/',
+)
+
+_WIDE = {'float32', 'float64'}
+_NARROW = {'bfloat16', 'float16'}
+
+
+def _widens(eqn):
+    """(narrow_dtype, wide_dtype) if this leaf equation takes narrow-float
+    input and produces wide-float output, else None. Catches explicit
+    `convert_element_type` AND convert-free widenings — a dot/conv with
+    preferred_element_type=f32, or any op whose output aval is silently
+    wider than its float operands."""
+    narrow = next((str(v.aval.dtype) for v in eqn.invars
+                   if hasattr(v, 'aval')
+                   and str(getattr(v.aval, 'dtype', '')) in _NARROW), None)
+    if narrow is None:
+        return None
+    wide = next((str(v.aval.dtype) for v in eqn.outvars
+                 if hasattr(v, 'aval')
+                 and str(getattr(v.aval, 'dtype', '')) in _WIDE), None)
+    if wide is None:
+        return None
+    return narrow, wide
+
+
+def _attribute(frames) -> Tuple[Optional[str], int, str]:
+    """(repo-relative path or None-for-library, line, function) of the
+    innermost frame; None path means no user frame at all (compiler-
+    synthesized code, e.g. transpose residuals)."""
+    if not frames:
+        return None, 0, ''
+    f = frames[0]
+    fn = f.file_name.replace(os.sep, '/')
+    if '/rtseg_tpu/' in fn or fn.startswith('rtseg_tpu/'):
+        rel = 'rtseg_tpu/' + fn.split('rtseg_tpu/', 1)[1]
+        return rel, int(f.start_line), f.function_name
+    return fn, int(f.start_line), f.function_name
+
+
+def _is_library(path: str) -> bool:
+    """Frames inside installed packages (flax/jax promotion discipline)
+    rather than this repo or the caller's own files."""
+    return 'site-packages' in path or 'dist-packages' in path
+
+
+def find_silent_upcasts(closed_jaxpr, label: str,
+                        root: Optional[str] = None,
+                        allowed: Sequence[str] = ALLOWED_UPCAST_PREFIXES
+                        ) -> List[Finding]:
+    """All narrow-float -> wide-float converts in `closed_jaxpr` (and its
+    sub-jaxprs) not attributed to an allow-listed location."""
+    from .step_harness import subjaxprs
+    root = root or repo_root()
+    findings: List[Finding] = []
+    seen = set()
+    for eqn in iter_eqns(closed_jaxpr.jaxpr):
+        if subjaxprs(eqn):
+            # call/loop eqns legitimately carry bf16 in / f32 out (the
+            # loss); their bodies are walked eqn-by-eqn by iter_eqns
+            continue
+        widened = _widens(eqn)
+        if widened is None:
+            continue
+        src, dst = widened
+        path, line, func = _attribute(user_frames(eqn))
+        if path is None or _is_library(path):
+            continue
+        if any(path.startswith(p) for p in allowed):
+            continue
+        key = (path, line)
+        if key in seen:          # one finding per source line, not per op
+            continue
+        seen.add(key)
+        if path.startswith('rtseg_tpu/') and \
+                suppressed_at(root, path, line, RULE_PRECISION):
+            continue
+        findings.append(Finding(
+            rule=RULE_PRECISION, path=path, line=line,
+            message=(f'{label}: silent {src} -> {dst} upcast '
+                     f'({eqn.primitive.name}) in {func}() — the bf16 hot '
+                     f'path must stay bf16; move the widening into an '
+                     f'allow-listed island (losses/, nn/, ops/, train/) '
+                     f'or suppress with segcheck: '
+                     f'disable={RULE_PRECISION}')))
+    return findings
+
+
+def trace_for_precision(fn: Callable, *args: Any):
+    """make_jaxpr on abstract args — shared by the audit and its tests."""
+    import jax
+    return jax.make_jaxpr(fn)(*args)
+
+
+def audit_train_precision(model_name: Optional[str] = None,
+                          root: Optional[str] = None,
+                          artifact=None,
+                          **artifact_kwargs) -> List[Finding]:
+    """Trace the full data-mesh train step (forward, backward, optimizer,
+    EMA — the whole compiled program) abstractly and report silent
+    upcasts. Seconds of CPU; no XLA compile. A caller that already built
+    the step passes `artifact` so it isn't rebuilt."""
+    from .step_harness import AUDIT_MODEL
+    model_name = model_name or AUDIT_MODEL
+    art = artifact if artifact is not None else build_step_artifacts(
+        kind='train', model_name=model_name, **artifact_kwargs)
+    art.step.pin()
+    closed = trace_for_precision(art.step.jitted, *art.args)
+    return find_silent_upcasts(closed, f'train[{model_name}]', root=root)

@@ -25,6 +25,16 @@ RULE_TRACE = 'trace-purity'
 RULE_EVIDENCE = 'evidence-citation'
 ALL_RULES = (RULE_IMPORTS, RULE_REGISTRY, RULE_TRACE, RULE_EVIDENCE)
 
+#: deep (jaxpr/HLO-level) rule identifiers — the segaudit family. These
+#: trace and compile the real step artifacts instead of walking source
+#: text, so they live behind `tools/segcheck.py --deep` and import jax.
+RULE_DONATION = 'donation'
+RULE_PRECISION = 'precision-flow'
+RULE_COLLECTIVES = 'collective-budget'
+RULE_DEAD_PARAM = 'dead-param'
+DEEP_RULES = (RULE_DONATION, RULE_PRECISION, RULE_COLLECTIVES,
+              RULE_DEAD_PARAM)
+
 _SUPPRESS_RE = re.compile(r'#\s*segcheck:\s*disable=([\w,\- ]+)')
 
 
@@ -102,6 +112,26 @@ class SourceFile:
             return None
         return Finding(rule=rule, path=self.relpath, line=line,
                        message=message)
+
+
+def suppressed_at(root: str, relpath: str, line: int, rule: str) -> bool:
+    """Whether `# segcheck: disable=<rule>` suppresses `rule` on one line
+    of a repo file. Deep rules attribute findings to real source lines, so
+    they honor the same suppression comments as the AST rules; unreadable
+    or out-of-tree paths simply don't suppress."""
+    path = os.path.join(root, relpath)
+    try:
+        with tokenize.open(path) as f:
+            lines = f.read().splitlines()
+    except (OSError, SyntaxError, UnicodeDecodeError):
+        return False
+    if not 1 <= line <= len(lines):
+        return False
+    m = _SUPPRESS_RE.search(lines[line - 1])
+    if not m:
+        return False
+    rules = {r.strip() for r in m.group(1).split(',') if r.strip()}
+    return 'all' in rules or rule in rules
 
 
 def load_tree(root: str, subdirs: Sequence[str] = ('rtseg_tpu', 'tools')

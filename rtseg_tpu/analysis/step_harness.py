@@ -1,0 +1,208 @@
+"""Abstract step artifacts for the deep (jaxpr/HLO) audits.
+
+The segaudit analyzers inspect what the compiler actually builds — donation
+aliasing, dtype flow, SPMD collectives — so they need real step closures
+from the real builders, but never real weights: the train state is built
+with `jax.eval_shape` (TrainState of ShapeDtypeStructs) and the steps are
+lowered/compiled AOT from those abstract values. Building the flagship
+audit artifact costs seconds of CPU tracing; only `.compile()` (needed for
+the collective counts and the input_output_alias map) costs real XLA time.
+
+Also home to the small jaxpr-walking utilities the precision-flow and
+dead-parameter analyzers share (recursing into pjit/remat/custom_* bodies).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Iterator, List, Optional, Tuple
+
+#: the default deep-audit subject: the fastest model in the zoo, so the
+#: audit artifact is the cheapest train step that still exercises the full
+#: state pytree (params + BN stats + optax + EMA)
+AUDIT_MODEL = 'fastscnn'
+AUDIT_NUM_CLASS = 7
+AUDIT_HW = (32, 32)
+
+
+@dataclass
+class StepArtifacts:
+    """One builder's abstract compile surface."""
+    label: str            # e.g. 'train@data=8', 'eval@data=4x spatial=2'
+    kind: str             # 'train' | 'eval' | 'predict'
+    config: Any
+    model: Any
+    mesh: Any
+    step: Any             # the _pin_bn_axis wrapper (step.jitted is the jit)
+    args: Tuple[Any, ...]  # abstract ShapeDtypeStruct args for lower()
+    n_state_leaves: int   # leaves of the donatable state arg (0 for predict)
+
+    def lower(self):
+        """AOT-lower the step on the abstract args (pins trace globals
+        first, per the _pin_bn_axis contract). Cheap: no XLA involved."""
+        self.step.pin()
+        return self.step.jitted.lower(*self.args)
+
+
+def mesh_label(mesh) -> str:
+    return ' '.join(f'{name}={size}'
+                    for name, size in zip(mesh.axis_names,
+                                          mesh.devices.shape))
+
+
+def build_step_artifacts(kind: str = 'train',
+                         model_name: str = AUDIT_MODEL,
+                         num_devices: Optional[int] = None,
+                         spatial_partition: int = 1,
+                         batch: Optional[int] = None,
+                         hw: Tuple[int, int] = AUDIT_HW,
+                         num_class: int = AUDIT_NUM_CLASS,
+                         **config_overrides) -> StepArtifacts:
+    """Build one step builder's output plus abstract args, weights never
+    materialized. `kind` is 'train', 'eval' or 'predict'; a
+    spatial_partition > 1 selects the GSPMD builders."""
+    import jax
+    import jax.numpy as jnp
+    from ..config import SegConfig
+    from ..models import get_model
+    from ..models.registry import AUX_MODELS, DETAIL_HEAD_MODELS
+    from ..parallel.mesh import make_mesh
+    from ..train.optim import get_optimizer
+    from ..train.state import create_train_state
+    from ..train.step import (build_eval_step, build_predict_step,
+                              build_train_step)
+
+    if num_devices is None:
+        num_devices = len(jax.devices())
+    overrides = dict(
+        use_aux=model_name in AUX_MODELS,
+        use_detail_head=model_name in DETAIL_HEAD_MODELS,
+        use_ema=True, loss_type='ohem')
+    overrides.update(config_overrides)
+    cfg = SegConfig(dataset='synthetic', model=model_name,
+                    num_class=num_class, compute_dtype='bfloat16',
+                    train_bs=batch or num_devices,
+                    save_dir='/tmp/rtseg_segaudit', **overrides)
+    cfg.resolve(num_devices=num_devices)
+    cfg.resolve_schedule(train_num=max(cfg.train_bs, 1) * 1000)
+    model = get_model(cfg)
+    opt = get_optimizer(cfg)
+    mesh = make_mesh(num_devices=num_devices,
+                     spatial_partition=spatial_partition)
+
+    h, w = hw
+    if batch is None:
+        batch = mesh.devices.size      # one image per shard
+    x1 = jax.ShapeDtypeStruct((1, h, w, 3), jnp.float32)
+    images = jax.ShapeDtypeStruct((batch, h, w, 3), jnp.float32)
+    masks = jax.ShapeDtypeStruct((batch, h, w), jnp.int32)
+    rng = jax.random.PRNGKey(0)
+
+    if kind == 'predict':
+        variables = jax.eval_shape(
+            lambda r, xx: model.init(r, xx, False), rng, x1)
+        step = build_predict_step(cfg, model, mesh)
+        args = (variables, images)
+        n_state = 0
+    else:
+        state = jax.eval_shape(
+            lambda r, xx: create_train_state(model, opt, r, xx), rng, x1)
+        n_state = len(jax.tree.leaves(state))
+        if kind == 'train':
+            step = build_train_step(cfg, model, opt, mesh)
+        elif kind == 'eval':
+            step = build_eval_step(cfg, model, mesh)
+        else:
+            raise ValueError(f'unknown step kind {kind!r}')
+        args = (state, images, masks)
+    return StepArtifacts(label=f'{kind}[{model_name}]@{mesh_label(mesh)}',
+                         kind=kind, config=cfg, model=model, mesh=mesh,
+                         step=step, args=args, n_state_leaves=n_state)
+
+
+# --------------------------------------------------------- jaxpr utilities
+def iter_eqns(jaxpr) -> Iterator:
+    """All equations of `jaxpr`, recursing into sub-jaxprs carried in eqn
+    params (pjit bodies, shard_map, remat, custom_jvp/vjp, scan, ...)."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for sub in subjaxprs(eqn):
+            yield from iter_eqns(sub)
+
+
+def subjaxprs(eqn) -> List:
+    """The open jaxprs nested inside one equation's params."""
+    out = []
+    for v in eqn.params.values():
+        for item in (v if isinstance(v, (list, tuple)) else (v,)):
+            # ClosedJaxpr first: it forwards .eqns, so the order matters
+            if hasattr(item, 'jaxpr') and hasattr(item.jaxpr, 'invars'):
+                out.append(item.jaxpr)
+            elif hasattr(item, 'eqns') and hasattr(item, 'invars'):
+                out.append(item)
+    return out
+
+
+def _is_var(v) -> bool:
+    # Literals carry no dataflow; everything else in invars is a Var
+    return not type(v).__name__.endswith('Literal')
+
+
+#: primitives whose single sub-jaxpr's invars/outvars map 1:1 onto the
+#: equation's own — the only ones the dependence slice recurses into
+#: precisely. Loop/branch primitives (scan, while, cond) can have
+#: coincidentally matching arities while permuting dataflow across
+#: iterations (scan's carry), so they always take the conservative path.
+_CALL_PRIMITIVES = frozenset((
+    'pjit', 'closed_call', 'core_call', 'remat', 'checkpoint',
+    'remat_call', 'custom_jvp_call', 'custom_vjp_call',
+    'custom_jvp_call_jaxpr', 'custom_vjp_call_jaxpr', 'shard_map',
+))
+
+
+def needed_invars(jaxpr) -> set:
+    """Backward dependence slice: the set of `jaxpr.invars` that can
+    influence any of its outvars.
+
+    Call-like equations (pjit, closed_call, remat, custom_jvp/vjp,
+    shard_map) whose single sub-jaxpr maps 1:1 onto the eqn's
+    invars/outvars are sliced precisely — a value flowing *into* such a
+    call but unused *inside* it stays dead. Everything else — above all
+    scan/while/cond, whose arities can match while the carry permutes
+    dataflow across iterations — takes the conservative rule: if any
+    output is needed, every input is."""
+    return needed_invars_for(jaxpr, set(jaxpr.outvars))
+
+
+def needed_invars_for(jaxpr, needed_out: set) -> set:
+    """needed_invars restricted to a subset of the jaxpr's outvars."""
+    needed = {v for v in needed_out if _is_var(v)}
+    for eqn in reversed(jaxpr.eqns):
+        if not any(v in needed for v in eqn.outvars):
+            continue
+        subs = subjaxprs(eqn)
+        inner = subs[0] if len(subs) == 1 else None
+        if (eqn.primitive.name in _CALL_PRIMITIVES
+                and inner is not None
+                and len(inner.invars) == len(eqn.invars)
+                and len(inner.outvars) == len(eqn.outvars)):
+            inner_needed = needed_invars_for(
+                inner, {inner.outvars[i] for i, v in enumerate(eqn.outvars)
+                        if v in needed})
+            needed |= {eqn.invars[i]
+                       for i in range(len(eqn.invars))
+                       if inner.invars[i] in inner_needed
+                       and _is_var(eqn.invars[i])}
+        else:
+            needed |= {v for v in eqn.invars if _is_var(v)}
+    return {v for v in jaxpr.invars if v in needed}
+
+
+def user_frames(eqn) -> List:
+    """Best-effort user stack frames for one equation (innermost first);
+    empty when jax's source-info introspection moved."""
+    try:
+        from jax._src import source_info_util
+        return list(source_info_util.user_frames(eqn.source_info))
+    except Exception:   # noqa: BLE001 — introspection must degrade, not crash
+        return []

@@ -98,45 +98,27 @@ def _make_apply_train(config, model):
     return apply_train
 
 
-def build_train_step(config, model, optimizer, mesh: Mesh,
-                     teacher_model=None, teacher_variables=None) -> Callable:
-    """Returns step(state, images, masks) -> (state, metrics_dict).
-
-    images: [global_B, H, W, 3] fp32/bf16, masks: [global_B, H, W] int32,
-    both sharded over the mesh batch axes; state is replicated.
-
-    Two compilation strategies:
-      * data-only mesh -> shard_map with explicit lax.pmean collectives
-        (per-shard control, BN axis_name sync).
-      * mesh with a 'spatial' axis -> GSPMD (jit + sharding annotations):
-        convolutions over the sharded H dimension need halo exchange, which
-        XLA's spatial partitioner inserts automatically — shard_map would
-        silently compute wrong boundaries. BN statistics and gradients are
-        global reductions under GSPMD, so sync-BN/grad-allreduce come for
-        free.
-    """
-    from ..parallel.mesh import SPATIAL_AXIS
-    if SPATIAL_AXIS in mesh.axis_names:
-        return _build_train_step_gspmd(config, model, optimizer, mesh,
-                                       teacher_model, teacher_variables)
+def _make_forward_loss(config, model, apply_train, base_rng,
+                       axes: Tuple[str, ...] = (),
+                       teacher_model=None, teacher_variables=None
+                       ) -> Callable:
+    """The one loss-assembly hot path both train-step builders compile:
+    cast to compute dtype, forward (plain / aux-head / detail-head), loss
+    terms, optional KD. `axes` names the shard_map mesh axes the dropout rng
+    is folded over (per-shard torch Dropout semantics); the GSPMD builder
+    passes () — under GSPMD there is no per-shard rng, XLA partitions one
+    global program. Keeping this a single shared closure is what lets the
+    precision-flow audit (analysis/audit_precision.py) certify one bf16
+    path for every mesh mode."""
     loss_fn = get_loss_fn(config)
     detail_loss_fn = get_detail_loss_fn(config)
     kd_fn = get_kd_loss_fn(config)
-    axes = _mesh_axes(mesh)
     compute_dtype = jnp.dtype(config.compute_dtype)
-    total_itrs = max(int(config.total_itrs), 1)
     aux_coef = config.aux_coef
-
-    # cross-replica BN statistics (reference SyncBatchNorm conversion,
-    # utils/parallel.py:36-37) — collective baked into the BN modules.
-    bn_axis = axes if config.sync_bn else None
-
-    base_rng = jax.random.PRNGKey(config.random_seed + 1)
-    apply_train = _make_apply_train(config, model)
 
     def forward_loss(params, batch_stats, images, masks, step):
         x = images.astype(compute_dtype)
-        # per-step, per-shard dropout rng (torch Dropout semantics)
+        # per-step (and per-shard, when axes bind) dropout rng
         rng = jax.random.fold_in(base_rng, step)
         for ax in axes:
             rng = jax.random.fold_in(rng, lax.axis_index(ax))
@@ -183,6 +165,42 @@ def build_train_step(config, model, optimizer, mesh: Mesh,
             loss = loss + config.kd_loss_coefficient * loss_kd
 
         return loss, (mutated.get('batch_stats', batch_stats), metrics)
+
+    return forward_loss
+
+
+def build_train_step(config, model, optimizer, mesh: Mesh,
+                     teacher_model=None, teacher_variables=None) -> Callable:
+    """Returns step(state, images, masks) -> (state, metrics_dict).
+
+    images: [global_B, H, W, 3] fp32/bf16, masks: [global_B, H, W] int32,
+    both sharded over the mesh batch axes; state is replicated.
+
+    Two compilation strategies:
+      * data-only mesh -> shard_map with explicit lax.pmean collectives
+        (per-shard control, BN axis_name sync).
+      * mesh with a 'spatial' axis -> GSPMD (jit + sharding annotations):
+        convolutions over the sharded H dimension need halo exchange, which
+        XLA's spatial partitioner inserts automatically — shard_map would
+        silently compute wrong boundaries. BN statistics and gradients are
+        global reductions under GSPMD, so sync-BN/grad-allreduce come for
+        free.
+    """
+    from ..parallel.mesh import SPATIAL_AXIS
+    if SPATIAL_AXIS in mesh.axis_names:
+        return _build_train_step_gspmd(config, model, optimizer, mesh,
+                                       teacher_model, teacher_variables)
+    axes = _mesh_axes(mesh)
+    total_itrs = max(int(config.total_itrs), 1)
+
+    # cross-replica BN statistics (reference SyncBatchNorm conversion,
+    # utils/parallel.py:36-37) — collective baked into the BN modules.
+    bn_axis = axes if config.sync_bn else None
+
+    base_rng = jax.random.PRNGKey(config.random_seed + 1)
+    apply_train = _make_apply_train(config, model)
+    forward_loss = _make_forward_loss(config, model, apply_train, base_rng,
+                                      axes, teacher_model, teacher_variables)
 
     def step(state: TrainState, images, masks):
         grad_fn = jax.value_and_grad(forward_loss, has_aux=True)
@@ -242,52 +260,12 @@ def _build_train_step_gspmd(config, model, optimizer, mesh: Mesh,
     from jax.sharding import NamedSharding
     from ..parallel import batch_sharding, replicated
 
-    loss_fn = get_loss_fn(config)
-    detail_loss_fn = get_detail_loss_fn(config)
-    kd_fn = get_kd_loss_fn(config)
-    compute_dtype = jnp.dtype(config.compute_dtype)
     total_itrs = max(int(config.total_itrs), 1)
-    aux_coef = config.aux_coef
     base_rng = jax.random.PRNGKey(config.random_seed + 1)
     apply_train = _make_apply_train(config, model)
-
-    def forward_loss(params, batch_stats, images, masks, step):
-        x = images.astype(compute_dtype)
-        rng = jax.random.fold_in(base_rng, step)
-        out, mutated = apply_train(params, batch_stats, x, rng)
-        metrics = {}
-        if config.use_aux:
-            preds, preds_aux = out
-            loss = loss_fn(preds, masks)
-            coefs = aux_coef if aux_coef is not None \
-                else (1.0,) * len(preds_aux)
-            m4 = masks[..., None].astype(jnp.float32)
-            for coef, pa in zip(coefs, preds_aux):
-                ms = resize_nearest(m4, pa.shape[1:3])[..., 0]
-                loss = loss + coef * loss_fn(pa, ms.astype(jnp.int32))
-        elif config.use_detail_head:
-            preds, preds_detail = out
-            loss = loss_fn(preds, masks)
-            pyr = laplacian_pyramid(masks)
-            dgt = model.apply(
-                {'params': jax.lax.stop_gradient(params)}, pyr,
-                method='detail_targets')
-            dgt = (dgt > config.detail_thrs).astype(jnp.float32)
-            pd = resize_bilinear(preds_detail, dgt.shape[1:3],
-                                 align_corners=True)
-            loss_detail = detail_loss_fn(pd.astype(jnp.float32), dgt)
-            metrics['loss_detail'] = loss_detail
-            loss = loss + config.detail_loss_coef * loss_detail
-        else:
-            preds = out
-            loss = loss_fn(preds, masks)
-        if config.kd_training:
-            t_out = teacher_model.apply(teacher_variables, x, False)
-            t_out = jax.lax.stop_gradient(t_out)
-            loss_kd = kd_fn(preds, t_out)
-            metrics['loss_kd'] = loss_kd
-            loss = loss + config.kd_loss_coefficient * loss_kd
-        return loss, (mutated.get('batch_stats', batch_stats), metrics)
+    # axes=(): no per-shard rng fold under GSPMD (one global program)
+    forward_loss = _make_forward_loss(config, model, apply_train, base_rng,
+                                      (), teacher_model, teacher_variables)
 
     def step(state: TrainState, images, masks):
         grad_fn = jax.value_and_grad(forward_loss, has_aux=True)

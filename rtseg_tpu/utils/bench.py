@@ -29,11 +29,26 @@ REFERENCE_FPS = {
 
 def fenced_throughput(call, readback, items_per_call: int,
                       queue: int = 20, trials: int = 3,
-                      warmup: int = 3) -> float:
+                      warmup: int = 3, guard_jitted=None,
+                      guard_name: str = 'bench') -> float:
     """Best items/sec over `trials` blocks of `queue` queued `call()`s, each
-    block fenced by `readback(out)` pulling a scalar from the last result."""
+    block fenced by `readback(out)` pulling a scalar from the last result.
+
+    `guard_jitted` (the jit object behind `call`, e.g. `step.jitted`) arms
+    the recompile guard for the timed region: the jit cache is baselined
+    after warmup and any growth during a timed block raises RecompileError
+    instead of publishing a number that paid for an XLA retrace. AOT
+    callers (compiled executables) keep a 0-entry cache, so the guard also
+    catches a future edit that silently reroutes timing through the traced
+    wrapper with drifting shapes."""
+    guard = None
+    if guard_jitted is not None:
+        from ..analysis.recompile import RecompileGuard
+        guard = RecompileGuard(guard_name, warmup=1)
     for _ in range(warmup):
         readback(call())
+    if guard is not None:
+        guard.after_call(guard_jitted)      # baseline post-warmup
     best = 0.0
     for _ in range(trials):
         t0 = time.perf_counter()
@@ -42,4 +57,6 @@ def fenced_throughput(call, readback, items_per_call: int,
             out = call()
         readback(out)
         best = max(best, items_per_call * queue / (time.perf_counter() - t0))
+        if guard is not None:
+            guard.after_call(guard_jitted)  # raise if this block retraced
     return best

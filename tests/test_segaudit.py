@@ -1,0 +1,328 @@
+"""segaudit (the --deep analyzer family): positive gates on the real tree
+plus one seeded violation per analyzer — an analyzer that cannot fail its
+negative test is decoration, not enforcement (the test_segcheck.py creed,
+one level down the stack: these rules read jaxprs and compiled HLO, not
+source text).
+
+Tier-1 runs the cheap surfaces: donation *intent* (AOT lowering only),
+precision flow and dead-param dependence (abstract jaxpr walks), and toy
+compiles for the alias-map/collective machinery. The real-tree XLA compile
+of the flagship train step (donation acceptance + the committed
+SEGAUDIT.json collective budget) and the full-zoo dead-param sweep are
+@deep @slow — CI covers them through `python tools/segcheck.py --deep`.
+"""
+
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rtseg_tpu.analysis import (audit_dead_params, audit_donation,
+                                check_donation_acceptance,
+                                check_donation_intent, compare_counts,
+                                count_collectives, dead_param_paths,
+                                find_silent_upcasts, trace_for_precision)
+from rtseg_tpu.analysis.audit_collectives import (audit_collective_budget,
+                                                  budget_key, load_budget)
+from rtseg_tpu.analysis.audit_donation import aliased_param_indices
+from rtseg_tpu.analysis.core import (RULE_COLLECTIVES, RULE_DEAD_PARAM,
+                                     RULE_DONATION, RULE_PRECISION,
+                                     repo_root)
+from rtseg_tpu.analysis.step_harness import (build_step_artifacts,
+                                             needed_invars)
+
+REPO = repo_root()
+
+
+def _toy_state():
+    return {'w': jnp.zeros((4, 4)), 'b': jnp.zeros((4,))}
+
+
+class _FakeArt:
+    """Duck-typed StepArtifacts for seeded donation violations."""
+
+    def __init__(self, step, args, kind, n_state_leaves, label):
+        self.step = step
+        self.args = args
+        self.kind = kind
+        self.n_state_leaves = n_state_leaves
+        self.label = label
+
+    def lower(self):
+        self.step.pin()
+        return self.step.jitted.lower(*self.args)
+
+
+def _fake_art(jitted, args, kind, label):
+    from rtseg_tpu.train.step import _pin_bn_axis
+    wrapper = _pin_bn_axis(jitted, None)
+    return _FakeArt(wrapper, args,
+                    kind, len(jax.tree.leaves(args[0])), label)
+
+
+# ------------------------------------------------------- donation: seeded
+def test_donation_catches_undonated_train_state():
+    def step(state, x):
+        return jax.tree.map(lambda w: w + x.sum(), state), x.mean()
+
+    art = _fake_art(jax.jit(step),                 # no donate_argnums
+                    (_toy_state(), jnp.ones((4,))), 'train', 'seeded-train')
+    fs = check_donation_intent(art)
+    assert len(fs) == 1 and fs[0].rule == RULE_DONATION
+    assert 'only 0/2 state leaves' in fs[0].message
+
+
+def test_donation_catches_donating_eval_step():
+    def eval_step(state, x):
+        return (state['w'] * x).sum()
+
+    art = _fake_art(jax.jit(eval_step, donate_argnums=(0,)),
+                    (_toy_state(), jnp.ones((4,))), 'eval', 'seeded-eval')
+    fs = check_donation_intent(art)
+    assert len(fs) == 1 and 'must not donate' in fs[0].message
+
+
+def test_donation_catches_xla_rejected_donation():
+    # state['b'] has no same-shape output to alias onto -> XLA drops that
+    # donation; with tolerance 0 the acceptance check must say so
+    def step(state, x):
+        return {'w': state['w'] + x}, x.sum()
+
+    art = _fake_art(jax.jit(step, donate_argnums=(0,)),
+                    (_toy_state(), jnp.ones((4, 4))), 'train',
+                    'seeded-reject')
+    compiled_text = art.lower().compile().as_text()
+    fs = check_donation_acceptance(art, compiled_text, max_rejected=0)
+    assert len(fs) == 1 and 'rejected > tolerance' in fs[0].message
+    # and the accepted donation is visible in the alias map
+    assert aliased_param_indices(compiled_text) == {0}
+
+
+def test_donation_accepts_fully_aliased_toy_step():
+    def step(state, x):
+        return jax.tree.map(lambda w: w * 2.0, state), x.sum()
+
+    art = _fake_art(jax.jit(step, donate_argnums=(0,)),
+                    (_toy_state(), jnp.ones((4,))), 'train', 'seeded-ok')
+    lowered = art.lower()
+    assert check_donation_intent(art, lowered) == []
+    assert check_donation_acceptance(art, lowered.compile().as_text(),
+                                     max_rejected=0) == []
+
+
+# ------------------------------------------------- donation: real builders
+@pytest.fixture(scope='module')
+def train_artifact():
+    """One abstract flagship train-step build shared by the real-tree
+    positive gates (donation intent + precision flow)."""
+    return build_step_artifacts(kind='train')
+
+
+def test_donation_intent_real_step_builders(train_artifact):
+    """Positive gate: train donates the full state, eval/predict donate
+    nothing, on the real data-mesh builders (lowering only — no XLA
+    compile). The spatial/GSPMD builder pair is @deep below; CI also
+    covers it via `segcheck --deep`."""
+    fs = check_donation_intent(train_artifact)
+    for kind in ('eval', 'predict'):
+        fs += check_donation_intent(build_step_artifacts(kind=kind))
+    assert fs == [], '\n'.join(str(f) for f in fs)
+
+
+@pytest.mark.deep
+@pytest.mark.slow
+def test_donation_intent_spatial_builders():
+    fs = audit_donation()          # full matrix incl. the GSPMD pair
+    assert fs == [], '\n'.join(str(f) for f in fs)
+
+
+# ------------------------------------------------------- precision: seeded
+def test_precision_catches_injected_upcast():
+    def hot(x):
+        y = x.astype(jnp.bfloat16) * 2.0
+        z = y.astype(jnp.float32)          # the silent upcast
+        return z.sum()
+
+    closed = trace_for_precision(hot,
+                                 jax.ShapeDtypeStruct((8,), jnp.float32))
+    fs = find_silent_upcasts(closed, 'seeded')
+    assert len(fs) == 1 and fs[0].rule == RULE_PRECISION
+    assert fs[0].path.endswith('test_segaudit.py')
+    assert 'hot()' in fs[0].message
+
+
+def test_precision_allows_loss_island():
+    # an upcast attributed to rtseg_tpu/losses/ is a sanctioned island
+    from rtseg_tpu.losses.losses import cross_entropy
+
+    def hot(x, masks):
+        logits = x.astype(jnp.bfloat16)
+        return cross_entropy(logits, masks)
+
+    closed = trace_for_precision(
+        hot, jax.ShapeDtypeStruct((2, 8, 8, 5), jnp.float32),
+        jax.ShapeDtypeStruct((2, 8, 8), jnp.int32))
+    assert find_silent_upcasts(closed, 'island') == []
+
+
+def test_precision_real_train_step(train_artifact):
+    """Positive gate: the full flagship train-step jaxpr (forward, loss,
+    backward, optimizer, EMA) has no silent upcasts outside the islands."""
+    train_artifact.step.pin()
+    closed = trace_for_precision(train_artifact.step.jitted,
+                                 *train_artifact.args)
+    fs = find_silent_upcasts(closed, 'train[fastscnn]', root=REPO)
+    assert fs == [], '\n'.join(str(f) for f in fs)
+
+
+# ----------------------------------------------------- collectives: seeded
+def test_collective_counts_from_compiled_pmean():
+    mesh_devices = jax.devices()
+    if len(mesh_devices) < 2:
+        pytest.skip('needs >= 2 (virtual) devices')
+    from jax.sharding import Mesh, PartitionSpec as P
+    from rtseg_tpu.train.step import _shard_map
+    mesh = Mesh(np.array(mesh_devices[:2]), ('data',))
+
+    def fn(x):
+        return jax.lax.pmean(x.sum(), 'data')
+
+    sharded = jax.jit(_shard_map(fn, mesh, in_specs=(P('data'),),
+                                 out_specs=P()))
+    text = sharded.lower(
+        jax.ShapeDtypeStruct((2, 4), jnp.float32)).compile().as_text()
+    counts = count_collectives(text)
+    assert counts['all-reduce'] >= 1
+
+    # seeded budget violation: a budget of zero all-reduces must fail loud
+    fs = compare_counts(counts, {op: 0 for op in counts}, 'seeded')
+    assert any(f.rule == RULE_COLLECTIVES and 'exceed' in f.message
+               for f in fs)
+    # and a stale (over-generous) budget fails the other direction
+    fat = {op: n + 3 for op, n in counts.items()}
+    fs = compare_counts(counts, fat, 'seeded')
+    assert fs and all('stale' in f.message for f in fs)
+
+
+def test_collective_count_ignores_done_and_names():
+    text = ('%all-reduce.3 = f32[4]{0} all-reduce-start(f32[4]{0} %p), '
+            'replica_groups={}\n'
+            '%r = f32[4]{0} all-reduce-done(f32[4]{0} %all-reduce.3)\n'
+            '%g = f32[8]{0} all-gather(f32[4]{0} %q), dimensions={0}\n')
+    counts = count_collectives(text)
+    assert counts['all-reduce'] == 1       # start counted once, done never
+    assert counts['all-gather'] == 1
+
+
+def test_committed_budget_exists_for_ci_mesh():
+    """SEGAUDIT.json carries the entry `python tools/segcheck.py --deep`
+    gates on in CI (cpu, 8 virtual devices, flagship model)."""
+    data = load_budget(REPO)
+    table = data.get('collective_budget', {})
+    if len(jax.devices()) != 8 or jax.devices()[0].platform != 'cpu':
+        pytest.skip('budget is pinned for the 8-device virtual CPU mesh')
+    entry = table.get(budget_key())
+    assert entry is not None, (f'missing {budget_key()} in SEGAUDIT.json; '
+                               f'run tools/segcheck.py --deep '
+                               f'--update-budget')
+    assert entry['model'] == 'fastscnn'
+    assert entry['counts']['all-reduce'] > 0
+
+
+# ------------------------------------------------------- dead-param: seeded
+def test_dead_param_catches_disconnected_param():
+    import flax.linen as nn
+
+    class DeadNet(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=False):
+            w = self.param('w', nn.initializers.ones, (3, 5))
+            self.param('orphan', nn.initializers.ones, (7,))
+            return x @ w
+
+    model = DeadNet()
+    variables = jax.eval_shape(
+        lambda r, xx: model.init(r, xx, False), jax.random.PRNGKey(0),
+        jax.ShapeDtypeStruct((2, 4, 4, 3), jnp.float32))
+    dead = dead_param_paths(model, variables, (2, 4, 4, 3))
+    assert dead == ["['orphan']"]
+
+
+def test_dead_param_slice_is_precise_through_pjit():
+    # a value flowing INTO a jitted call but unused INSIDE it stays dead
+    def inner(a, b):
+        return a * 2.0
+
+    def outer(a, b):
+        return jax.jit(inner)(a, b).sum()
+
+    closed = jax.make_jaxpr(outer)(jnp.ones((3,)), jnp.ones((3,)))
+    needed = needed_invars(closed.jaxpr)
+    flags = [v in needed for v in closed.jaxpr.invars]
+    assert flags == [True, False]
+
+
+def test_dead_param_slice_conservative_through_scan():
+    # scan's carry permutes dataflow across iterations while its arities
+    # can coincidentally match its body jaxpr 1:1 — the slice must take
+    # the conservative branch (everything live), never report the truly
+    # live carry input dead
+    def f(x, p):
+        def body(carry, _):
+            a, b = carry
+            return (b, a), None
+        (a, _b), _ = jax.lax.scan(body, (x, p), None, length=2)
+        return a.sum()
+
+    closed = jax.make_jaxpr(f)(jnp.ones((3,)), jnp.ones((3,)))
+    needed = needed_invars(closed.jaxpr)
+    flags = [v in needed for v in closed.jaxpr.invars]
+    assert flags == [True, True]
+
+
+def test_dead_param_subset_clean():
+    """Positive gate: representative zoo subset (flagship, aux, detail,
+    full-res decoder — the detail entry also proves the stop-grad
+    detail_targets path counts as live) has no dead params. 32x32 keeps
+    tier-1 cheap; the full zoo at the audit default 64x64 is @deep."""
+    fs = audit_dead_params(
+        model_names=['fastscnn', 'bisenetv2', 'stdc', 'enet'],
+        image_shape=(1, 32, 32, 3))
+    assert fs == [], '\n'.join(str(f) for f in fs)
+
+
+# ------------------------------------------------------------- deep sweeps
+@pytest.mark.deep
+@pytest.mark.slow
+def test_dead_param_full_zoo():
+    fs = audit_dead_params()
+    assert fs == [], '\n'.join(str(f) for f in fs)
+
+
+@pytest.mark.deep
+@pytest.mark.slow
+def test_real_train_step_compile_gate():
+    """One XLA compile of the flagship data-mesh train step feeds both
+    executable-level checks: XLA accepts the state donation, and the
+    collective counts equal the committed SEGAUDIT.json budget."""
+    if len(jax.devices()) != 8 or jax.devices()[0].platform != 'cpu':
+        pytest.skip('budget is pinned for the 8-device virtual CPU mesh')
+    art = build_step_artifacts(kind='train')
+    text = art.lower().compile().as_text()
+    fs = check_donation_acceptance(art, text)
+    fs += audit_collective_budget(root=REPO, compiled_text=text)
+    assert fs == [], '\n'.join(str(f) for f in fs)
+
+
+@pytest.mark.deep
+@pytest.mark.slow
+def test_cli_deep_green_on_real_tree():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, 'tools', 'segcheck.py'),
+         '--deep'], capture_output=True, text=True, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert 'segcheck deep: 0 finding(s)' in proc.stdout

@@ -6,7 +6,9 @@ Forward mode measures jit'd inference imgs/sec/chip; --train measures the
 full compiled train step (forward+loss+backward+optimizer+EMA) on synthetic
 data. Dispatch through the axon tunnel is fenced the same way as bench.py:
 calls are queued in blocks and completion is forced by a device-side scalar
-readback.
+readback. Every timed region is armed with the recompile guard
+(rtseg_tpu/analysis/recompile.py via fenced_throughput): a benchmark number
+can never come from a block that silently paid for an XLA retrace.
 
     python tools/benchmark_all.py --models fastscnn,bisenetv2,ddrnet
     python tools/benchmark_all.py --train --models bisenetv2
@@ -127,7 +129,9 @@ def bench_forward(name, batch, h, w, queue, trials):
     compiled = fwd.lower(variables, images).compile()
     flops = _compiled_flops(compiled)
     ips = fenced_throughput(lambda: compiled(variables, images), float,
-                            batch, queue=queue, trials=trials)
+                            batch, queue=queue, trials=trials,
+                            guard_jitted=fwd,
+                            guard_name=f'{name} forward bench')
     return ips, flops / batch
 
 
@@ -184,7 +188,9 @@ def bench_eval(name, batch, h, w, queue, trials):
         jax.device_get(state), images, masks).compile()
     flops = _compiled_flops(compiled)
     ips = fenced_throughput(lambda: compiled(state, images, masks)[0, 0],
-                            float, batch, queue=queue, trials=trials)
+                            float, batch, queue=queue, trials=trials,
+                            guard_jitted=eval_step.jitted,
+                            guard_name=f'{name} eval bench')
     return ips, flops / batch
 
 
@@ -212,7 +218,8 @@ def bench_train(name, batch, h, w, queue, trials):
         return metrics['loss']
 
     ips = fenced_throughput(call, float, batch, queue=queue, trials=trials,
-                            warmup=1)
+                            warmup=1, guard_jitted=step.jitted,
+                            guard_name=f'{name} train bench')
     return ips, flops / batch
 
 

@@ -12,7 +12,9 @@ records in each op's metadata (jax source-info -> HLO op_name).
 
 Writes the trace under --trace-dir (default /tmp, NOT the repo: binary
 traces stay out of git per the round-3 advisor note) and prints a
-module-share table.
+module-share table. The traced region is armed with the recompile guard
+(rtseg_tpu/analysis/recompile.py): a profile whose iterations secretly
+retraced raises instead of attributing compile time to model modules.
 """
 
 import argparse
@@ -63,28 +65,39 @@ def capture(model_name, batch, h, w, trace_dir, iters, hires_remat=False,
     images = jax.device_put(rng.rand(batch, h, w, 3).astype(np.float32))
     masks = jax.device_put(
         rng.randint(0, 19, (batch, h, w)).astype(np.int32))
+    from rtseg_tpu.analysis.recompile import RecompileGuard
+
+    # arm the recompile guard around the traced region: a profile whose
+    # iterations secretly retraced would attribute XLA compile time to
+    # model modules (same invariant as tools/benchmark_all.py timing)
     if eval_mode:
         step = build_eval_step(cfg, model, mesh)
         step.pin()
+        guard = RecompileGuard(f'{model_name} eval profile', warmup=1)
         compiled = step.jitted.lower(
             jax.device_get(state), images, masks).compile()
         cm = compiled(state, images, masks)
         jax.block_until_ready(cm)
+        guard.after_call(step.jitted)              # baseline post-warmup
         with jax.profiler.trace(trace_dir):
             for _ in range(iters):
                 cm = compiled(state, images, masks)
             jax.block_until_ready(cm)
+        guard.after_call(step.jitted)              # raise if trace retraced
         return float(np.asarray(cm).sum())
     step = build_train_step(cfg, model, opt, mesh)
     step.pin()
+    guard = RecompileGuard(f'{model_name} train profile', warmup=1)
     compiled = step.jitted.lower(
         jax.device_get(state), images, masks).compile()
     state, _ = compiled(state, images, masks)      # warmup / compile check
     jax.block_until_ready(state)
+    guard.after_call(step.jitted)                  # baseline post-warmup
     with jax.profiler.trace(trace_dir):
         for _ in range(iters):
             state, metrics = compiled(state, images, masks)
         jax.block_until_ready(state)
+    guard.after_call(step.jitted)                  # raise if trace retraced
     return float(np.asarray(metrics['loss']))
 
 

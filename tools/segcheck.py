@@ -6,6 +6,8 @@ Usage:
     python tools/segcheck.py --lint-only     # AST rules only (no jax)
     python tools/segcheck.py --rules import-hygiene,evidence-citation
     python tools/segcheck.py --audit-only    # eval_shape zoo sweep only
+    python tools/segcheck.py --deep          # + jaxpr/HLO deep audits
+    python tools/segcheck.py --deep --update-budget   # re-pin SEGAUDIT.json
 
 Rules (suppress one finding with `# segcheck: disable=<rule>` on its line):
     import-hygiene        torch/torchvision never import at module scope
@@ -17,6 +19,17 @@ Rules (suppress one finding with `# segcheck: disable=<rule>` on its line):
 Audit: jax.eval_shape sweep of every registry model (aux/detail variants
 included) asserting the [B, H, W, num_class] eval contract — no weights
 materialized, CPU-safe.
+
+Deep audit (--deep, the segaudit family): traces/compiles the real step
+artifacts abstractly and checks
+    donation              train steps donate the state (and XLA accepts);
+                          eval/predict steps donate nothing
+    precision-flow        no silent bf16->f32 upcasts outside the
+                          sanctioned islands (losses/nn/ops/train)
+    collective-budget     compiled data-mesh train-step collective counts
+                          == the committed SEGAUDIT.json budget
+    dead-param            every param influences the model outputs
+                          (--deep-zoo sweeps all registry models)
 
 Exit codes: 0 clean, 1 findings/audit failures, 2 usage or internal error.
 """
@@ -46,11 +59,27 @@ def main(argv=None) -> int:
                     help='run only the eval_shape zoo audit')
     ap.add_argument('--num-class', type=int, default=19,
                     help='audit num_class (default 19, Cityscapes)')
+    ap.add_argument('--deep', action='store_true',
+                    help='run the jaxpr/HLO deep audits (donation, '
+                         'precision-flow, collective-budget, dead-param)')
+    ap.add_argument('--deep-models', default='fastscnn',
+                    help='comma-separated models for the deep audits '
+                         '(default: fastscnn, the flagship artifact)')
+    ap.add_argument('--deep-zoo', action='store_true',
+                    help='extend the dead-param audit to every registry '
+                         'model (minutes of CPU tracing)')
+    ap.add_argument('--update-budget', action='store_true',
+                    help='rewrite SEGAUDIT.json with the measured '
+                         'collective counts instead of gating on them')
     ap.add_argument('-q', '--quiet', action='store_true',
                     help='print findings only, no summary')
     args = ap.parse_args(argv)
     if args.lint_only and args.audit_only:
         ap.error('--lint-only and --audit-only are mutually exclusive')
+    if args.lint_only and args.deep:
+        ap.error('--lint-only and --deep are mutually exclusive')
+    if args.update_budget and not args.deep:
+        ap.error('--update-budget requires --deep')
 
     try:
         root = args.root or repo_root()
@@ -82,6 +111,15 @@ def main(argv=None) -> int:
         # axon sitecustomize overrides JAX_PLATFORMS at interpreter start
         # (same counter-override as tests/conftest.py)
         os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+        if args.deep:
+            # the collective audit needs a real data mesh: force the
+            # 8-device virtual CPU platform (same strategy as
+            # tests/conftest.py) before any backend initializes
+            flags = os.environ.get('XLA_FLAGS', '')
+            if '--xla_force_host_platform_device_count' not in flags:
+                os.environ['XLA_FLAGS'] = (
+                    flags + ' --xla_force_host_platform_device_count=8'
+                ).strip()
         import jax
         try:
             jax.config.update('jax_platforms', os.environ['JAX_PLATFORMS'])
@@ -96,6 +134,41 @@ def main(argv=None) -> int:
         if not args.quiet:
             print(f'segcheck audit: {len(report) - len(bad)}/{len(report)} '
                   f'zoo variants pass the shape/dtype contract')
+
+    if args.deep:
+        from rtseg_tpu.analysis import (audit_collective_budget,
+                                        audit_dead_params, audit_donation,
+                                        audit_train_precision)
+        from rtseg_tpu.analysis.step_harness import build_step_artifacts
+        models = [m.strip() for m in args.deep_models.split(',')
+                  if m.strip()]
+        deep_findings = []
+        for name in models:
+            # ONE build + abstract lowering of the data-mesh train step
+            # feeds donation intent, the precision trace, and (via one XLA
+            # compile) donation acceptance + the collective budget; the
+            # audited builder/mesh matrix itself lives in audit_donation
+            art = build_step_artifacts(kind='train', model_name=name)
+            lowered = art.lower()
+            compiled_text = lowered.compile().as_text()
+            deep_findings += audit_donation(
+                model_name=name, compiled_text=compiled_text,
+                train_artifact=art, train_lowered=lowered)
+            deep_findings += audit_train_precision(model_name=name,
+                                                   root=root, artifact=art)
+            deep_findings += audit_collective_budget(
+                root=root, compiled_text=compiled_text,
+                update=args.update_budget, model_name=name)
+        deep_findings += audit_dead_params(
+            model_names=None if args.deep_zoo else models)
+        for f in deep_findings:
+            print(f)
+        failures += len(deep_findings)
+        if not args.quiet:
+            scope = 'full zoo' if args.deep_zoo else ','.join(models)
+            print(f'segcheck deep: {len(deep_findings)} finding(s) '
+                  f'(donation, precision-flow, collective-budget, '
+                  f'dead-param; {scope})')
 
     return 1 if failures else 0
 
