@@ -26,6 +26,7 @@ from .lint_imports import check_import_hygiene
 from .lint_registry import check_registry_consistency
 from .lint_trace import check_trace_purity
 from .lint_evidence import check_evidence_citations
+from .lint_obs import check_obs_purity
 # audit modules defer their jax imports to call time, so importing the
 # package stays jax-free
 from .recompile import RecompileError, RecompileGuard, guard_step
@@ -45,7 +46,7 @@ __all__ = [
     'Finding', 'iter_python_files', 'repo_root', 'run_lints',
     'suppressed_at',
     'check_import_hygiene', 'check_registry_consistency',
-    'check_trace_purity', 'check_evidence_citations',
+    'check_trace_purity', 'check_evidence_citations', 'check_obs_purity',
     'RecompileError', 'RecompileGuard', 'guard_step',
     'AuditResult', 'audit_model', 'audit_zoo', 'zoo_variants',
     'StepArtifacts', 'build_step_artifacts', 'iter_eqns', 'needed_invars',

@@ -1,0 +1,80 @@
+"""Rule `obs-purity`: host-side segscope APIs stay out of jit-traced code.
+
+The obs/ layer (spans, event sinks, heartbeats) reads wall clocks, takes
+locks and writes files — all host effects. Inside a function jax traces,
+an `obs.span(...)` does not time the step: it fires once at trace time,
+records the duration of *tracing*, and then never runs again (or runs
+again on every silent retrace, corrupting the telemetry it was meant to
+produce). Telemetry belongs in the host loop — the trainer, the loader,
+the bench harness — never in train/step.py or ops/ kernels.
+
+Scope and reachability are shared with trace-purity (lint_trace.py): the
+rule walks every function reachable from a jit entry point under the same
+TARGET_PREFIXES and flags calls that resolve to the rtseg_tpu.obs module —
+through a module alias (`from rtseg_tpu import obs`, `import
+rtseg_tpu.obs as obs`), a member import (`from ..obs import span`), or a
+fully qualified `rtseg_tpu.obs.*` path.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from .core import Finding, RULE_OBS, SourceFile
+from .lint_trace import _dotted, jit_reachable, target_files
+
+
+def _obs_bindings(sf: SourceFile) -> Tuple[Set[str], Set[str]]:
+    """(module aliases bound to rtseg_tpu.obs, member names imported from
+    it) for one file."""
+    aliases: Set[str] = set()
+    members: Set[str] = set()
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == 'rtseg_tpu.obs' and a.asname:
+                    aliases.add(a.asname)
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ''
+            is_obs = (mod == 'rtseg_tpu.obs'
+                      or (node.level > 0
+                          and (mod == 'obs' or mod.endswith('.obs'))))
+            if is_obs:
+                members |= {a.asname or a.name for a in node.names}
+            elif mod == 'rtseg_tpu' or (node.level > 0 and not mod):
+                for a in node.names:
+                    if a.name == 'obs':
+                        aliases.add(a.asname or 'obs')
+    return aliases, members
+
+
+def check_obs_purity(root: str, files=None) -> List[Finding]:
+    files = target_files(root, files)
+    bindings: Dict[int, Tuple[Set[str], Set[str]]] = {}
+    findings: List[Finding] = []
+    for info in jit_reachable(files):
+        if id(info.sf) not in bindings:
+            bindings[id(info.sf)] = _obs_bindings(info.sf)
+        aliases, members = bindings[id(info.sf)]
+        for node in ast.walk(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            d = _dotted(node.func)
+            if d is None:
+                continue
+            head, _, rest = d.partition('.')
+            hit = (d.startswith('rtseg_tpu.obs.')
+                   or (rest and head in aliases)
+                   or d in members)
+            if not hit:
+                continue
+            f = info.sf.finding(
+                RULE_OBS, node.lineno,
+                f'{d}() is a host-side segscope call inside '
+                f'{info.qualname!r}, which is reachable from a jit entry '
+                f'point — it would time the trace once, not the step; '
+                f'record this region from the host loop instead')
+            if f:
+                findings.append(f)
+    return findings

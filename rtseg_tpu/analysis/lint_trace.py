@@ -139,15 +139,21 @@ def _forbidden(call: ast.Call) -> Optional[str]:
     return None
 
 
-def check_trace_purity(root: str, files=None) -> List[Finding]:
+def target_files(root: str, files=None) -> List[SourceFile]:
+    """The scanned SourceFiles under TARGET_PREFIXES (the modules whose
+    functions may end up inside a jax trace)."""
     if files is not None:
-        files = [sf for sf in files
-                 if sf.relpath.replace('\\', '/').startswith(TARGET_PREFIXES)]
-    else:
-        targets = [rel for rel in iter_python_files(root)
-                   if rel.replace('\\', '/').startswith(TARGET_PREFIXES)]
-        files = [SourceFile.load(root, rel) for rel in targets]
+        return [sf for sf in files
+                if sf.relpath.replace('\\', '/').startswith(TARGET_PREFIXES)]
+    targets = [rel for rel in iter_python_files(root)
+               if rel.replace('\\', '/').startswith(TARGET_PREFIXES)]
+    return [SourceFile.load(root, rel) for rel in targets]
 
+
+def jit_reachable(files: List[SourceFile]) -> List[_FnInfo]:
+    """Every function reachable from a jit root across `files`, in sorted
+    name order. Shared by trace-purity and obs-purity — one definition of
+    'this code runs under a jax trace'."""
     # global function index by bare name (cross-file edges resolve here)
     all_fns: Dict[str, List[_FnInfo]] = {}
     roots: Set[str] = set()
@@ -174,19 +180,22 @@ def check_trace_purity(root: str, files=None) -> List[Finding]:
                 if ref in all_fns and ref not in reachable:
                     frontier.append(ref)
 
+    return [info for name in sorted(reachable) for info in all_fns[name]]
+
+
+def check_trace_purity(root: str, files=None) -> List[Finding]:
     findings: List[Finding] = []
-    for name in sorted(reachable):
-        for info in all_fns[name]:
-            for node in ast.walk(info.node):
-                if not isinstance(node, ast.Call):
-                    continue
-                why = _forbidden(node)
-                if why is None:
-                    continue
-                f = info.sf.finding(
-                    RULE_TRACE, node.lineno,
-                    f'{why} — inside {info.qualname!r}, which is reachable '
-                    f'from a jit entry point')
-                if f:
-                    findings.append(f)
+    for info in jit_reachable(target_files(root, files)):
+        for node in ast.walk(info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            why = _forbidden(node)
+            if why is None:
+                continue
+            f = info.sf.finding(
+                RULE_TRACE, node.lineno,
+                f'{why} — inside {info.qualname!r}, which is reachable '
+                f'from a jit entry point')
+            if f:
+                findings.append(f)
     return findings

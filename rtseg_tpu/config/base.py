@@ -102,6 +102,24 @@ class SegConfig:
     profile_dir: Optional[str] = None
     profile_steps: int = 5
 
+    # ----- Observability (segscope, rtseg_tpu/obs/) -----
+    # per-host JSONL telemetry: spans, per-step wall-time breakdown (data
+    # wait vs dispatch vs compile), stall events. tools/segscope.py
+    # report/diff consumes obs_dir. Off: no files and no watchdog thread;
+    # the progress line still shows imgs/sec + data-wait (host timing).
+    use_obs: bool = True
+    obs_dir: Optional[str] = None          # resolved to save_dir/segscope
+    # stall watchdog: heartbeat thread that fires when no step completes
+    # within max(watchdog_min_s, watchdog_factor x median recent step
+    # time) — dumps every thread's Python stack (+ a short profiler trace
+    # when obs_stall_trace) and emits a structured 'stall' event instead
+    # of letting a hung collective / tunnel stall die silently
+    # (the failure mode utils/bench.py documents)
+    watchdog: bool = True
+    watchdog_min_s: float = 120.0
+    watchdog_factor: float = 20.0
+    obs_stall_trace: bool = True
+
     # ----- Training setting (base_config.py:64-71) -----
     # torch AMP's role is played by compute_dtype on TPU (bf16 compute, fp32
     # params, no GradScaler). For reference-config migration the flag is
@@ -238,6 +256,8 @@ class SegConfig:
             self.load_ckpt_path = f'{self.save_dir}/last.ckpt'
         if self.tb_log_dir is None:
             self.tb_log_dir = f'{self.save_dir}/tb_logs/'
+        if self.obs_dir is None:
+            self.obs_dir = f'{self.save_dir}/segscope'
         if self.crop_h is None:
             self.crop_h = self.crop_size
         if self.crop_w is None:

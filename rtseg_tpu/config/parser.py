@@ -95,6 +95,13 @@ def get_parser() -> argparse.ArgumentParser:
     p.add_argument('--use_tb', type=_bool)
     p.add_argument('--tb_log_dir', type=str)
     p.add_argument('--ckpt_name', type=str)
+    # Observability (segscope)
+    p.add_argument('--use_obs', type=_bool)
+    p.add_argument('--obs_dir', type=str)
+    p.add_argument('--watchdog', type=_bool)
+    p.add_argument('--watchdog_min_s', type=float)
+    p.add_argument('--watchdog_factor', type=float)
+    p.add_argument('--obs_stall_trace', type=_bool)
     # Training setting
     # tri-state: absent -> None (defer to compute_dtype), true -> bf16,
     # false -> force fp32 (reachable from the CLI, unlike store_const)

@@ -26,6 +26,8 @@ from typing import Iterator, Optional, Tuple
 
 import numpy as np
 
+from ..obs import span
+
 
 class ShardedLoader:
     def __init__(self, dataset, global_batch: int, seed: int = 0,
@@ -133,8 +135,13 @@ class ShardedLoader:
                     lo = self.process_index * self.local_batch
                     hi = lo + self.local_batch
                     local_idx = batch_idx[lo:hi]
-                    batch = self._make_batch(local_idx,
-                                             self._sample_rngs(b), pool)
+                    # segscope: producer-side batch production time — the
+                    # consumer-side wait is timed by the trainer's
+                    # StepCollector; comparing the two separates "loader
+                    # too slow" from "prefetch queue too short"
+                    with span('data/produce'):
+                        batch = self._make_batch(local_idx,
+                                                 self._sample_rngs(b), pool)
                     if not put(q, batch):
                         return                  # consumer went away
                 put(q, None)

@@ -1,0 +1,28 @@
+"""segscope — the runtime telemetry layer (spans, step collector, stall
+watchdog, run reports).
+
+What segcheck/segaudit prove about the *compiled artifact*, segscope
+observes about the *run*: where each step's wall time goes (data wait vs
+dispatch vs compile), what throughput and goodput a run actually achieved,
+and — via the stall watchdog — what every thread was doing when a step
+stopped returning. Events land in per-host JSONL files under
+``config.obs_dir``; ``tools/segscope.py report|diff`` turns them into the
+step-time/goodput breakdown. Span names are mirrored into XLA profiler
+traces (jax.profiler.TraceAnnotation) so host regions and device ops line
+up in trace viewer.
+
+All APIs here are host-side; the ``obs-purity`` lint
+(analysis/lint_obs.py) keeps them out of jit-reachable code.
+"""
+
+from .core import (EventSink, emit_memory, get_sink, init_run, set_sink,
+                   span)
+from .collector import StepCollector
+from .watchdog import StallWatchdog, dump_all_stacks
+from .report import (diff_table, format_summary, load_events, summarize)
+
+__all__ = [
+    'EventSink', 'emit_memory', 'get_sink', 'init_run', 'set_sink', 'span',
+    'StepCollector', 'StallWatchdog', 'dump_all_stacks',
+    'diff_table', 'format_summary', 'load_events', 'summarize',
+]

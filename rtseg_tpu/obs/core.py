@@ -1,0 +1,178 @@
+"""segscope core: structured spans and the per-host JSONL event sink.
+
+The observability contract of the repo (see README "Observability"): every
+interesting wall-time region of a run — data wait, step dispatch,
+checkpoint I/O, bench blocks — is a *span*. A span does two things at once:
+
+  * records a structured event ``{"event": "span", "name", "ts", "dur_s",
+    "depth"}`` to the process-global :class:`EventSink` (one JSONL file per
+    host under ``config.obs_dir``), and
+  * mirrors the same name into any active XLA profiler trace via
+    ``jax.profiler.TraceAnnotation``, so the host regions line up with
+    device ops in trace viewer under identical labels.
+
+Everything here is host-side by design; calling these APIs from
+jit-reachable code is a bug the ``obs-purity`` lint (analysis/lint_obs.py)
+catches — a span inside a traced function would time the *trace*, once,
+instead of the step, every time.
+
+This module must stay importable without jax (tools/segscope.py reads
+JSONL on machines with no accelerator stack): jax is imported lazily and
+only when a profiler annotation is actually requested.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, Iterator, Optional
+
+
+class EventSink:
+    """Append-only JSONL event writer, one file per host.
+
+    Thread-safe: the trainer loop, the loader's producer thread and the
+    stall watchdog all emit into the same sink. Each event line gets a
+    wall-clock ``ts`` and the sink's static fields (``host``) stamped in
+    unless the caller already set them. Emitting into a closed sink is a
+    silent no-op so late telemetry (a watchdog poll racing shutdown) can
+    never crash a run.
+    """
+
+    def __init__(self, path: str, static: Optional[Dict[str, Any]] = None):
+        self.path = path
+        self.static = dict(static or {})
+        self._lock = threading.Lock()
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        self._f = open(path, 'a')
+        self._closed = False
+
+    def emit(self, event: Dict[str, Any]) -> None:
+        rec = dict(self.static)
+        rec.update(event)
+        rec.setdefault('ts', time.time())
+        line = json.dumps(rec, default=str)
+        with self._lock:
+            if self._closed:
+                return
+            self._f.write(line + '\n')
+            # flush per line: a stall/crash must not eat the events that
+            # explain it (the whole point of the stall watchdog)
+            self._f.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._closed:
+                self._closed = True
+                self._f.close()
+
+
+# process-global sink: the trainer owns the lifecycle (init_run/set_sink);
+# library code (loader producer, bench loops) emits through get_sink() and
+# degrades to a no-op when telemetry is off
+_SINK: Optional[EventSink] = None
+_TLS = threading.local()                    # per-thread span nesting depth
+
+
+def set_sink(sink: Optional[EventSink]) -> None:
+    global _SINK
+    _SINK = sink
+
+
+def get_sink() -> Optional[EventSink]:
+    return _SINK
+
+
+_TRACE_ANNOTATION = None                    # cached class or False
+
+
+def _trace_annotation(name: str):
+    """jax.profiler.TraceAnnotation(name), or None when jax is absent.
+    Cached after the first lookup; cheap TraceMe no-op outside an active
+    profiler session."""
+    global _TRACE_ANNOTATION
+    if _TRACE_ANNOTATION is None:
+        try:
+            from jax.profiler import TraceAnnotation
+            _TRACE_ANNOTATION = TraceAnnotation
+        except Exception:   # noqa: BLE001 — telemetry never breaks the run
+            _TRACE_ANNOTATION = False
+    if _TRACE_ANNOTATION is False:
+        return None
+    return _TRACE_ANNOTATION(name)
+
+
+@contextmanager
+def span(name: str, record: bool = True, **attrs: Any) -> Iterator[None]:
+    """Time a host-side region.
+
+    ``record=True`` emits a ``span`` JSONL event on exit (when a sink is
+    set); ``record=False`` only mirrors the name into the profiler trace —
+    used for regions whose timing is already captured by a richer event
+    (e.g. the per-step dispatch, covered by the collector's ``step``
+    events) so the JSONL carries no duplicates.
+    """
+    depth = getattr(_TLS, 'depth', 0)
+    _TLS.depth = depth + 1
+    ta = _trace_annotation(name)
+    t0 = time.perf_counter()
+    try:
+        if ta is not None:
+            with ta:
+                yield
+        else:
+            yield
+    finally:
+        dur = time.perf_counter() - t0
+        _TLS.depth = depth
+        sink = _SINK
+        if record and sink is not None:
+            ev: Dict[str, Any] = {'event': 'span', 'name': name,
+                                  'dur_s': round(dur, 6), 'depth': depth}
+            if attrs:
+                ev.update(attrs)
+            sink.emit(ev)
+
+
+def init_run(obs_dir: str, meta: Optional[Dict[str, Any]] = None
+             ) -> EventSink:
+    """Create this host's event sink under ``obs_dir`` and emit the
+    ``run_start`` marker. Files append across resumes; tools/segscope.py
+    reports the segment after the *last* run_start by default."""
+    host = 0
+    try:
+        import jax
+        host = jax.process_index()
+    except Exception:   # noqa: BLE001 — no jax / uninitialized backend
+        host = 0
+    sink = EventSink(os.path.join(obs_dir, f'events-{host:03d}.jsonl'),
+                     static={'host': host})
+    ev: Dict[str, Any] = {'event': 'run_start'}
+    if meta:
+        ev.update(meta)
+    sink.emit(ev)
+    return sink
+
+
+#: memory_stats keys worth persisting (backend-optional; TPU fills these,
+#: CPU usually reports nothing)
+_MEMORY_KEYS = ('bytes_in_use', 'peak_bytes_in_use', 'bytes_limit',
+                'largest_alloc_size')
+
+
+def emit_memory(sink: Optional[EventSink]) -> None:
+    """Best-effort ``memory`` event from device 0's memory_stats()."""
+    if sink is None:
+        return
+    try:
+        import jax
+        dev = jax.local_devices()[0]
+        stats = dev.memory_stats() or {}
+    except Exception:   # noqa: BLE001 — backend without memory_stats
+        return
+    keep = {k: int(v) for k, v in stats.items() if k in _MEMORY_KEYS}
+    if keep:
+        sink.emit({'event': 'memory', 'device': str(dev), **keep})

@@ -1,0 +1,218 @@
+"""Read segscope JSONL runs and derive the step-time/goodput breakdown.
+
+Pure stdlib+numpy — tools/segscope.py runs this on machines without jax.
+Definitions (also in BENCHMARKS.md "Goodput"):
+
+  * step p50/p95   — percentiles of non-compile train-step durations
+  * imgs/sec       — total images of non-compile train steps / their
+                     summed duration (steady-state throughput)
+  * data-wait frac — time blocked on the loader / loop wall
+                     (data wait + step time) over all train steps
+  * goodput        — productive train-step time (non-compile) / the
+                     training-run wall (run() entry -> run_end; trainer
+                     construction excluded), i.e. the fraction of the run
+                     spent making training progress
+  * compile s      — summed duration of steps whose jit cache grew
+                     (first-step compile and any retrace)
+
+Multi-host runs write one file per host; timing stats come from the lowest
+host present (per-host clocks don't mix), stall counts from every host.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+def _read_jsonl(path: str) -> List[dict]:
+    events = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue        # torn tail line from a killed run
+    return events
+
+
+def load_events(path: str, last_run: bool = True) -> List[dict]:
+    """Events from one JSONL file or a run directory of events-*.jsonl.
+
+    Sinks append across resumes; ``last_run`` slices each host's stream
+    from its final ``run_start`` marker so a resumed run reports only
+    itself. Returns events merged across hosts, ordered by timestamp.
+    """
+    if os.path.isdir(path):
+        files = sorted(glob.glob(os.path.join(path, 'events-*.jsonl')))
+        if not files:
+            raise FileNotFoundError(f'no events-*.jsonl under {path}')
+    elif os.path.isfile(path):
+        files = [path]
+    else:
+        raise FileNotFoundError(path)
+    events: List[dict] = []
+    for fp in files:
+        ev = _read_jsonl(fp)
+        if last_run:
+            starts = [i for i, e in enumerate(ev)
+                      if e.get('event') == 'run_start']
+            if starts:
+                ev = ev[starts[-1]:]
+        events.extend(ev)
+    return sorted(events, key=lambda e: e.get('ts', 0.0))
+
+
+def summarize(events: List[dict]) -> Dict[str, Any]:
+    hosts = sorted({e.get('host', 0) for e in events})
+    h0 = hosts[0] if hosts else 0
+
+    def mine(e):
+        return e.get('host', 0) == h0
+
+    start = next((e for e in events
+                  if e.get('event') == 'run_start' and mine(e)), None)
+    end = next((e for e in reversed(events)
+                if e.get('event') == 'run_end' and mine(e)), None)
+    tsteps = [e for e in events if e.get('event') == 'step'
+              and e.get('kind') == 'train' and mine(e)]
+    vsteps = [e for e in events if e.get('event') == 'step'
+              and e.get('kind') == 'val' and mine(e)]
+    clean = [e for e in tsteps if not e.get('compile')]
+    durs = np.asarray([e['dur_s'] for e in clean], np.float64)
+    compile_s = float(sum(e['dur_s'] for e in tsteps + vsteps
+                          if e.get('compile')))
+    stalls = [e for e in events if e.get('event') == 'stall']
+
+    if end is not None and 'wall_s' in end:
+        wall = float(end['wall_s'])
+    else:
+        # crashed/killed run: no run_end marker. Approximate the same
+        # window run_end would have covered (the train/val loop, not
+        # trainer construction): first step event to the last event seen.
+        ts = [e['ts'] for e in events if 'ts' in e]
+        t0 = min((e['ts'] for e in tsteps + vsteps if 'ts' in e),
+                 default=min(ts) if ts else 0.0)
+        wall = (max(ts) - t0) if len(ts) > 1 else 0.0
+
+    productive = float(durs.sum()) if durs.size else 0.0
+    imgs = int(sum(e.get('imgs', 0) for e in clean))
+    waits = [float(e.get('data_wait_s', 0.0)) for e in tsteps]
+    busy = float(sum(e['dur_s'] for e in tsteps)) + sum(waits)
+
+    spans: Dict[str, Dict[str, float]] = {}
+    for e in events:
+        if e.get('event') != 'span' or not mine(e):
+            continue
+        agg = spans.setdefault(e.get('name', '?'),
+                               {'count': 0, 'total_s': 0.0})
+        agg['count'] += 1
+        agg['total_s'] = round(agg['total_s'] + float(e.get('dur_s', 0.0)),
+                               6)
+    memory = next((e for e in reversed(events)
+                   if e.get('event') == 'memory' and mine(e)), None)
+
+    return {
+        'run': {k: v for k, v in (start or {}).items()
+                if k not in ('event', 'ts', 'host')},
+        'hosts': len(hosts),
+        'train_steps': len(tsteps),
+        'compile_steps': len([e for e in tsteps + vsteps
+                              if e.get('compile')]),
+        'val_steps': len(vsteps),
+        'step_p50_s': float(np.percentile(durs, 50)) if durs.size else None,
+        'step_p95_s': float(np.percentile(durs, 95)) if durs.size else None,
+        'imgs_per_sec': imgs / productive if productive > 0 else 0.0,
+        'data_wait_frac': sum(waits) / busy if busy > 0 else 0.0,
+        'goodput': productive / wall if wall > 0 else 0.0,
+        'compile_s': compile_s,
+        'stalls': len(stalls),
+        'wall_s': wall,
+        'epochs': len([e for e in events if e.get('event') == 'epoch'
+                       and e.get('kind') == 'train' and mine(e)]),
+        'spans': spans,
+        'memory': ({k: v for k, v in memory.items()
+                    if k not in ('event', 'ts', 'host')}
+                   if memory else None),
+    }
+
+
+def _ms(v: Optional[float]) -> str:
+    return f'{1e3 * v:.2f} ms' if v is not None else '—'
+
+
+def format_summary(s: Dict[str, Any], path: str = '') -> str:
+    run = s.get('run', {})
+    meta = ' '.join(f'{k}={run[k]}' for k in
+                    ('model', 'dataset', 'devices') if k in run)
+    lines = [
+        f'segscope report — {path}' if path else 'segscope report',
+        f'  run            : {meta or "(no metadata)"}'
+        f' | hosts={s["hosts"]} epochs={s["epochs"]}',
+        f'  train steps    : {s["train_steps"]} | val steps: '
+        f'{s["val_steps"]} | compile steps (train+val): '
+        f'{s["compile_steps"]}',
+        f'  step p50 / p95 : {_ms(s["step_p50_s"])} / '
+        f'{_ms(s["step_p95_s"])}',
+        f'  imgs/sec       : {s["imgs_per_sec"]:.1f}',
+        f'  data-wait      : {100 * s["data_wait_frac"]:.1f}%',
+        f'  goodput        : {100 * s["goodput"]:.1f}%',
+        f'  compile        : {s["compile_s"]:.2f} s',
+        f'  stalls         : {s["stalls"]}',
+        f'  wall           : {s["wall_s"]:.1f} s',
+    ]
+    if s.get('memory'):
+        mem = s['memory']
+        parts = [f'{k}={v / 2**20:.0f}MiB' for k, v in mem.items()
+                 if isinstance(v, (int, float))]
+        lines.append(f'  device memory  : {" ".join(parts)}')
+    if s.get('spans'):
+        top = sorted(s['spans'].items(), key=lambda kv: -kv[1]['total_s'])
+        lines.append('  top spans      : ' + '; '.join(
+            f'{name} {agg["total_s"]:.2f}s x{agg["count"]}'
+            for name, agg in top[:5]))
+    return '\n'.join(lines)
+
+
+#: (key, label, unit scale, higher_is_better)
+_DIFF_ROWS = (
+    ('step_p50_s', 'step p50 (ms)', 1e3, False),
+    ('step_p95_s', 'step p95 (ms)', 1e3, False),
+    ('imgs_per_sec', 'imgs/sec', 1.0, True),
+    ('data_wait_frac', 'data-wait (%)', 100.0, False),
+    ('goodput', 'goodput (%)', 100.0, True),
+    ('compile_s', 'compile (s)', 1.0, False),
+    ('stalls', 'stalls', 1.0, False),
+)
+
+#: relative change beyond which a worse metric is labeled a regression
+_REGRESSION_THRESHOLD = 0.05
+
+
+def diff_table(a: Dict[str, Any], b: Dict[str, Any]) -> str:
+    """Markdown regression table comparing run A (baseline) to run B."""
+    lines = ['| metric | A | B | delta |', '|---|---|---|---|']
+    for key, label, scale, higher_better in _DIFF_ROWS:
+        va, vb = a.get(key), b.get(key)
+        if va is None or vb is None:
+            lines.append(f'| {label} | — | — | — |')
+            continue
+        va, vb = scale * va, scale * vb
+        if va:
+            rel = (vb - va) / abs(va)
+            delta = f'{100 * rel:+.1f}%'
+        else:
+            rel = 0.0 if vb == 0 else float('inf')
+            delta = '+inf' if rel else '0%'
+        worse = rel > _REGRESSION_THRESHOLD if not higher_better \
+            else rel < -_REGRESSION_THRESHOLD
+        mark = ' REGRESSED' if worse else ''
+        lines.append(f'| {label} | {va:.2f} | {vb:.2f} | {delta}{mark} |')
+    return '\n'.join(lines)

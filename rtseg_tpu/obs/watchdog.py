@@ -1,0 +1,168 @@
+"""Stall watchdog: a heartbeat thread that turns silent hangs into events.
+
+The failure mode this exists for is documented in utils/bench.py: through
+the axon TPU tunnel a hung collective or a dropped dispatch response can
+park the main thread inside a device call forever, with no log line and no
+stack. The watchdog runs as a daemon thread; the train/val loops heartbeat
+it (`beat`) when a batch arrives and when a step returns. If no beat lands
+within an *adaptive* deadline — ``max(min_deadline_s, factor x median
+recent step time)``, so slow-but-healthy workloads aren't false-flagged —
+it:
+
+  * captures the Python stack of every live thread (``sys._current_frames``
+    — including the one stuck inside the device call),
+  * best-effort dumps a short ``jax.profiler`` trace window into
+    ``trace_dir`` (what the device was doing while the host was stuck),
+  * emits one structured ``stall`` event to the sink and logs an error.
+
+It fires at most once per missed beat (re-armed by the next beat) and it
+never raises into the run: a watchdog that could kill healthy training is
+worse than the hangs it reports.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import statistics
+import sys
+import threading
+import time
+import traceback
+from typing import Optional
+
+from .core import EventSink
+
+
+def dump_all_stacks() -> str:
+    """One formatted stack per live thread, named where possible."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = []
+    for tid, frame in sorted(sys._current_frames().items()):
+        out.append(f'--- thread {names.get(tid, "?")} (id {tid}) ---')
+        out.append(''.join(traceback.format_stack(frame)))
+    return '\n'.join(out)
+
+
+class StallWatchdog:
+    def __init__(self, sink: Optional[EventSink],
+                 min_deadline_s: float = 120.0, factor: float = 20.0,
+                 poll_s: Optional[float] = None,
+                 trace_dir: Optional[str] = None,
+                 trace_len_s: float = 0.5, logger=None,
+                 compile_grace_s: float = 1800.0):
+        self.sink = sink
+        self.min_deadline_s = float(min_deadline_s)
+        self.factor = float(factor)
+        # until one real step duration has been observed, the deadline is
+        # at least compile_grace_s: the first call of a big model can sit
+        # minutes inside trace+XLA compile with no heartbeat possible, and
+        # that must not count as a stall (it is reported as compile time
+        # by the collector instead)
+        self.compile_grace_s = float(compile_grace_s)
+        self.poll_s = (poll_s if poll_s is not None
+                       else max(0.05, min(1.0, self.min_deadline_s / 8)))
+        self.trace_dir = trace_dir
+        self.trace_len_s = trace_len_s
+        self.logger = logger
+        self.stall_count = 0
+        self._durs: collections.deque = collections.deque(maxlen=128)
+        self._lock = threading.Lock()
+        self._last: Optional[tuple] = None     # (monotonic, step id)
+        self._fired = False
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------ heartbeat
+    def beat(self, dur_s: Optional[float] = None,
+             step: Optional[int] = None) -> None:
+        with self._lock:
+            if dur_s is not None:
+                self._durs.append(float(dur_s))
+            self._last = (time.monotonic(), step)
+            self._fired = False
+
+    def deadline_s(self) -> float:
+        with self._lock:
+            durs = list(self._durs)
+        if not durs:                       # nothing completed yet: compile
+            return max(self.min_deadline_s, self.compile_grace_s)
+        return max(self.min_deadline_s, self.factor * statistics.median(durs))
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name='segscope-watchdog')
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    # ----------------------------------------------------------------- loop
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            with self._lock:
+                last, fired = self._last, self._fired
+            if last is None or fired:
+                continue
+            elapsed = time.monotonic() - last[0]
+            deadline = self.deadline_s()
+            if elapsed <= deadline:
+                continue
+            with self._lock:
+                self._fired = True              # once per missed beat
+            try:
+                self._fire(elapsed, deadline, last[1])
+            except Exception:   # noqa: BLE001 — never raise into the run
+                pass
+
+    def _fire(self, elapsed: float, deadline: float,
+              step: Optional[int]) -> None:
+        self.stall_count += 1
+        stacks = dump_all_stacks()
+        trace_dir = self._try_trace()
+        if self.sink is not None:
+            self.sink.emit({'event': 'stall', 'step': step,
+                            'elapsed_s': round(elapsed, 3),
+                            'deadline_s': round(deadline, 3),
+                            'stacks': stacks, 'trace_dir': trace_dir})
+        if self.logger is not None:
+            self.logger.error(
+                f'segscope: no step heartbeat for {elapsed:.1f}s '
+                f'(deadline {deadline:.1f}s, last step {step}) — stall '
+                f'event written'
+                + (f', profiler trace in {trace_dir}' if trace_dir else ''))
+
+    def _try_trace(self) -> Optional[str]:
+        """Short profiler trace of the stalled window; None on any failure
+        (no jax, a user trace already active, backend wedged solid).
+
+        stop_trace is only ever called for a trace THIS method started: if
+        start_trace raises (e.g. the trainer's own config.profile_dir
+        trace is active), bailing out without a stop keeps the user's
+        trace alive — stopping it here would make the trainer's later
+        stop_trace raise into the run."""
+        if not self.trace_dir:
+            return None
+        try:
+            import jax
+            os.makedirs(self.trace_dir, exist_ok=True)
+            jax.profiler.start_trace(self.trace_dir)
+        except Exception:   # noqa: BLE001 — not our trace to stop
+            return None
+        try:
+            time.sleep(self.trace_len_s)
+            jax.profiler.stop_trace()
+            return self.trace_dir
+        except Exception:   # noqa: BLE001
+            try:
+                jax.profiler.stop_trace()
+            except Exception:   # noqa: BLE001
+                pass
+            return None

@@ -30,6 +30,8 @@ import numpy as np
 from ..config import SegConfig
 from ..data import get_loader, get_test_loader
 from ..models import get_model, get_teacher_model
+from .. import obs
+from ..obs import StallWatchdog, StepCollector, emit_memory, span
 from ..parallel import (batch_sharding, init_multihost, main_rank,
                         make_global_array, make_mesh, replicated)
 from ..utils import (TBWriter, get_colormap, get_logger, iou_from_cm,
@@ -59,6 +61,8 @@ class SegTrainer:
         self.best_score = 0.0
         self.cur_epoch = 0
         self.epoch_losses = []             # mean loss per trained epoch
+        self._obs_sink = None              # segscope sink (training only)
+        self._watchdog = None              # stall watchdog (run() scope)
 
         if config.is_testing:
             self.test_set = get_test_loader(config)
@@ -66,6 +70,18 @@ class SegTrainer:
             return
 
         self.writer = TBWriter(config, self.main_rank)
+        # segscope telemetry: every host writes its own JSONL event stream
+        # (tools/segscope.py report aggregates); the watchdog thread is
+        # started/stopped by run()
+        if config.use_obs:
+            self._obs_sink = obs.init_run(config.obs_dir, meta={
+                'model': config.model, 'dataset': config.dataset,
+                'total_epoch': config.total_epoch,
+                'global_train_bs': config.train_bs * config.gpu_num,
+                'global_val_bs': config.val_bs * config.gpu_num,
+                'compute_dtype': config.compute_dtype,
+                'devices': config.gpu_num})
+            obs.set_sink(self._obs_sink)
         self.train_loader, self.val_loader = get_loader(config)
         self.optimizer = get_optimizer(config)
 
@@ -190,12 +206,13 @@ class SegTrainer:
         # base_trainer.py:152-154, where the branch is a latent NameError)
         name = cfg.ckpt_name or ('best.ckpt' if best else 'last.ckpt')
         path = os.path.join(cfg.save_dir, name)
-        if best:
-            save_best_ckpt(path, self.state, self.cur_epoch + 1,
-                           self.best_score)
-        else:
-            save_train_ckpt(path, self.state, self.cur_epoch + 1,
-                            self.best_score)
+        with span('ckpt/save', best=best):
+            if best:
+                save_best_ckpt(path, self.state, self.cur_epoch + 1,
+                               self.best_score)
+            else:
+                save_train_ckpt(path, self.state, self.cur_epoch + 1,
+                                self.best_score)
 
     # ------------------------------------------------------------------- run
     def _put(self, images: np.ndarray, masks: np.ndarray):
@@ -212,22 +229,47 @@ class SegTrainer:
         if self.main_rank:
             save_config(cfg)
             log_config(cfg, self.logger)
-        start = time.time()
-        for epoch in range(self.cur_epoch, cfg.total_epoch):
-            self.cur_epoch = epoch
-            self.train_one_epoch()
-            score = None
-            if (epoch >= cfg.begin_val_epoch
-                    and (epoch + 1) % cfg.val_interval == 0):
-                score = self.validate()
-                if score > self.best_score:
-                    self.best_score = score
-                    self.save_ckpt(best=True)
-            self.save_ckpt(best=False)
-        if self.main_rank:
-            self.logger.info(
-                f'Training finished in {time.time() - start:.1f}s')
-        score = self.val_best()
+        start = time.perf_counter()
+        if self._obs_sink is not None and cfg.watchdog:
+            self._watchdog = StallWatchdog(
+                self._obs_sink, min_deadline_s=cfg.watchdog_min_s,
+                factor=cfg.watchdog_factor,
+                trace_dir=(os.path.join(cfg.obs_dir, 'stall_trace')
+                           if cfg.obs_stall_trace else None),
+                logger=self.logger)
+            self._watchdog.start()
+        try:
+            for epoch in range(self.cur_epoch, cfg.total_epoch):
+                self.cur_epoch = epoch
+                self.train_one_epoch()
+                score = None
+                if (epoch >= cfg.begin_val_epoch
+                        and (epoch + 1) % cfg.val_interval == 0):
+                    score = self.validate()
+                    if score > self.best_score:
+                        self.best_score = score
+                        self.save_ckpt(best=True)
+                self.save_ckpt(best=False)
+            if self.main_rank:
+                self.logger.info(
+                    f'Training finished in '
+                    f'{time.perf_counter() - start:.1f}s')
+            score = self.val_best()
+        finally:
+            if self._watchdog is not None:
+                self._watchdog.stop()
+                self._watchdog = None
+            if self._obs_sink is not None:
+                # wall_s is the goodput denominator: the run() loop proper
+                # (trainer construction is not counted; see BENCHMARKS.md
+                # "Goodput")
+                self._obs_sink.emit({
+                    'event': 'run_end',
+                    'wall_s': round(time.perf_counter() - start, 3)})
+                self._obs_sink.close()
+                if obs.get_sink() is self._obs_sink:
+                    obs.set_sink(None)
+                self._obs_sink = None
         self.writer.close()
         return score
 
@@ -249,14 +291,31 @@ class SegTrainer:
         nb = len(self.train_loader)
         profiling = (cfg.profile_dir is not None and self.cur_epoch == 0
                      and self.main_rank)
-        for i, (images, masks) in enumerate(self.train_loader):
+        # segscope per-step collector: data-wait vs dispatch wall time,
+        # compile attribution via the step's jit cache, watchdog beats.
+        # Host timing only — it never reads a device value, so the loop's
+        # async dispatch is untouched.
+        col = StepCollector(self._obs_sink, 'train',
+                            imgs_per_step=cfg.train_bs * cfg.gpu_num,
+                            jitted=getattr(self.train_step, 'jitted', None),
+                            watchdog=self._watchdog, epoch=self.cur_epoch)
+        # event/TB step ids are derived host-side from one sync per epoch
+        # (the compiled step advances state.step by exactly 1), so the loop
+        # never pays a per-step int(state.step) readback
+        step0 = int(self.state.step)
+        tb_buf = []
+        tb_every = cfg.log_interval if cfg.log_interval > 0 else 50
+        for i, (images, masks) in enumerate(col.wrap(self.train_loader)):
             if profiling and i == 1:          # skip the compile step
                 jax.profiler.start_trace(cfg.profile_dir)
             imgs, msks = self._put(images, masks)
-            self.state, metrics = self.train_step(self.state, imgs, msks)
+            with span('train/dispatch', record=False):
+                self.state, metrics = self.train_step(self.state, imgs,
+                                                      msks)
             loss_sum = metrics['loss'] if loss_sum is None \
                 else loss_sum + metrics['loss']
             n_steps += 1
+            col.end_step(step=step0 + n_steps)
             if profiling and i == cfg.profile_steps:
                 jax.block_until_ready(self.state.params)
                 jax.profiler.stop_trace()
@@ -267,35 +326,54 @@ class SegTrainer:
                 # first log point of the epoch reads the current loss (one
                 # host sync per epoch); later points read the lagged one
                 li, ll = lag if lag is not None else (i, metrics['loss'])
+                ips, dwf = col.interval_stats()
                 self.logger.info(
                     f'Epoch:{self.cur_epoch + 1}/{cfg.total_epoch} | '
-                    f'Iter:{li + 1}/{nb} | Loss:{float(ll):.4g}')
+                    f'Iter:{li + 1}/{nb} | Loss:{float(ll):.4g} | '
+                    f'{ips:.1f} imgs/s | data-wait {100 * dwf:.0f}%')
                 lag = (i, metrics['loss'])
             if self.main_rank and cfg.use_tb:
-                # the only unconditional per-step host<->device sync;
-                # skipped entirely when TB is off so steps dispatch
-                # asynchronously
-                step = int(self.state.step)
-                self.writer.add_scalar('train/loss', metrics['loss'], step)
-                if 'loss_detail' in metrics:
-                    self.writer.add_scalar('train/loss_detail',
-                                           metrics['loss_detail'], step)
-                if 'loss_kd' in metrics:
-                    self.writer.add_scalar('train/loss_kd',
-                                           metrics['loss_kd'], step)
-                    self.writer.add_scalar('train/loss_total',
-                                           metrics['loss'], step)
+                # buffer the device scalars; one batched host readback per
+                # log interval instead of a per-scalar pull every step
+                tb_buf.append((step0 + n_steps, metrics))
+                if len(tb_buf) >= tb_every:
+                    self._flush_tb(tb_buf)
         if profiling:                         # epoch shorter than the window
             jax.profiler.stop_trace()
         if metrics is None:
             raise RuntimeError(
                 'Training loader yielded no batches; the dataset is smaller '
                 'than the global batch size.')
+        self._flush_tb(tb_buf)
         self.epoch_losses.append(float(loss_sum) / n_steps)
         if self.main_rank:
             self.logger.info(
                 f'Epoch:{self.cur_epoch + 1}/{cfg.total_epoch} | '
                 f"Loss:{self.epoch_losses[-1]:.4g}")
+        if self._obs_sink is not None:
+            self._obs_sink.emit({
+                'event': 'epoch', 'epoch': self.cur_epoch, 'kind': 'train',
+                'steps': n_steps, 'mean_loss': self.epoch_losses[-1],
+                'data_wait_s': round(col.total_wait, 3),
+                'step_s': round(col.total_dur, 3),
+                'compile_s': round(col.compile_s, 3)})
+            emit_memory(self._obs_sink)
+
+    def _flush_tb(self, buf) -> None:
+        """Write buffered (step, metrics) pairs to TensorBoard with ONE
+        batched device->host readback for the whole interval."""
+        if not buf:
+            return
+        vals = jax.device_get([m for _, m in buf])
+        for (step_id, _), m in zip(buf, vals):
+            scalars = {'train/loss': m['loss']}
+            if 'loss_detail' in m:
+                scalars['train/loss_detail'] = m['loss_detail']
+            if 'loss_kd' in m:
+                scalars['train/loss_kd'] = m['loss_kd']
+                scalars['train/loss_total'] = m['loss']
+            self.writer.add_scalars(scalars, step_id)
+        buf.clear()
 
     def validate(self, val_best: bool = False) -> float:
         cfg = self.config
@@ -310,7 +388,11 @@ class SegTrainer:
         # bounded by the GLOBAL pixel count, not this process's share
         procs = jax.process_count()
         checked_bound = False
-        for images, masks in self.val_loader:
+        col = StepCollector(self._obs_sink, 'val',
+                            imgs_per_step=cfg.val_bs * cfg.gpu_num,
+                            jitted=getattr(self.eval_step, 'jitted', None),
+                            watchdog=self._watchdog, epoch=self.cur_epoch)
+        for images, masks in col.wrap(self.val_loader):
             if not checked_bound:
                 # the cross-batch accumulator is flushed below before int32
                 # could overflow, but a single global batch beyond 2^31 px
@@ -328,12 +410,15 @@ class SegTrainer:
                 cm_host += np.asarray(cm_dev, np.int64)
                 cm_dev, dev_pixels = None, 0
             imgs, msks = self._put(images, masks)
-            part = self.eval_step(self.state, imgs, msks)
+            with span('val/dispatch', record=False):
+                part = self.eval_step(self.state, imgs, msks)
             cm_dev = part if cm_dev is None else cm_dev + part
             dev_pixels += masks.size * procs
+            col.end_step()
         if cm_dev is None:
             raise RuntimeError('Validation loader yielded no batches.')
-        cm_host += np.asarray(cm_dev, np.int64)
+        with span('val/readback'):
+            cm_host += np.asarray(cm_dev, np.int64)
         iou = iou_from_cm(cm_host)
         score = float(iou.mean())
         if self.main_rank:
@@ -346,10 +431,16 @@ class SegTrainer:
                     f'Epoch {self.cur_epoch + 1} mIoU: {score:.4f} | best '
                     f'mIoU so far: {max(self.best_score, score):.4f}')
             if cfg.use_tb and not val_best:
-                self.writer.add_scalar('val/mIoU', score, self.cur_epoch + 1)
-                for i in range(cfg.num_class):
-                    self.writer.add_scalar(f'val/IoU_cls{i:02d}', iou[i],
-                                           self.cur_epoch + 1)
+                scalars = {'val/mIoU': score}
+                scalars.update({f'val/IoU_cls{i:02d}': iou[i]
+                                for i in range(cfg.num_class)})
+                self.writer.add_scalars(scalars, self.cur_epoch + 1)
+        if self._obs_sink is not None:
+            self._obs_sink.emit({
+                'event': 'epoch', 'epoch': self.cur_epoch, 'kind': 'val',
+                'steps': col.n_steps, 'miou': score,
+                'data_wait_s': round(col.total_wait, 3),
+                'step_s': round(col.total_dur, 3)})
         return score
 
     def val_best(self) -> float:

@@ -12,6 +12,8 @@ from __future__ import annotations
 
 import time
 
+from ..obs import span
+
 # Reference RTX-2080 FPS at 1024x512 bs1 as the reference repo reports
 # them (README.md:133-203, produced by its tools/test_speed.py).
 REFERENCE_FPS = {
@@ -45,18 +47,27 @@ def fenced_throughput(call, readback, items_per_call: int,
     if guard_jitted is not None:
         from ..analysis.recompile import RecompileGuard
         guard = RecompileGuard(guard_name, warmup=1)
-    for _ in range(warmup):
-        readback(call())
+    # segscope spans: warmup vs timed blocks show up named in profiler
+    # traces and (when a sink is set, e.g. benchmark_all --obs-dir) in the
+    # run's JSONL alongside the bench_result events
+    with span(f'bench/warmup/{guard_name}', record=False):
+        for _ in range(warmup):
+            readback(call())
     if guard is not None:
         guard.after_call(guard_jitted)      # baseline post-warmup
     best = 0.0
     for _ in range(trials):
-        t0 = time.perf_counter()
-        out = None
-        for _ in range(queue):
-            out = call()
-        readback(out)
-        best = max(best, items_per_call * queue / (time.perf_counter() - t0))
+        with span(f'bench/block/{guard_name}', items=items_per_call * queue):
+            t0 = time.perf_counter()
+            out = None
+            for _ in range(queue):
+                out = call()
+            readback(out)
+            # close the timed window INSIDE the span: the span's own JSONL
+            # emit (file write + flush) must never be charged to the
+            # published number
+            dt = time.perf_counter() - t0
+        best = max(best, items_per_call * queue / dt)
         if guard is not None:
             guard.after_call(guard_jitted)  # raise if this block retraced
     return best

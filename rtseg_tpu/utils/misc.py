@@ -72,6 +72,14 @@ class TBWriter:
         if self._w is not None:
             self._w.add_scalar(tag, float(value), int(step))
 
+    def add_scalars(self, scalars, step):
+        """Write a dict of host scalars at one step. Callers batch their
+        device->host readbacks (one jax.device_get per log interval)
+        before handing values here — see SegTrainer._flush_tb."""
+        if self._w is not None:
+            for tag, value in scalars.items():
+                self._w.add_scalar(tag, float(value), int(step))
+
     def close(self):
         if self._w is not None:
             self._w.close()

@@ -1,0 +1,350 @@
+"""segscope (rtseg_tpu/obs): span nesting + JSONL schema, goodput math on
+a real 2-epoch synthetic run, the seeded-stall watchdog, the obs-purity
+lint, and the report/diff CLI.
+
+The trainer-backed tests share one module-scoped 2-epoch run: the same
+JSONL feeds the goodput assertions, the span-wiring assertions and the
+CLI subprocess tests, so the suite pays for exactly one compile."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import time
+from os import path
+
+import pytest
+
+from rtseg_tpu.analysis import check_obs_purity, run_lints
+from rtseg_tpu.analysis.core import RULE_OBS, repo_root
+from rtseg_tpu.obs import (EventSink, StallWatchdog, StepCollector,
+                           load_events, set_sink, span, summarize)
+
+ROOT = path.dirname(path.dirname(path.abspath(__file__)))
+REPO = repo_root()
+
+
+def _read(p):
+    with open(p) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+# ------------------------------------------------------------ span + sink
+def test_span_nesting_and_jsonl_schema(tmp_path):
+    p = str(tmp_path / 'events-000.jsonl')
+    sink = EventSink(p, static={'host': 0})
+    set_sink(sink)
+    try:
+        with span('train/epoch'):
+            with span('data/produce', batch=3):
+                time.sleep(0.005)
+    finally:
+        set_sink(None)
+        sink.close()
+    ev = _read(p)                          # every line parses as JSON
+    assert [e['event'] for e in ev] == ['span', 'span']
+    inner, outer = ev                      # inner span closes first
+    assert inner['name'] == 'data/produce' and outer['name'] == 'train/epoch'
+    assert inner['depth'] == 1 and outer['depth'] == 0
+    assert inner['batch'] == 3             # custom attrs pass through
+    assert 0 < inner['dur_s'] <= outer['dur_s']
+    for e in ev:                           # schema: common stamped fields
+        assert e['host'] == 0 and isinstance(e['ts'], float)
+
+
+def test_span_without_sink_is_noop_and_sink_closed_drops():
+    with span('no/sink'):                  # no global sink: must not raise
+        pass
+    sink = EventSink('/tmp/rtseg_obs_closed.jsonl')
+    sink.close()
+    sink.emit({'event': 'late'})           # closed sink: silent no-op
+
+
+# -------------------------------------------------------------- collector
+class _FakeJit:
+    """Stands in for a jitted callable's cache introspection."""
+    def __init__(self):
+        self.size = 0
+
+    def _cache_size(self):
+        return self.size
+
+
+def test_collector_step_events_and_compile_attribution(tmp_path):
+    p = str(tmp_path / 'events-000.jsonl')
+    sink = EventSink(p, static={'host': 0})
+    jit = _FakeJit()
+    col = StepCollector(sink, 'train', imgs_per_step=4, jitted=jit,
+                        epoch=0)
+    for i, _ in enumerate(col.wrap(range(3))):
+        if i == 0:
+            jit.size = 1                   # first step traces + compiles
+        time.sleep(0.002)
+        col.end_step(step=i + 1)
+    sink.close()
+    steps = [e for e in _read(p) if e['event'] == 'step']
+    assert [e['step'] for e in steps] == [1, 2, 3]
+    assert steps[0].get('compile') is True
+    assert all('compile' not in e for e in steps[1:])
+    assert all(e['imgs'] == 4 and e['kind'] == 'train'
+               and e['epoch'] == 0 and e['dur_s'] > 0
+               and e['data_wait_s'] >= 0 for e in steps)
+    assert col.n_compile == 1 and col.compile_s == pytest.approx(
+        steps[0]['dur_s'], abs=1e-6)
+    ips, frac = col.interval_stats()
+    assert ips > 0 and 0 <= frac < 1
+
+
+# --------------------------------------------------------------- watchdog
+def test_watchdog_fires_on_seeded_stall(tmp_path):
+    """A step that stops heartbeating past the deadline produces ONE
+    structured stall event carrying every thread's Python stack — the
+    run reports the hang instead of dying silently."""
+    p = str(tmp_path / 'events-000.jsonl')
+    sink = EventSink(p, static={'host': 0})
+    wd = StallWatchdog(sink, min_deadline_s=0.15, factor=10.0, poll_s=0.03)
+    wd.start()
+    try:
+        # one completed step ends the first-compile grace window; then the
+        # next step stops heartbeating
+        wd.beat(dur_s=0.01, step=42)
+        time.sleep(0.7)                    # the seeded stall
+    finally:
+        wd.stop()
+        sink.close()
+    stalls = [e for e in _read(p) if e['event'] == 'stall']
+    assert len(stalls) == 1                # fires once per missed beat
+    st = stalls[0]
+    assert st['step'] == 42
+    assert st['elapsed_s'] >= st['deadline_s'] == pytest.approx(0.15)
+    # the dump includes the stalled main thread, stuck in time.sleep here
+    assert 'test_watchdog_fires_on_seeded_stall' in st['stacks']
+    assert 'MainThread' in st['stacks']
+    assert wd.stall_count == 1
+
+
+def test_watchdog_quiet_while_heartbeating(tmp_path):
+    p = str(tmp_path / 'events-000.jsonl')
+    sink = EventSink(p, static={'host': 0})
+    wd = StallWatchdog(sink, min_deadline_s=0.3, poll_s=0.03)
+    wd.start()
+    try:
+        for _ in range(8):
+            wd.beat(dur_s=0.01)
+            time.sleep(0.05)
+    finally:
+        wd.stop()
+        sink.close()
+    assert [e for e in _read(p) if e['event'] == 'stall'] == []
+    # adaptive deadline: median-of-durs scaling never undercuts the floor
+    assert wd.deadline_s() == pytest.approx(0.3)
+    # before any step completes, the deadline is the compile grace: a
+    # first XLA compile longer than min_deadline_s must not read as a
+    # stall (no heartbeat is possible while the host sits in trace+compile)
+    fresh = StallWatchdog(None, min_deadline_s=0.3, compile_grace_s=900.0)
+    assert fresh.deadline_s() == pytest.approx(900.0)
+
+
+# --------------------------------------------- trainer-backed shared run
+@pytest.fixture(scope='module')
+def run_dir(tmp_path_factory):
+    """One 2-epoch synthetic FastSCNN run with segscope on (the defaults):
+    the JSONL under save_dir/segscope feeds the goodput + CLI tests."""
+    from rtseg_tpu.config import SegConfig
+    from rtseg_tpu.train import SegTrainer
+    save = str(tmp_path_factory.mktemp('segscope') / 'save')
+    cfg = SegConfig(dataset='synthetic', model='fastscnn', num_class=5,
+                    crop_size=32, train_bs=1, val_bs=1, total_epoch=2,
+                    val_interval=1, compute_dtype='float32',
+                    save_dir=save, use_tb=False, use_ema=True,
+                    base_workers=0, log_interval=2)
+    cfg.resolve()
+    SegTrainer(cfg).run()
+    return save
+
+
+def test_goodput_math_on_two_epoch_run(run_dir):
+    obs_dir = os.path.join(run_dir, 'segscope')
+    events = load_events(obs_dir)
+    s = summarize(events)
+    # 2 epochs x iters_per_epoch train steps, exactly one paid the compile
+    assert s['train_steps'] > 0 and s['train_steps'] % 2 == 0
+    assert s['epochs'] == 2
+    train_compiles = [e for e in events if e.get('event') == 'step'
+                      and e.get('kind') == 'train' and e.get('compile')]
+    assert len(train_compiles) == 1        # step 1; no silent retraces
+    assert s['compile_s'] > 0
+    # goodput = productive step time / end-to-end wall: a real fraction
+    assert 0 < s['goodput'] < 1
+    assert s['step_p50_s'] > 0 and s['step_p95_s'] >= s['step_p50_s']
+    assert s['imgs_per_sec'] > 0
+    assert 0 <= s['data_wait_frac'] < 1
+    assert s['stalls'] == 0
+    assert s['wall_s'] > 0
+    # val loops (2 epoch validates + val_best) emitted val step events
+    assert s['val_steps'] >= 3
+
+
+def test_run_wires_spans_through_loader_and_checkpoints(run_dir):
+    events = load_events(os.path.join(run_dir, 'segscope'))
+    names = {e['name'] for e in events if e['event'] == 'span'}
+    # producer-side loader spans and checkpoint spans ride the same sink
+    assert 'data/produce' in names
+    assert 'ckpt/save' in names
+    assert 'val/readback' in names
+    kinds = {e['event'] for e in events}
+    assert {'run_start', 'run_end', 'step', 'epoch'} <= kinds
+
+
+def _segscope_main():
+    sys.path.insert(0, path.join(ROOT, 'tools'))
+    try:
+        from segscope import main
+    finally:
+        sys.path.pop(0)
+    return main
+
+
+def test_report_cli_on_run(run_dir, capsys):
+    """One true subprocess run proves the CLI works from a bare shell (and
+    without jax); the other modes exercise main() in-process."""
+    obs_dir = os.path.join(run_dir, 'segscope')
+    r = subprocess.run(
+        [sys.executable, path.join(ROOT, 'tools', 'segscope.py'),
+         'report', obs_dir, '--check'],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr[-2000:]
+    for needle in ('step p50', 'imgs/sec', 'data-wait', 'goodput',
+                   'compile', 'stalls', 'segscope check OK'):
+        assert needle in r.stdout, r.stdout
+    # machine-readable mode emits parseable JSON with the same keys
+    main = _segscope_main()
+    assert main(['report', obs_dir, '--json']) == 0
+    s = json.loads(capsys.readouterr().out)
+    assert s['goodput'] > 0 and s['stalls'] == 0
+
+
+def test_diff_cli_self_comparison_has_no_regressions(run_dir, capsys):
+    obs_dir = os.path.join(run_dir, 'segscope')
+    main = _segscope_main()
+    assert main(['diff', obs_dir, obs_dir]) == 0
+    out = capsys.readouterr().out
+    assert 'goodput' in out
+    assert 'REGRESSED' not in out          # a run never regresses itself
+
+
+def test_report_cli_missing_run_exits_2(tmp_path):
+    main = _segscope_main()
+    assert main(['report', str(tmp_path / 'nope')]) == 2
+
+
+def test_flush_tb_one_batched_readback_per_interval(monkeypatch):
+    """The TB satellite: an interval's buffered device scalars reach the
+    writer through ONE jax.device_get (was a per-scalar pull per step),
+    and every buffered step still gets its own TB point."""
+    import jax
+    import jax.numpy as jnp
+    from rtseg_tpu.train.trainer import SegTrainer
+
+    t = SegTrainer.__new__(SegTrainer)     # only _flush_tb's deps needed
+    calls = []
+
+    class _W:
+        def add_scalars(self, scalars, step):
+            calls.append((dict(scalars), step))
+
+    t.writer = _W()
+    buf = [(i + 1, {'loss': jnp.float32(i), 'loss_kd': jnp.float32(2 * i)})
+           for i in range(3)]
+    n = {'gets': 0}
+    real = jax.device_get
+
+    def counting_get(x):
+        n['gets'] += 1
+        return real(x)
+
+    monkeypatch.setattr(jax, 'device_get', counting_get)
+    t._flush_tb(buf)
+    assert n['gets'] == 1                  # one batched transfer, 3 steps
+    assert [step for _, step in calls] == [1, 2, 3]
+    assert calls[1][0]['train/loss'] == pytest.approx(1.0)
+    assert calls[1][0]['train/loss_kd'] == pytest.approx(2.0)
+    assert calls[1][0]['train/loss_total'] == pytest.approx(1.0)
+    assert buf == []                       # interval buffer drained
+    t._flush_tb([])                        # empty flush is a no-op
+
+
+# ---------------------------------------------------------- obs-purity lint
+def _write(root, relpath, text):
+    p = os.path.join(str(root), relpath)
+    os.makedirs(os.path.dirname(p), exist_ok=True)
+    with open(p, 'w') as f:
+        f.write(textwrap.dedent(text))
+
+
+def test_obs_purity_real_tree_clean():
+    assert run_lints(REPO, rules=[RULE_OBS]) == []
+
+
+def test_obs_purity_catches_span_in_jitted_code(tmp_path):
+    _write(tmp_path, 'rtseg_tpu/ops/bad.py', '''
+        import jax
+        from rtseg_tpu import obs
+
+        @jax.jit
+        def fwd(x):
+            with obs.span('fwd'):
+                return x * 2
+        ''')
+    fs = check_obs_purity(str(tmp_path))
+    assert [f.rule for f in fs] == [RULE_OBS]
+    assert fs[0].path == 'rtseg_tpu/ops/bad.py'
+    assert 'obs.span' in fs[0].message
+
+
+def test_obs_purity_catches_member_import_in_reachable_helper(tmp_path):
+    # the violation sits in a helper only *reachable* from a jit root,
+    # imported member-style — the reachability walk + ImportFrom tracking
+    _write(tmp_path, 'rtseg_tpu/ops/bad2.py', '''
+        import jax
+        from ..obs import span
+
+        def helper(x):
+            with span('inner'):
+                return x + 1
+
+        def root(x):
+            return helper(x)
+
+        run = jax.jit(root)
+        ''')
+    fs = check_obs_purity(str(tmp_path))
+    assert [f.rule for f in fs] == [RULE_OBS]
+    assert 'span' in fs[0].message
+
+
+def test_obs_purity_allows_host_side_use(tmp_path):
+    # same APIs outside any jit-reachable function: clean
+    _write(tmp_path, 'rtseg_tpu/ops/ok.py', '''
+        from rtseg_tpu import obs
+
+        def host_loop(step_fn, batches):
+            for b in batches:
+                with obs.span('step'):
+                    step_fn(b)
+        ''')
+    assert check_obs_purity(str(tmp_path)) == []
+
+
+def test_obs_purity_suppression(tmp_path):
+    _write(tmp_path, 'rtseg_tpu/ops/sup.py', '''
+        import jax
+        from rtseg_tpu import obs
+
+        @jax.jit
+        def fwd(x):
+            with obs.span('fwd'):  # segcheck: disable=obs-purity
+                return x
+        ''')
+    assert check_obs_purity(str(tmp_path)) == []

@@ -275,7 +275,21 @@ def main() -> int:
                     help='override the per-chip peak FLOP/s used for MFU '
                          '(required on device kinds not in '
                          'PEAK_BF16_BY_KIND)')
+    ap.add_argument('--obs-dir', default=None,
+                    help='segscope: write bench_result events (and the '
+                         'fenced_throughput block spans) as JSONL under '
+                         'this dir, readable by tools/segscope.py')
     args = ap.parse_args()
+
+    sink = None
+    if args.obs_dir:
+        from rtseg_tpu import obs
+        sink = obs.init_run(args.obs_dir,
+                            meta={'tool': 'benchmark_all',
+                                  'models': args.models,
+                                  'batch': args.batch,
+                                  'imgh': args.imgh, 'imgw': args.imgw})
+        obs.set_sink(sink)
 
     BENCH_S2D['on'] = args.s2d
     BENCH_S2D['segnet_pack'] = args.segnet_pack
@@ -316,6 +330,12 @@ def main() -> int:
             'vs_baseline': round(ips / base, 3) if comparable else None,
             'mfu': round(mfu, 4) if mfu is not None else None,
         }), flush=True)
+        if sink is not None:
+            sink.emit({'event': 'bench_result', 'model': name,
+                       'mode': kind, 'imgs_per_sec': round(ips, 2),
+                       'batch': args.batch, 'imgh': args.imgh,
+                       'imgw': args.imgw, 'device_kind': device_kind,
+                       'mfu': round(mfu, 4) if mfu is not None else None})
 
     print(f'\n| model | {kind} imgs/sec/chip ({device_kind}, '
           f'bs{args.batch}) | ref FPS (RTX 2080, bs1) | speedup | MFU |')

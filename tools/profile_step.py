@@ -205,8 +205,22 @@ def main():
                     help='aggregate an existing trace only')
     ap.add_argument('--inspect', action='store_true',
                     help='dump the longest raw events and exit')
+    ap.add_argument('--obs-dir', default=None,
+                    help='segscope: write a profile event (model, '
+                         'ms/iter, trace dir, module shares) as JSONL '
+                         'under this dir, readable by tools/segscope.py')
     args = ap.parse_args()
     trace_dir = args.trace_dir or f'/tmp/rtseg_profile/{args.model}'
+
+    sink = None
+    if args.obs_dir:
+        from rtseg_tpu import obs
+        sink = obs.init_run(args.obs_dir,
+                            meta={'tool': 'profile_step',
+                                  'model': args.model,
+                                  'batch': args.batch,
+                                  'imgh': args.imgh, 'imgw': args.imgw})
+        obs.set_sink(sink)
 
     if not args.no_capture and not args.inspect:
         os.makedirs(trace_dir, exist_ok=True)
@@ -225,6 +239,14 @@ def main():
         print(f'| {mod} | {dur / 1000 / args.iters:.2f} | '
               f'{100 * dur / total:.1f}% |')
     print(f'| TOTAL | {total / 1000 / args.iters:.2f} | 100% |')
+    if sink is not None:
+        sink.emit({'event': 'profile', 'model': args.model,
+                   'mode': 'eval' if args.eval else 'train',
+                   'iters': args.iters, 'trace_dir': trace_dir,
+                   'ms_per_iter': round(total / 1000 / args.iters, 3),
+                   'module_shares': {
+                       (mod or '(unattributed)'): round(dur / total, 4)
+                       for mod, dur in rows.most_common(20)}})
     return 0
 
 

@@ -15,6 +15,8 @@ Rules (suppress one finding with `# segcheck: disable=<rule>` on its line):
     trace-purity          no print/np.random/time/datetime in jit'd code
     evidence-citation     measurement claims cite real BENCHMARKS.md
                           headings or committed logs
+    obs-purity            no host-side segscope (rtseg_tpu.obs) calls in
+                          jit-reachable code
 
 Audit: jax.eval_shape sweep of every registry model (aux/detail variants
 included) asserting the [B, H, W, num_class] eval contract — no weights

@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""segscope — run-report CLI over the obs/ JSONL telemetry.
+
+Reads the per-host event streams a run wrote under config.obs_dir
+(default save_dir/segscope) and prints the step-time/goodput breakdown, or
+compares two runs as a regression table. Pure stdlib+numpy: works on
+machines without jax (e.g. a laptop holding synced run dirs).
+
+Usage:
+    python tools/segscope.py report save/segscope
+    python tools/segscope.py report save/segscope --json
+    python tools/segscope.py report save/segscope --check   # CI gate:
+                                        # goodput > 0 and 0 stalls, else 1
+    python tools/segscope.py report save/segscope --all-runs
+    python tools/segscope.py diff runA/segscope runB/segscope
+
+Metric definitions live in rtseg_tpu/obs/report.py and BENCHMARKS.md
+("Goodput"). `report` summarizes the segment after the last run_start
+marker (resumes append to the same files); `--all-runs` keeps everything.
+
+Exit codes: 0 ok, 1 --check failed / regression, 2 usage or missing run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from rtseg_tpu.obs.report import (diff_table, format_summary,  # noqa: E402
+                                  load_events, summarize)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog='segscope', description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = ap.add_subparsers(dest='cmd', required=True)
+
+    rp = sub.add_parser('report', help='summarize one run')
+    rp.add_argument('path', help='obs dir (events-*.jsonl) or one file')
+    rp.add_argument('--json', action='store_true',
+                    help='machine-readable summary')
+    rp.add_argument('--all-runs', action='store_true',
+                    help='include events before the last run_start')
+    rp.add_argument('--check', action='store_true',
+                    help='exit 1 unless goodput > 0, stalls == 0 and at '
+                         'least one train step was recorded')
+
+    dp = sub.add_parser('diff', help='compare two runs (A=baseline, B=new)')
+    dp.add_argument('a')
+    dp.add_argument('b')
+    dp.add_argument('--json', action='store_true')
+    args = ap.parse_args(argv)
+
+    try:
+        if args.cmd == 'report':
+            events = load_events(args.path, last_run=not args.all_runs)
+            s = summarize(events)
+            if args.json:
+                print(json.dumps(s, indent=2, default=str))
+            else:
+                print(format_summary(s, args.path))
+            if args.check:
+                ok = (s['goodput'] > 0 and s['stalls'] == 0
+                      and s['train_steps'] > 0)
+                if not ok:
+                    print(f'segscope check FAILED: '
+                          f'goodput={s["goodput"]:.4f} '
+                          f'stalls={s["stalls"]} '
+                          f'train_steps={s["train_steps"]}',
+                          file=sys.stderr)
+                    return 1
+                print(f'segscope check OK: goodput='
+                      f'{100 * s["goodput"]:.1f}% > 0, 0 stalls')
+            return 0
+
+        sa = summarize(load_events(args.a))
+        sb = summarize(load_events(args.b))
+        if args.json:
+            print(json.dumps({'a': sa, 'b': sb}, indent=2, default=str))
+        else:
+            print(f'segscope diff — A: {args.a}  B: {args.b}')
+            print(diff_table(sa, sb))
+        return 0
+    except FileNotFoundError as e:
+        print(f'segscope: {e}', file=sys.stderr)
+        return 2
+
+
+if __name__ == '__main__':
+    sys.exit(main())
