@@ -23,8 +23,13 @@ from typing import Dict, List, Optional, Set, Tuple
 
 from .core import Finding, RULE_TRACE, SourceFile, iter_python_files
 
-#: files whose functions may end up inside a jax trace
-TARGET_PREFIXES = ('rtseg_tpu/train/step.py', 'rtseg_tpu/ops/')
+#: files whose functions may end up inside a jax trace. serve/ is covered
+#: so the serving subsystem's host-side queue/telemetry code (wall clocks,
+#: locks, event emission) can never leak into a jit-reachable inference
+#: path — a serving engine that times or logs inside its traced forward
+#: would bake trace-time values into every compiled bucket executable.
+TARGET_PREFIXES = ('rtseg_tpu/train/step.py', 'rtseg_tpu/ops/',
+                   'rtseg_tpu/serve/')
 
 #: call names (last dotted segment) that receive functions destined for
 #: tracing — a function passed by name into one of these is a jit root

@@ -31,3 +31,17 @@ class TestFolder:
         image = np.asarray(Image.open(self.images[index]).convert('RGB'))
         aug = self.transform(image, None, rng)
         return image, aug, self.img_names[index]
+
+    def shape(self, index: int):
+        """Post-transform (h, w) from the image header alone — PIL reads
+        metadata lazily, so no pixel decode. Mirrors EvalTransform's
+        only shape-changing step for this dataset (transforms.scale,
+        which truncates with int()). Lets callers discover the bucket
+        set of a whole folder without holding any image in memory
+        (SegTrainer.predict's streaming dispatch)."""
+        with Image.open(self.images[index]) as im:
+            w, h = im.size
+        factor = self.transform.config.scale
+        if factor != 1.0:
+            h, w = int(h * factor), int(w * factor)
+        return h, w

@@ -107,6 +107,50 @@ def summarize(events: List[dict]) -> Dict[str, Any]:
     waits = [float(e.get('data_wait_s', 0.0)) for e in tsteps]
     busy = float(sum(e['dur_s'] for e in tsteps)) + sum(waits)
 
+    # serving section: request/batch events from the segserve pipeline
+    # (rtseg_tpu/serve). Counts come from every host; latency percentiles
+    # from all hosts too — request timings are durations, not clock
+    # readings, so cross-host mixing is sound.
+    reqs = [e for e in events if e.get('event') == 'request']
+    batches = [e for e in events if e.get('event') == 'batch']
+    serving: Optional[Dict[str, Any]] = None
+    if reqs:
+        okr = [e for e in reqs if e.get('status', 'ok') == 'ok']
+        e2e = np.asarray([float(e['e2e_ms']) for e in okr
+                          if 'e2e_ms' in e], np.float64)
+        ts_r = [e['ts'] for e in reqs if 'ts' in e]
+        window = (max(ts_r) - min(ts_r)) if len(ts_r) > 1 else 0.0
+
+        def _pct(q):
+            return float(np.percentile(e2e, q)) if e2e.size else None
+
+        stage_means = {}
+        for key in ('queue_ms', 'assemble_ms', 'device_ms', 'post_ms',
+                    'decode_ms'):
+            vals = [float(e[key]) for e in okr if key in e]
+            if vals:
+                stage_means[key] = round(float(np.mean(vals)), 3)
+        sizes = np.asarray([int(e.get('size', 0)) for e in batches],
+                           np.float64)
+        caps = np.asarray([max(int(e.get('cap', 1)), 1) for e in batches],
+                          np.float64)
+        serving = {
+            'requests': len(reqs),
+            'ok': len(okr),
+            'dropped': len([e for e in reqs
+                            if e.get('status') == 'dropped']),
+            'rejected': len([e for e in reqs
+                             if e.get('status') == 'rejected']),
+            'rps': len(okr) / window if window > 0 else 0.0,
+            'e2e_p50_ms': _pct(50), 'e2e_p95_ms': _pct(95),
+            'e2e_p99_ms': _pct(99),
+            'stage_mean_ms': stage_means,
+            'batches': len(batches),
+            'mean_batch': float(sizes.mean()) if sizes.size else 0.0,
+            'occupancy': (float((sizes / caps).mean()) if sizes.size
+                          else 0.0),
+        }
+
     spans: Dict[str, Dict[str, float]] = {}
     for e in events:
         if e.get('event') != 'span' or not mine(e):
@@ -137,6 +181,10 @@ def summarize(events: List[dict]) -> Dict[str, Any]:
         'wall_s': wall,
         'epochs': len([e for e in events if e.get('event') == 'epoch'
                        and e.get('kind') == 'train' and mine(e)]),
+        'serving': serving,
+        # flattened for diff_table's flat-key rows
+        'serve_p99_ms': serving['e2e_p99_ms'] if serving else None,
+        'serve_rps': serving['rps'] if serving else None,
         'spans': spans,
         'memory': ({k: v for k, v in memory.items()
                     if k not in ('event', 'ts', 'host')}
@@ -168,6 +216,28 @@ def format_summary(s: Dict[str, Any], path: str = '') -> str:
         f'  stalls         : {s["stalls"]}',
         f'  wall           : {s["wall_s"]:.1f} s',
     ]
+    if s.get('serving'):
+        sv = s['serving']
+
+        def _m(v):
+            return f'{v:.1f}' if v is not None else '—'
+
+        lines += [
+            f'  serving        : {sv["ok"]}/{sv["requests"]} ok | '
+            f'drops {sv["dropped"]} | rejects {sv["rejected"]} | '
+            f'{sv["rps"]:.1f} rps',
+            f'  request p50/p95/p99 : {_m(sv["e2e_p50_ms"])} / '
+            f'{_m(sv["e2e_p95_ms"])} / {_m(sv["e2e_p99_ms"])} ms',
+        ]
+        st = sv.get('stage_mean_ms', {})
+        if st:
+            lines.append('  stage means    : ' + ' | '.join(
+                f'{k[:-3]} {v:.1f}ms' for k, v in st.items()))
+        if sv['batches']:
+            lines.append(
+                f'  batching       : {sv["batches"]} batches | mean size '
+                f'{sv["mean_batch"]:.1f} | occupancy '
+                f'{100 * sv["occupancy"]:.0f}%')
     if s.get('memory'):
         mem = s['memory']
         parts = [f'{k}={v / 2**20:.0f}MiB' for k, v in mem.items()
@@ -190,6 +260,9 @@ _DIFF_ROWS = (
     ('goodput', 'goodput (%)', 100.0, True),
     ('compile_s', 'compile (s)', 1.0, False),
     ('stalls', 'stalls', 1.0, False),
+    # serving rows (None — rendered as '—' — for training-only runs)
+    ('serve_p99_ms', 'serve p99 (ms)', 1.0, False),
+    ('serve_rps', 'serve RPS', 1.0, True),
 )
 
 #: relative change beyond which a worse metric is labeled a regression

@@ -1,0 +1,37 @@
+"""segserve — the online inference-serving subsystem.
+
+Layers (each its own module, composable and separately testable):
+
+  * :mod:`engine`   — ServeEngine: shape-bucketed AOT executables with the
+    recompile guard armed over the sealed executable table;
+  * :mod:`batcher`  — MicroBatcher: bounded queue, max_batch/max_wait_ms
+    coalescing, deadline drops, admission backpressure;
+  * :mod:`pipeline` — ServePipeline: preprocess/postprocess thread pools
+    double-buffered against device compute;
+  * :mod:`server`   — stdlib ThreadingHTTPServer front-end
+    (POST image -> mask; /healthz, /stats);
+  * :mod:`loadgen`  — open-loop Poisson load generator + SLO gate
+    (tools/segserve.py bench).
+
+Everything here is host-side; the trace-purity and obs-purity lints
+(analysis/lint_trace.py TARGET_PREFIXES) gate this package so queue code
+and telemetry can never leak into the jit-reachable inference path.
+"""
+
+from .batcher import MicroBatcher, Request, ServeDrop, ServeReject
+from .engine import (Bucket, ServeEngine, UnknownBucket, assemble_batch,
+                     parse_buckets, select_bucket)
+from .loadgen import (bench_http, bench_pipeline, bench_sequential,
+                      check_report, encode_png, format_report, synth_images)
+from .pipeline import ServePipeline, ServeResult
+from .server import ServeHTTPServer, make_preprocess, make_server
+
+__all__ = [
+    'Bucket', 'ServeEngine', 'UnknownBucket', 'assemble_batch',
+    'parse_buckets', 'select_bucket',
+    'MicroBatcher', 'Request', 'ServeDrop', 'ServeReject',
+    'ServePipeline', 'ServeResult',
+    'ServeHTTPServer', 'make_preprocess', 'make_server',
+    'bench_http', 'bench_pipeline', 'bench_sequential', 'check_report',
+    'encode_png', 'format_report', 'synth_images',
+]

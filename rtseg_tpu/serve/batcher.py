@@ -1,0 +1,253 @@
+"""Dynamic micro-batcher: a bounded request queue that coalesces requests
+into bucket-homogeneous batches under a max_batch / max_wait_ms policy.
+
+The online-serving counterpart of the training loader's prefetch queue.
+Three failure modes of naive serving queues are handled structurally:
+
+  * **unbounded latency collapse** — admission is rejected (ServeReject)
+    when the queue is full, so overload surfaces as fast 503s at the edge
+    instead of a queue whose wait grows without bound;
+  * **serving stale work** — each request can carry a deadline; requests
+    whose deadline passed while queued are dropped (ServeDrop) at
+    dequeue time rather than occupying a batch slot to compute an answer
+    nobody is waiting for;
+  * **head-of-line blocking across shapes** — one FIFO per bucket; the
+    dispatcher always serves the bucket whose head request is oldest, so
+    a burst of large-shape traffic cannot starve small-shape requests of
+    their latency budget indefinitely.
+
+Every formed batch emits a ``batch`` event and every terminal request
+outcome a ``request`` event into the process-global segscope sink
+(rtseg_tpu/obs), which is how ``tools/segscope.py report`` grows a serving
+section for free. All host-side code — the obs-purity lint keeps it (and
+everything else in serve/) out of jit-reachable paths.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..obs import get_sink
+from .engine import Bucket, UnknownBucket, select_bucket
+
+
+class ServeReject(RuntimeError):
+    """Admission rejected: the request queue is full (backpressure)."""
+
+
+class ServeDrop(RuntimeError):
+    """Request dropped: its deadline passed while it waited in queue."""
+
+
+@dataclass
+class Request:
+    image: np.ndarray                     # (h, w, 3) f32, preprocessed
+    hw: Tuple[int, int]
+    bucket: Bucket
+    future: Future
+    t_submit: float                       # perf_counter at admission
+    deadline: Optional[float] = None      # absolute perf_counter deadline
+    t_popped: Optional[float] = None      # perf_counter at batch assembly
+    meta: Dict[str, Any] = field(default_factory=dict)
+
+
+def _bucket_str(b: Bucket) -> str:
+    return f'{b[0]}x{b[1]}'
+
+
+class MicroBatcher:
+    """Thread-safe bounded queue with per-bucket coalescing.
+
+    Producers call :meth:`submit` (any thread); one consumer loop calls
+    :meth:`get_batch`. A batch is released when its bucket holds
+    ``max_batch`` requests, or when the bucket's oldest request has waited
+    ``max_wait_ms`` — latency-bounded coalescing, not full-batch-or-bust.
+    """
+
+    def __init__(self, buckets: Sequence[Bucket], max_batch: int,
+                 max_wait_ms: float = 5.0, max_queue: int = 128,
+                 deadline_ms: Optional[float] = None):
+        self.buckets = sorted({tuple(b) for b in buckets})
+        self.max_batch = int(max_batch)
+        self.max_wait_ms = float(max_wait_ms)
+        self.max_queue = int(max_queue)
+        self.deadline_ms = deadline_ms
+        self._queues: Dict[Bucket, deque] = {b: deque()
+                                             for b in self.buckets}
+        self._cond = threading.Condition()
+        self._closed = False
+        # counters (all under the condition's lock)
+        self.submitted = 0
+        self.rejected = 0
+        self.dropped = 0
+        self.batches = 0
+        self.batched_requests = 0
+        self.padded_slots = 0
+
+    # ------------------------------------------------------------ producer
+    def submit(self, image: np.ndarray,
+               deadline_ms: Optional[float] = None,
+               meta: Optional[Dict[str, Any]] = None) -> Future:
+        """Admit one preprocessed image; returns a Future resolving to the
+        consumer-side result. Raises UnknownBucket when no bucket fits and
+        ServeReject when the queue is full or the batcher is closed."""
+        h, w = int(image.shape[0]), int(image.shape[1])
+        bucket = select_bucket(self.buckets, h, w)
+        if bucket is None:
+            raise UnknownBucket(
+                f'no bucket fits {h}x{w}; configured: '
+                + ','.join(_bucket_str(b) for b in self.buckets))
+        now = time.perf_counter()
+        dl_ms = self.deadline_ms if deadline_ms is None else deadline_ms
+        req = Request(
+            image=image, hw=(h, w), bucket=bucket, future=Future(),
+            t_submit=now,
+            deadline=(now + dl_ms / 1e3) if dl_ms is not None else None,
+            meta=dict(meta or {}))
+        with self._cond:
+            if self._closed:
+                raise ServeReject('batcher is closed')
+            depth = sum(len(q) for q in self._queues.values())
+            if depth >= self.max_queue:
+                self.rejected += 1
+            else:
+                depth = -1
+                self.submitted += 1
+                self._queues[bucket].append(req)
+                self._cond.notify_all()
+        # event emission (file write + flush) stays off the lock: every
+        # admitting thread would otherwise serialize on disk latency
+        if depth >= 0:
+            self._emit_request(req, 'rejected', now)
+            raise ServeReject(
+                f'queue full ({depth}/{self.max_queue}); retry later')
+        return req.future
+
+    # ------------------------------------------------------------ consumer
+    def get_batch(self, timeout: Optional[float] = None
+                  ) -> Optional[Tuple[Bucket, List[Request]]]:
+        """Block until a batch is ready (or ``timeout`` elapses / the
+        batcher is closed and drained — both return None). Expired
+        requests are dropped here, at dequeue time. Queue state changes
+        happen under the lock (_poll_locked); event emission and future
+        resolution — file I/O and arbitrary done-callbacks — happen
+        outside it."""
+        overall = (time.perf_counter() + timeout) if timeout is not None \
+            else None
+        while True:
+            dropped, batch, done = self._poll_locked(overall)
+            now = time.perf_counter()
+            for r in dropped:
+                self._emit_request(r, 'dropped', now)
+                r.future.set_exception(ServeDrop(
+                    f'deadline exceeded after '
+                    f'{(now - r.t_submit) * 1e3:.1f} ms in queue'))
+            if batch is not None:
+                bucket, reqs, head_age_ms = batch
+                self._emit_batch(bucket, reqs, head_age_ms)
+                return bucket, reqs
+            if done:
+                return None
+
+    def _poll_locked(self, overall: Optional[float]):
+        """One scheduling step under the lock: pop expired requests,
+        release a ready batch, or wait. Returns (dropped_requests,
+        (bucket, requests, head_age_ms) | None, exhausted)."""
+        with self._cond:
+            now = time.perf_counter()
+            dropped: List[Request] = []
+            for q in self._queues.values():
+                while q and q[0].deadline is not None \
+                        and now > q[0].deadline:
+                    dropped.append(q.popleft())
+            self.dropped += len(dropped)
+            bucket = self._oldest_bucket_locked()
+            if bucket is None:
+                if dropped:
+                    # flush the drops before blocking again
+                    return dropped, None, False
+                if self._closed or (overall is not None
+                                    and now >= overall):
+                    return dropped, None, True
+                self._cond.wait(
+                    None if overall is None else overall - now)
+                return dropped, None, False
+            q = self._queues[bucket]
+            head_age_ms = (now - q[0].t_submit) * 1e3
+            if (len(q) >= self.max_batch or self._closed
+                    or head_age_ms >= self.max_wait_ms):
+                reqs = [q.popleft()
+                        for _ in range(min(self.max_batch, len(q)))]
+                for r in reqs:
+                    r.t_popped = now
+                self.batches += 1
+                self.batched_requests += len(reqs)
+                self.padded_slots += self.max_batch - len(reqs)
+                return dropped, (bucket, reqs, head_age_ms), False
+            # sleep until the head ages out, a notify, or the timeout
+            wait_s = (self.max_wait_ms - head_age_ms) / 1e3
+            if overall is not None:
+                wait_s = min(wait_s, overall - now)
+            self._cond.wait(max(wait_s, 1e-4))
+            return dropped, None, False
+
+    def close(self) -> None:
+        """Stop admissions; queued requests still drain via get_batch."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    def fail_all(self, exc: BaseException) -> None:
+        """Resolve every queued request with ``exc`` (engine teardown)."""
+        with self._cond:
+            pending = [r for q in self._queues.values() for r in q]
+            for q in self._queues.values():
+                q.clear()
+            self._cond.notify_all()
+        for r in pending:
+            r.future.set_exception(exc)
+
+    # ------------------------------------------------------------ internal
+    def _oldest_bucket_locked(self) -> Optional[Bucket]:
+        best, best_t = None, None
+        for b, q in self._queues.items():
+            if q and (best_t is None or q[0].t_submit < best_t):
+                best, best_t = b, q[0].t_submit
+        return best
+
+    def _emit_request(self, req: Request, status: str, now: float) -> None:
+        sink = get_sink()
+        if sink is not None:
+            sink.emit({'event': 'request', 'status': status,
+                       'bucket': _bucket_str(req.bucket),
+                       'queue_ms': round((now - req.t_submit) * 1e3, 3)})
+
+    def _emit_batch(self, bucket: Bucket, reqs: List[Request],
+                    head_age_ms: float) -> None:
+        sink = get_sink()
+        if sink is not None:
+            sink.emit({'event': 'batch', 'bucket': _bucket_str(bucket),
+                       'size': len(reqs), 'cap': self.max_batch,
+                       'wait_ms': round(head_age_ms, 3)})
+
+    def stats(self) -> dict:
+        with self._cond:
+            return {
+                'submitted': self.submitted,
+                'rejected': self.rejected,
+                'dropped': self.dropped,
+                'batches': self.batches,
+                'batched_requests': self.batched_requests,
+                'padded_slots': self.padded_slots,
+                'depth': sum(len(q) for q in self._queues.values()),
+                'max_queue': self.max_queue,
+                'max_batch': self.max_batch,
+                'max_wait_ms': self.max_wait_ms,
+            }

@@ -1,0 +1,233 @@
+"""segserve engine: shape-bucketed, AOT-compiled online inference.
+
+The serving counterpart of :mod:`rtseg_tpu.export`: where export produces a
+portable StableHLO artifact, the engine turns either that artifact or a
+checkpoint into a *fixed set* of ready-to-run executables — one per
+configured (H, W) bucket, all at one fixed batch size. Requests are padded
+up to the nearest bucket (spatially) and batches are padded up to the
+bucket's batch (batch dim), so the executable set is sealed at construction
+and can never grow under traffic: the jit-cache-never-grows promise the
+trainer makes per step (analysis/recompile.py), made for serving. The
+RecompileGuard is armed over the executable table itself — any post-init
+compile raises instead of silently eating an XLA compile on the serving
+hot path.
+
+Batch-dim padding is exact: inference-mode forwards (conv / BN with running
+stats / argmax) have no cross-sample ops, and within one executable the
+per-sample results are independent of batch index, so a request's mask does
+not depend on how full its batch was (tests/test_segserve.py pins this).
+Spatial padding is *not* exact for interior pixels of models with global
+context — offline folder prediction therefore buckets by exact image shape
+(train/trainer.py predict), while online serving accepts boundary effects
+as part of the resize contract.
+
+The on-device head matches the export head (export.build_inference_fn):
+channel argmax as int8 — the smallest host readback per pixel.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..analysis.recompile import RecompileError, RecompileGuard
+from ..obs import span
+
+Bucket = Tuple[int, int]
+
+
+class UnknownBucket(ValueError):
+    """No configured bucket fits the request's (h, w)."""
+
+
+def parse_buckets(spec: str) -> List[Bucket]:
+    """'512x1024,256x512' -> [(512, 1024), (256, 512)]."""
+    out: List[Bucket] = []
+    for part in spec.split(','):
+        part = part.strip()
+        if not part:
+            continue
+        h, _, w = part.partition('x')
+        out.append((int(h), int(w)))
+    if not out:
+        raise ValueError(f'no buckets in spec {spec!r}')
+    return out
+
+
+def select_bucket(buckets: Sequence[Bucket], h: int, w: int
+                  ) -> Optional[Bucket]:
+    """Smallest-area bucket that fits (h, w); None when nothing fits."""
+    fits = [(bh * bw, bh, bw) for bh, bw in buckets if bh >= h and bw >= w]
+    if not fits:
+        return None
+    _, bh, bw = min(fits)
+    return (bh, bw)
+
+
+def assemble_batch(images: Sequence[np.ndarray], bucket: Bucket, batch: int
+                   ) -> np.ndarray:
+    """Stack ``images`` (each (h, w, 3) f32, h<=H, w<=W) into one
+    (batch, H, W, 3) array: zero-pad each image to the bucket spatially,
+    zero-fill the unused batch rows. Zero batch rows cost compute but keep
+    one executable per bucket alive for every partial batch."""
+    if len(images) > batch:
+        raise ValueError(f'{len(images)} requests > bucket batch {batch}')
+    bh, bw = bucket
+    out = np.zeros((batch, bh, bw, 3), np.float32)
+    for i, img in enumerate(images):
+        h, w = img.shape[:2]
+        if h > bh or w > bw:
+            raise UnknownBucket(f'image {h}x{w} exceeds bucket {bh}x{bw}')
+        out[i, :h, :w] = img
+    return out
+
+
+class ServeEngine:
+    """A sealed table of AOT-compiled inference executables.
+
+    ``fn(images: f32[B, H, W, 3]) -> int8[B, H, W]`` is lowered and
+    compiled once per bucket at construction (``pin`` runs before each
+    lowering so process-global trace flags — BN axis, stem packing, head
+    deferral — are this engine's, not a previous builder's). ``dispatch``
+    only looks executables up; the armed RecompileGuard turns any table
+    growth after init into a RecompileError.
+    """
+
+    def __init__(self, fn: Callable, buckets: Sequence[Bucket], batch: int,
+                 name: str = 'serve_engine',
+                 pin: Optional[Callable[[], None]] = None):
+        if not buckets:
+            raise ValueError('ServeEngine needs at least one bucket')
+        if batch < 1:
+            raise ValueError(f'batch must be >= 1, got {batch}')
+        import jax
+        import jax.numpy as jnp
+        self.buckets: List[Bucket] = sorted({(int(h), int(w))
+                                             for h, w in buckets})
+        self.batch = int(batch)
+        self.name = name
+        self._fn = fn
+        self._compiled = {}
+        self._calls = {b: 0 for b in self.buckets}
+        self._images = 0
+        self._retraces = 0        # guard trips observed (see dispatch)
+        jitted = jax.jit(fn)
+        for b in self.buckets:
+            if pin is not None:
+                pin()
+            spec = jax.ShapeDtypeStruct((self.batch, b[0], b[1], 3),
+                                        jnp.float32)
+            with span('serve/compile', bucket=f'{b[0]}x{b[1]}',
+                      batch=self.batch):
+                self._compiled[b] = jitted.lower(spec).compile()
+        # arm the guard over the executable table: _cache_size plays the
+        # role of the jit cache's introspection hook
+        self._cache_size = lambda: len(self._compiled)
+        self.guard = RecompileGuard(name, warmup=1)
+        self.guard.after_call(self)     # baseline = the sealed table
+
+    # ------------------------------------------------------------- running
+    def select(self, h: int, w: int) -> Bucket:
+        b = select_bucket(self.buckets, h, w)
+        if b is None:
+            raise UnknownBucket(
+                f'no bucket fits {h}x{w}; configured: '
+                + ','.join(f'{bh}x{bw}' for bh, bw in self.buckets))
+        return b
+
+    def dispatch(self, bucket: Bucket, images: np.ndarray):
+        """Asynchronously run one padded batch; returns the device array
+        (block with ``np.asarray``). ``images`` must be exactly the
+        bucket's (batch, H, W, 3) f32 shape."""
+        exe = self._compiled.get(tuple(bucket))
+        if exe is None:
+            raise UnknownBucket(f'bucket {bucket} was not compiled')
+        out = exe(images)
+        try:
+            self.guard.after_call(self)
+        except RecompileError:
+            # count before propagating so stats()['retraces'] is a real
+            # observation, not a structurally-zero expression — the
+            # raise still kills the serving path (by design)
+            self._retraces += 1
+            raise
+        self._calls[tuple(bucket)] += 1
+        self._images += int(images.shape[0])
+        return out
+
+    def run(self, bucket: Bucket, images: np.ndarray) -> np.ndarray:
+        """Synchronous ``dispatch`` + host readback."""
+        return np.asarray(self.dispatch(bucket, images))
+
+    def stats(self) -> dict:
+        return {
+            'buckets': [f'{h}x{w}' for h, w in self.buckets],
+            'batch': self.batch,
+            'executables': len(self._compiled),
+            'calls': {f'{h}x{w}': n for (h, w), n in self._calls.items()},
+            'images': self._images,
+            'retraces': self._retraces
+            + max(0, len(self._compiled) - len(self.buckets)),
+        }
+
+    # -------------------------------------------------------- constructors
+    @classmethod
+    def from_config(cls, config, buckets: Sequence[Bucket], batch: int,
+                    ckpt_path: Optional[str] = None, variables=None,
+                    name: str = 'serve_engine') -> 'ServeEngine':
+        """Engine from the configured model: weights from ``variables`` or
+        a checkpoint (random init when neither is given — load-gen only).
+        The inference head is the export head (int8 argmax), so the ckpt
+        and StableHLO paths are the same program."""
+        import jax
+        import jax.numpy as jnp
+        from ..export import build_inference_fn
+        from ..models import get_model
+        from ..nn import set_bn_axis, set_stem_packing
+        from ..ops import set_defer_final_upsample
+
+        model = get_model(config)
+        if variables is None:
+            variables = model.init(
+                jax.random.PRNGKey(0),
+                jnp.zeros((1, 64, 64, 3), jnp.float32), False)
+            if ckpt_path:
+                from ..train.checkpoint import restore_weights
+                p, bs = restore_weights(ckpt_path, variables['params'],
+                                        variables.get('batch_stats', {}))
+                variables = dict(variables, params=p, batch_stats=bs)
+        fn = build_inference_fn(model, variables, config.compute_dtype,
+                                argmax=True)
+        s2d = bool(getattr(config, 's2d_stem', False))
+
+        def pin():
+            # trace-time globals are this engine's for the lowering
+            # (same contract as train/step.py _pin_bn_axis)
+            set_bn_axis(None)
+            set_stem_packing(s2d)
+            set_defer_final_upsample(False)
+
+        return cls(fn, buckets, batch, name=name, pin=pin)
+
+    @classmethod
+    def from_artifact(cls, path: str, batch: Optional[int] = None,
+                      name: str = 'serve_engine') -> 'ServeEngine':
+        """Engine from a serialized ``jax.export`` StableHLO artifact
+        (rtseg_tpu/export.py). The artifact's input aval fixes the bucket;
+        a symbolic batch dimension takes ``batch`` from the caller, a
+        static one must match it."""
+        from ..export import load_exported
+        exported = load_exported(path)
+        aval = exported.in_avals[0]
+        b, h, w = aval.shape[0], aval.shape[1], aval.shape[2]
+        if isinstance(b, int):
+            if batch is not None and batch != b:
+                raise ValueError(
+                    f'artifact {path} was exported at batch {b}, '
+                    f'requested {batch}')
+            batch = b
+        elif batch is None:
+            raise ValueError(
+                f'artifact {path} has a symbolic batch dim; pass batch=')
+        return cls(exported.call, [(int(h), int(w))], int(batch), name=name)

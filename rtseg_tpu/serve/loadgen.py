@@ -1,0 +1,283 @@
+"""Open-loop Poisson load generator + SLO report for segserve.
+
+Closed-loop harnesses (tools/test_speed.py) send the next request when the
+previous one finishes, so the system under test sets its own arrival rate
+and queueing delay is structurally invisible — the classic coordinated-
+omission trap. This generator is open-loop: arrival times are drawn up
+front from a seeded exponential(1/RPS) process and requests are fired on
+that schedule whether or not earlier ones finished, so queue growth under
+overload shows up where it belongs — in the tail latency, the drop count
+and the rejection count (BENCHMARKS.md "Serving latency methodology").
+
+Two targets: in-process (drives a ServePipeline directly) and HTTP
+(drives a running server; per-stage timing comes back in the
+X-Serve-Timing header). ``check_report`` is the CI gate.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .batcher import ServeDrop, ServeReject
+from .engine import Bucket, ServeEngine, assemble_batch, select_bucket
+from .pipeline import ServePipeline
+
+_STAGES = ('queue_ms', 'assemble_ms', 'device_ms', 'post_ms', 'decode_ms')
+
+
+def synth_images(shapes: Sequence[Bucket], seed: int = 0,
+                 per_shape: int = 2) -> List[np.ndarray]:
+    """Deterministic f32 test images (already "preprocessed"), a few per
+    (h, w) so mixed-shape traffic interleaves buckets."""
+    rng = np.random.default_rng(seed)
+    return [rng.standard_normal((h, w, 3)).astype(np.float32)
+            for h, w in shapes for _ in range(per_shape)]
+
+
+def encode_png(image_f32: np.ndarray) -> bytes:
+    """f32 image -> PNG bytes for HTTP-mode payloads."""
+    import io
+    from PIL import Image
+    u8 = np.clip(image_f32 * 64 + 128, 0, 255).astype(np.uint8)
+    buf = io.BytesIO()
+    Image.fromarray(u8).save(buf, format='PNG')
+    return buf.getvalue()
+
+
+def _percentiles(vals: Sequence[float]) -> Dict[str, Optional[float]]:
+    if not vals:
+        return {'p50': None, 'p95': None, 'p99': None}
+    arr = np.asarray(vals, np.float64)
+    p50, p95, p99 = np.percentile(arr, [50, 95, 99])
+    return {'p50': float(p50), 'p95': float(p95), 'p99': float(p99)}
+
+
+def _open_loop_schedule(n: int, rps: float, seed: int) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    return np.cumsum(rng.exponential(1.0 / rps, size=n))
+
+
+def _sleep_until(target: float) -> None:
+    while True:
+        d = target - time.perf_counter()
+        if d <= 0:
+            return
+        time.sleep(min(d, 0.002))
+
+
+def _finalize(report: dict, e2e: List[float],
+              stages: Dict[str, List[float]], ok: int, dropped: int,
+              rejected: int, errors: int, wall_s: float) -> dict:
+    pct = _percentiles(e2e)
+    report.update({
+        'ok': ok, 'dropped': dropped, 'rejected': rejected,
+        'errors': errors,
+        'wall_s': round(wall_s, 3),
+        'rps_achieved': round(ok / wall_s, 2) if wall_s > 0 else 0.0,
+        'e2e_p50_ms': pct['p50'], 'e2e_p95_ms': pct['p95'],
+        'e2e_p99_ms': pct['p99'],
+        'stage_mean_ms': {k: (round(float(np.mean(v)), 3) if v else None)
+                          for k, v in stages.items()},
+    })
+    return report
+
+
+def bench_pipeline(pipeline: ServePipeline, images: Sequence[np.ndarray],
+                   requests: int, rps: float, seed: int = 0,
+                   deadline_ms: Optional[float] = None) -> dict:
+    """Open-loop drive of an in-process pipeline. Returns the report dict
+    (the engine/batcher stats ride along under 'engine'/'batcher')."""
+    arrivals = _open_loop_schedule(requests, rps, seed)
+    order = np.random.default_rng(seed + 1).integers(
+        0, len(images), requests)
+    futures, rejected = [], 0
+    t0 = time.perf_counter()
+    for i in range(requests):
+        _sleep_until(t0 + arrivals[i])
+        try:
+            futures.append(pipeline.submit(images[int(order[i])],
+                                           deadline_ms=deadline_ms))
+        except ServeReject:
+            rejected += 1
+            futures.append(None)
+    e2e: List[float] = []
+    stages: Dict[str, List[float]] = {k: [] for k in _STAGES}
+    ok = dropped = errors = 0
+    for fut in futures:
+        if fut is None:
+            continue
+        try:
+            res = fut.result(timeout=120)
+        except ServeDrop:
+            dropped += 1
+            continue
+        except Exception:   # noqa: BLE001 — counted, reported, gated on
+            errors += 1
+            continue
+        ok += 1
+        e2e.append(res.timings['e2e_ms'])
+        for k in _STAGES:
+            if k in res.timings:
+                stages[k].append(res.timings[k])
+    wall = time.perf_counter() - t0
+    report = {'mode': 'in-process', 'requests': requests,
+              'rps_target': rps,
+              'batcher': pipeline.batcher.stats(),
+              'engine': pipeline.engine.stats()}
+    return _finalize(report, e2e, stages, ok, dropped, rejected, errors,
+                     wall)
+
+
+def bench_http(url: str, payloads: Sequence[bytes], requests: int,
+               rps: float, seed: int = 0, timeout_s: float = 60.0,
+               workers: int = 32) -> dict:
+    """Open-loop drive of a running segserve HTTP server. Client-side e2e
+    latency; the server's own stage decomposition comes back in
+    X-Serve-Timing."""
+    from urllib import error, request as urlreq
+
+    arrivals = _open_loop_schedule(requests, rps, seed)
+    order = np.random.default_rng(seed + 1).integers(
+        0, len(payloads), requests)
+    url = url.rstrip('/') + '/predict'
+
+    def one(i: int, t_sched: float) -> dict:
+        body = payloads[int(order[i])]
+        req = urlreq.Request(url, data=body, method='POST')
+        try:
+            with urlreq.urlopen(req, timeout=timeout_s) as resp:
+                resp.read()
+                timing = json.loads(
+                    resp.headers.get('X-Serve-Timing') or '{}')
+                # e2e is anchored at the SCHEDULED arrival, not worker
+                # pickup: time spent queued in the client's own thread
+                # pool is part of what the user would have waited
+                # (coordinated omission otherwise sneaks back in through
+                # the client)
+                return {'status': 'ok',
+                        'e2e_ms': (time.perf_counter() - t_sched) * 1e3,
+                        'timing': timing}
+        except error.HTTPError as e:
+            e.read()
+            return {'status': {503: 'rejected', 504: 'dropped'}.get(
+                e.code, 'error')}
+        except Exception:   # noqa: BLE001 — connection-level failure
+            return {'status': 'error'}
+
+    results = []
+    t0 = time.perf_counter()
+    with ThreadPoolExecutor(max_workers=workers) as pool:
+        futs = []
+        for i in range(requests):
+            t_sched = t0 + arrivals[i]
+            _sleep_until(t_sched)
+            futs.append(pool.submit(one, i, t_sched))
+        results = [f.result() for f in futs]
+    wall = time.perf_counter() - t0
+    e2e = [r['e2e_ms'] for r in results if r['status'] == 'ok']
+    stages: Dict[str, List[float]] = {k: [] for k in _STAGES}
+    for r in results:
+        for k in _STAGES:
+            if r['status'] == 'ok' and k in r.get('timing', {}):
+                stages[k].append(r['timing'][k])
+    counts = {s: sum(1 for r in results if r['status'] == s)
+              for s in ('ok', 'dropped', 'rejected', 'error')}
+    report = {'mode': 'http', 'url': url, 'requests': requests,
+              'rps_target': rps}
+    return _finalize(report, e2e, stages, counts['ok'], counts['dropped'],
+                     counts['rejected'], counts['error'], wall)
+
+
+def bench_sequential(engine: ServeEngine, images: Sequence[np.ndarray],
+                     requests: int) -> dict:
+    """Closed-loop sequential batch-1 baseline: one request at a time,
+    fully synchronized — the SegTrainer.predict() dispatch pattern before
+    segserve. ``engine`` must have batch == 1."""
+    if engine.batch != 1:
+        raise ValueError('sequential baseline wants a batch-1 engine')
+    order = np.arange(requests) % len(images)
+    t0 = time.perf_counter()
+    for i in order:
+        img = images[int(i)]
+        bucket = select_bucket(engine.buckets, *img.shape[:2])
+        engine.run(bucket, assemble_batch([img], bucket, 1))
+    wall = time.perf_counter() - t0
+    return {'mode': 'sequential-bs1', 'requests': requests,
+            'wall_s': round(wall, 3),
+            'rps_achieved': round(requests / wall, 2) if wall > 0 else 0.0}
+
+
+def check_report(report: dict, p95_ms: float,
+                 expect_buckets: Optional[int] = None) -> List[str]:
+    """CI gate: the list of violated conditions (empty == pass)."""
+    problems = []
+    if report.get('dropped', 0):
+        problems.append(f"{report['dropped']} deadline drops (want 0)")
+    if report.get('rejected', 0):
+        problems.append(f"{report['rejected']} admission rejections "
+                        f"(want 0)")
+    if report.get('errors', 0):
+        problems.append(f"{report['errors']} request errors (want 0)")
+    if report.get('ok', 0) != report.get('requests', 0):
+        problems.append(f"only {report.get('ok', 0)}/"
+                        f"{report.get('requests', 0)} requests completed")
+    p95 = report.get('e2e_p95_ms')
+    if p95 is None or p95 > p95_ms:
+        problems.append(f'e2e p95 {p95} ms > threshold {p95_ms} ms')
+    eng = report.get('engine')
+    if eng is not None:
+        if eng.get('retraces', 0):
+            problems.append(f"{eng['retraces']} retraces (want 0)")
+        if expect_buckets is not None \
+                and eng.get('executables') != expect_buckets:
+            problems.append(
+                f"{eng.get('executables')} executables != "
+                f"{expect_buckets} configured buckets")
+    return problems
+
+
+def format_report(report: dict) -> str:
+    lines = [
+        f"segserve bench — {report['mode']} | "
+        f"{report['requests']} requests @ {report['rps_target']} rps "
+        f"target",
+        f"  completed      : {report['ok']} ok | {report['dropped']} "
+        f"dropped | {report['rejected']} rejected | "
+        f"{report['errors']} errors",
+        f"  achieved       : {report['rps_achieved']} rps over "
+        f"{report['wall_s']} s",
+        f"  e2e p50/p95/p99: {report['e2e_p50_ms'] or float('nan'):.1f} / "
+        f"{report['e2e_p95_ms'] or float('nan'):.1f} / "
+        f"{report['e2e_p99_ms'] or float('nan'):.1f} ms",
+    ]
+    st = report.get('stage_mean_ms', {})
+    parts = [f'{k[:-3]} {v:.1f}' for k, v in st.items() if v is not None]
+    if parts:
+        lines.append('  stage means ms : ' + ' | '.join(parts))
+    eng = report.get('engine')
+    if eng:
+        lines.append(
+            f"  engine         : {eng['executables']} executables over "
+            f"buckets {','.join(eng['buckets'])} x batch {eng['batch']} | "
+            f"retraces {eng['retraces']}")
+    bat = report.get('batcher')
+    if bat and bat.get('batches'):
+        occ = bat['batched_requests'] / (
+            bat['batched_requests'] + bat['padded_slots'])
+        lines.append(
+            f"  batching       : {bat['batches']} batches | "
+            f"mean size {bat['batched_requests'] / bat['batches']:.1f} | "
+            f"occupancy {100 * occ:.0f}%")
+    if 'baseline' in report:
+        base = report['baseline']
+        ratio = (report['rps_achieved'] / base['rps_achieved']
+                 if base.get('rps_achieved') else float('nan'))
+        lines.append(
+            f"  vs sequential  : {base['rps_achieved']} rps closed-loop "
+            f"bs1 -> {ratio:.2f}x")
+    return '\n'.join(lines)

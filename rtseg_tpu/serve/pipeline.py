@@ -1,0 +1,249 @@
+"""Async serving pipeline: preprocess pool -> batcher -> device -> post pool.
+
+Four stages, each its own thread(s), with the device stage double-buffered:
+
+  * **preprocess** — a small thread pool decodes/normalizes request bytes
+    (PIL + EvalTransform live here, never on the dispatch path);
+  * **dispatch** — one thread pulls coalesced batches from the
+    MicroBatcher, pads them to the bucket (engine.assemble_batch), and
+    dispatches the AOT executable asynchronously;
+  * **readback** — one thread blocks on the device result and fans the
+    per-request rows out to the postprocess pool. The dispatch and
+    readback threads talk through a depth-``inflight`` queue (default 2),
+    so while one batch computes on device the next is already assembled
+    and dispatched — the device never waits on PIL, and the bound keeps
+    device-side queueing from hiding overload from the admission check;
+  * **postprocess** — a thread pool crops each mask to its request's
+    original (h, w) and runs the optional ``postprocess`` hook (colormap /
+    PNG encode for the HTTP front-end).
+
+Per-request timing is decomposed into queue / assemble / device / post and
+emitted as one ``request`` event; ``tools/segscope.py report`` renders the
+serving section from these plus the batcher's ``batch`` events.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future, ThreadPoolExecutor
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from ..obs import get_sink, span
+from .batcher import MicroBatcher, Request, _bucket_str
+from .engine import ServeEngine, assemble_batch
+
+_DONE = object()
+
+
+@dataclass
+class ServeResult:
+    """What a request's Future resolves to."""
+    mask: np.ndarray                      # (h, w) int8, cropped
+    timings: Dict[str, float]             # per-stage milliseconds
+    payload: Any = None                   # postprocess() output, if any
+    meta: Dict[str, Any] = field(default_factory=dict)
+
+
+class ServePipeline:
+    """Owns the batcher and the stage threads around a ServeEngine."""
+
+    def __init__(self, engine: ServeEngine,
+                 max_wait_ms: float = 5.0, max_queue: int = 128,
+                 deadline_ms: Optional[float] = None,
+                 preprocess: Optional[Callable[[bytes], np.ndarray]] = None,
+                 postprocess: Optional[Callable[[np.ndarray, Request],
+                                                Any]] = None,
+                 pre_workers: int = 2, post_workers: int = 2,
+                 inflight: int = 2):
+        self.engine = engine
+        self.preprocess = preprocess
+        self.postprocess = postprocess
+        self.batcher = MicroBatcher(engine.buckets, engine.batch,
+                                    max_wait_ms=max_wait_ms,
+                                    max_queue=max_queue,
+                                    deadline_ms=deadline_ms)
+        self._pre = ThreadPoolExecutor(max_workers=max(1, pre_workers),
+                                       thread_name_prefix='segserve-pre')
+        self._post = ThreadPoolExecutor(max_workers=max(1, post_workers),
+                                        thread_name_prefix='segserve-post')
+        self._inflight: queue.Queue = queue.Queue(maxsize=max(1, inflight))
+        self._lock = threading.Lock()
+        self._ok = 0
+        self._errors = 0
+        self._closing = False
+        self._closed = False
+        self.error: Optional[BaseException] = None
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, daemon=True,
+            name='segserve-dispatch')
+        self._reader = threading.Thread(
+            target=self._readback_loop, daemon=True,
+            name='segserve-readback')
+        self._dispatcher.start()
+        self._reader.start()
+
+    # ------------------------------------------------------------- ingress
+    def submit(self, image: np.ndarray,
+               deadline_ms: Optional[float] = None,
+               meta: Optional[Dict[str, Any]] = None) -> Future:
+        """Admit one already-preprocessed (h, w, 3) f32 image."""
+        if self.error is not None:
+            raise RuntimeError('serve pipeline is dead') from self.error
+        return self.batcher.submit(image, deadline_ms=deadline_ms,
+                                   meta=meta)
+
+    def submit_bytes(self, data: bytes,
+                     deadline_ms: Optional[float] = None,
+                     meta: Optional[Dict[str, Any]] = None) -> Future:
+        """Admit raw request bytes; decode/normalize runs on the
+        preprocess pool, then the result chains into :meth:`submit`. The
+        returned Future resolves to the same ServeResult (with a
+        ``decode_ms`` timing added) or raises the admission error."""
+        if self.preprocess is None:
+            raise RuntimeError('pipeline built without a preprocess fn')
+        outer: Future = Future()
+        t_recv = time.perf_counter()
+
+        def _chain(inner: Future) -> None:
+            try:
+                outer.set_result(inner.result())
+            except BaseException as e:   # noqa: BLE001 — mirror verbatim
+                outer.set_exception(e)
+
+        def _decode() -> None:
+            try:
+                with span('serve/decode', record=False):
+                    image = self.preprocess(data)
+                m = dict(meta or {})
+                m['t_recv'] = t_recv
+                m['decode_ms'] = (time.perf_counter() - t_recv) * 1e3
+                inner = self.submit(image, deadline_ms=deadline_ms, meta=m)
+            except BaseException as e:   # noqa: BLE001 — mirror verbatim
+                outer.set_exception(e)
+                return
+            inner.add_done_callback(_chain)
+
+        self._pre.submit(_decode)
+        return outer
+
+    # -------------------------------------------------------------- stages
+    def _dispatch_loop(self) -> None:
+        while True:
+            got = self.batcher.get_batch(timeout=0.05)
+            if got is None:
+                if self._closing:
+                    break
+                continue
+            bucket, reqs = got
+            try:
+                with span('serve/assemble', record=False):
+                    arr = assemble_batch([r.image for r in reqs], bucket,
+                                         self.engine.batch)
+                t_d0 = time.perf_counter()
+                with span('serve/dispatch', record=False):
+                    dev = self.engine.dispatch(bucket, arr)
+                t_d1 = time.perf_counter()
+            except BaseException as e:   # noqa: BLE001 — engine is dead
+                self.error = e
+                for r in reqs:
+                    r.future.set_exception(e)
+                self.batcher.close()
+                self.batcher.fail_all(e)
+                break
+            self._inflight.put((bucket, reqs, t_d0, t_d1, dev))
+        self._inflight.put(_DONE)
+
+    def _readback_loop(self) -> None:
+        while True:
+            item = self._inflight.get()
+            if item is _DONE:
+                break
+            bucket, reqs, t_d0, t_d1, dev = item
+            try:
+                with span('serve/readback', record=False):
+                    host = np.asarray(dev)
+            except BaseException as e:   # noqa: BLE001 — async dispatch
+                # XLA runtime errors (device OOM, bad buffer) surface at
+                # the first block on the result, i.e. HERE, not at the
+                # dispatch call — resolve this batch's futures instead of
+                # letting the thread die and wedge the whole pipeline
+                with self._lock:
+                    self._errors += len(reqs)
+                for r in reqs:
+                    r.future.set_exception(e)
+                continue
+            t_done = time.perf_counter()
+            for i, r in enumerate(reqs):
+                self._post.submit(self._finish, r, host[i], t_d1, t_done)
+
+    def _finish(self, r: Request, row: np.ndarray, t_disp: float,
+                t_done: float) -> None:
+        h, w = r.hw
+        mask = row[:h, :w]
+        payload = None
+        try:
+            if self.postprocess is not None:
+                with span('serve/post', record=False):
+                    payload = self.postprocess(mask, r)
+        except BaseException as e:   # noqa: BLE001 — per-request failure
+            with self._lock:
+                self._errors += 1
+            r.future.set_exception(e)
+            return
+        t_end = time.perf_counter()
+        t0 = r.meta.get('t_recv', r.t_submit)
+        timings = {
+            'queue_ms': (r.t_popped - r.t_submit) * 1e3,
+            'assemble_ms': (t_disp - r.t_popped) * 1e3,
+            'device_ms': (t_done - t_disp) * 1e3,
+            'post_ms': (t_end - t_done) * 1e3,
+            'e2e_ms': (t_end - t0) * 1e3,
+        }
+        if 'decode_ms' in r.meta:
+            timings['decode_ms'] = r.meta['decode_ms']
+        with self._lock:
+            self._ok += 1
+        sink = get_sink()
+        if sink is not None:
+            sink.emit({'event': 'request', 'status': 'ok',
+                       'bucket': _bucket_str(r.bucket),
+                       **{k: round(v, 3) for k, v in timings.items()}})
+        r.future.set_result(ServeResult(mask=mask, timings=timings,
+                                        meta=r.meta))
+
+    # ------------------------------------------------------------ lifetime
+    def close(self) -> None:
+        """Drain queued requests, stop the stage threads, shut the pools
+        down. Idempotent."""
+        if self._closed:
+            return
+        self._closing = True
+        self.batcher.close()
+        self._dispatcher.join(timeout=60)
+        self._reader.join(timeout=60)
+        self._post.shutdown(wait=True)
+        self._pre.shutdown(wait=True)
+        self._closed = True
+
+    def __enter__(self) -> 'ServePipeline':
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def stats(self) -> dict:
+        with self._lock:
+            ok, errors = self._ok, self._errors
+        return {
+            'ok': ok,
+            'errors': errors,
+            'batcher': self.batcher.stats(),
+            'engine': self.engine.stats(),
+            'inflight': self._inflight.qsize(),
+            'dead': self.error is not None,
+        }

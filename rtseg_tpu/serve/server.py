@@ -1,0 +1,153 @@
+"""Stdlib HTTP front-end over a ServePipeline.
+
+ThreadingHTTPServer — one handler thread per connection, which is exactly
+the shape the pipeline wants: handlers block on request Futures while the
+batcher coalesces across them. No framework dependency; the container's
+stdlib is the whole serving stack.
+
+API:
+  * ``POST /predict`` (or ``/``) — body is an encoded image (anything PIL
+    decodes). Response 200 is the colormapped PNG mask (``?raw=1``: the
+    int8 class-id array as bytes + ``X-Mask-Shape``). The per-stage
+    latency decomposition rides in the ``X-Serve-Timing`` header as JSON.
+    503 = admission rejected (queue full: back off), 504 = deadline
+    dropped, 413 = no bucket fits the decoded image.
+  * ``GET /healthz`` — liveness (200 once the engine is compiled).
+  * ``GET /stats`` — engine/batcher/pipeline counters as JSON.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import io
+import json
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from .batcher import ServeDrop, ServeReject
+from .engine import UnknownBucket
+from .pipeline import ServePipeline
+
+
+class ServeHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+
+    def __init__(self, addr, pipeline: ServePipeline,
+                 colormap: Optional[np.ndarray] = None,
+                 request_timeout_s: float = 30.0):
+        self.pipeline = pipeline
+        self.colormap = colormap
+        self.request_timeout_s = request_timeout_s
+        super().__init__(addr, _Handler)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server: ServeHTTPServer
+    protocol_version = 'HTTP/1.1'
+
+    def log_message(self, *args) -> None:   # quiet: telemetry goes to obs
+        pass
+
+    def _send(self, code: int, body: bytes, ctype: str,
+              extra: Optional[dict] = None) -> None:
+        self.send_response(code)
+        self.send_header('Content-Type', ctype)
+        self.send_header('Content-Length', str(len(body)))
+        for k, v in (extra or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, code: int, obj) -> None:
+        self._send(code, json.dumps(obj).encode(), 'application/json')
+
+    def do_GET(self) -> None:   # noqa: N802 — http.server API
+        path = self.path.split('?', 1)[0]
+        if path == '/healthz':
+            self._send_json(200, {'ok': True})
+        elif path == '/stats':
+            self._send_json(200, self.server.pipeline.stats())
+        else:
+            self._send_json(404, {'error': f'no route {path}'})
+
+    def do_POST(self) -> None:  # noqa: N802 — http.server API
+        # consume the body BEFORE any reply: under HTTP/1.1 keep-alive an
+        # unread body would be parsed as the next request line,
+        # desyncing the connection
+        length = int(self.headers.get('Content-Length', 0))
+        data = self.rfile.read(length) if length > 0 else b''
+        path = self.path.split('?', 1)[0]
+        if path not in ('/', '/predict'):
+            self._send_json(404, {'error': f'no route {path}'})
+            return
+        if not data:
+            self._send_json(400, {'error': 'empty body'})
+            return
+        try:
+            fut = self.server.pipeline.submit_bytes(data)
+            res = fut.result(timeout=self.server.request_timeout_s)
+        except ServeReject as e:
+            self._send_json(503, {'error': str(e)})
+            return
+        except ServeDrop as e:
+            self._send_json(504, {'error': str(e)})
+            return
+        except UnknownBucket as e:
+            self._send_json(413, {'error': str(e)})
+            return
+        except (TimeoutError, concurrent.futures.TimeoutError):
+            # both spellings: futures.TimeoutError only aliases the
+            # builtin from Python 3.11
+            self._send_json(504, {'error': 'server-side wait timed out'})
+            return
+        except Exception as e:   # noqa: BLE001 — surface, don't hang
+            self._send_json(500, {'error': f'{type(e).__name__}: {e}'})
+            return
+        timing = json.dumps({k: round(v, 3)
+                             for k, v in res.timings.items()})
+        query = urllib.parse.parse_qs(
+            urllib.parse.urlsplit(self.path).query)
+        if query.get('raw', ['0'])[0] not in ('0', '', 'false'):
+            h, w = res.mask.shape
+            self._send(200, np.ascontiguousarray(res.mask).tobytes(),
+                       'application/octet-stream',
+                       {'X-Mask-Shape': f'{h},{w}', 'X-Mask-Dtype': 'int8',
+                        'X-Serve-Timing': timing})
+            return
+        cmap = self.server.colormap
+        if cmap is None:
+            self._send_json(500, {'error': 'server has no colormap; '
+                                           'use ?raw=1'})
+            return
+        from PIL import Image
+        buf = io.BytesIO()
+        Image.fromarray(cmap[res.mask]).save(buf, format='PNG')
+        self._send(200, buf.getvalue(), 'image/png',
+                   {'X-Serve-Timing': timing})
+
+
+def make_server(pipeline: ServePipeline, host: str = '127.0.0.1',
+                port: int = 8080, colormap: Optional[np.ndarray] = None,
+                request_timeout_s: float = 30.0) -> ServeHTTPServer:
+    """Bind (port 0 picks a free one; read ``server.server_address``).
+    Call ``serve_forever()`` — typically on a thread — then ``shutdown()``
+    + ``pipeline.close()``."""
+    return ServeHTTPServer((host, port), pipeline, colormap=colormap,
+                           request_timeout_s=request_timeout_s)
+
+
+def make_preprocess(config):
+    """bytes -> preprocessed (h, w, 3) f32 image, the EvalTransform the
+    validation path uses (data/transforms.py)."""
+    from PIL import Image
+    from ..data.transforms import EvalTransform
+    transform = EvalTransform(config)
+
+    def preprocess(data: bytes) -> np.ndarray:
+        image = np.asarray(Image.open(io.BytesIO(data)).convert('RGB'))
+        return transform(image, None, None)
+
+    return preprocess

@@ -40,7 +40,7 @@ from .checkpoint import (load_meta, restore_train_ckpt, restore_weights,
                          save_best_ckpt, save_train_ckpt)
 from .optim import get_optimizer
 from .state import create_train_state
-from .step import build_eval_step, build_predict_step, build_train_step
+from .step import build_eval_step, build_train_step
 
 
 class SegTrainer:
@@ -474,17 +474,27 @@ class SegTrainer:
             params, batch_stats = restore_weights(
                 cfg.load_ckpt_path, params, batch_stats)
             self.logger.info(f'Loaded weights from {cfg.load_ckpt_path}')
+        # predict() dispatches through the segserve engine (which arms
+        # its own recompile guard over the sealed executable table); no
+        # per-image predict_step is built anymore
         self.predict_vars = {'params': params, 'batch_stats': batch_stats}
-        self.predict_step = build_predict_step(cfg, self.model)
-        if cfg.recompile_guard:
-            from ..analysis.recompile import guard_step
-            self.predict_step = guard_step(self.predict_step,
-                                           'predict_step')
 
     def predict(self) -> None:
         """Reference core/seg_trainer.py:154-191: argmax -> colormap LUT ->
-        PNG mask and/or alpha-blend overlay."""
+        PNG mask and/or alpha-blend overlay.
+
+        Dispatch goes through the segserve engine + micro-batcher
+        (rtseg_tpu/serve/): images are bucketed by their exact
+        post-transform shape and each bucket runs as test_bs-sized padded
+        batches — one executable per (shape, test_bs) instead of one
+        blocking device_get per image. Exact-shape buckets (no spatial
+        padding) plus batch-dim-only padding keep each mask bit-identical
+        to the one-image-per-step path (inference forwards have no
+        cross-sample ops; pinned by tests/test_segserve.py), so the output
+        files stay byte-identical."""
+        from collections import deque
         from PIL import Image
+        from ..serve import ServeEngine, ServePipeline
         cfg = self.config
         colormap = get_colormap(cfg)
         save_dir = os.path.join(cfg.save_dir, 'predicts')
@@ -492,11 +502,20 @@ class SegTrainer:
         mkdir(save_dir)
         if cfg.blend_prediction:
             mkdir(blend_dir)
-        for i in range(len(self.test_set)):
-            raw, aug, name = self.test_set.get(i)
-            pred = np.asarray(
-                self.predict_step(self.predict_vars, aug[None]))[0]
-            mask_rgb = colormap[pred]
+        n = len(self.test_set)
+        if n == 0:
+            self.logger.info(f'No test images; nothing saved to {save_dir}')
+            return
+        # bucket discovery from image headers only (TestFolder.shape) —
+        # no decode, no residency; the folder is never all in memory
+        shapes = sorted({self.test_set.shape(i) for i in range(n)})
+        batch = max(1, min(cfg.test_bs, n))
+        engine = ServeEngine.from_config(cfg, shapes, batch,
+                                         variables=self.predict_vars,
+                                         name='predict_engine')
+
+        def write(raw, name, res):
+            mask_rgb = colormap[res.mask]
             base = os.path.splitext(name)[0]
             if cfg.save_mask:
                 Image.fromarray(mask_rgb).save(
@@ -509,4 +528,24 @@ class SegTrainer:
                          + up.astype(np.float32) * cfg.blend_alpha)
                 Image.fromarray(blend.astype(np.uint8)).save(
                     os.path.join(blend_dir, f'{base}.png'))
-        self.logger.info(f'Predictions saved to {save_dir}')
+
+        # sliding window: at most `window` images (raw + pending mask)
+        # resident at once; outputs stream in index order, so a mid-run
+        # failure still leaves every earlier prediction on disk
+        window = max(2 * batch, 8)
+        pending = deque()                 # (raw, name, future)
+        with ServePipeline(engine, max_wait_ms=1.0,
+                           max_queue=window + batch) as pipe:
+            for i in range(n):
+                if len(pending) >= window:
+                    raw0, name0, fut = pending.popleft()
+                    write(raw0, name0, fut.result())
+                raw, aug, name = self.test_set.get(i)
+                pending.append((raw, name, pipe.submit(aug)))
+            while pending:
+                raw0, name0, fut = pending.popleft()
+                write(raw0, name0, fut.result())
+        self.logger.info(
+            f'Predictions saved to {save_dir} '
+            f'({engine.stats()["executables"]} executables over '
+            f'{len(shapes)} shape bucket(s), batch {batch})')

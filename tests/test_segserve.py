@@ -1,0 +1,448 @@
+"""segserve (rtseg_tpu/serve): engine bucketing/AOT sealing, micro-batcher
+coalescing/drops/backpressure, pipeline parity vs direct apply (ckpt and
+StableHLO paths), HTTP e2e, the bench --check gate, the segscope serving
+report, and the serve/ lint coverage.
+
+All CPU-fast: fastscnn at 32x32/48x48, num_class 5, float32."""
+
+import io
+import json
+import os
+import textwrap
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from rtseg_tpu import obs
+from rtseg_tpu.config import SegConfig
+from rtseg_tpu.serve import (MicroBatcher, ServeDrop, ServeEngine,
+                             ServePipeline, ServeReject, UnknownBucket,
+                             assemble_batch, bench_pipeline, check_report,
+                             make_preprocess, make_server, parse_buckets,
+                             select_bucket, synth_images)
+
+BUCKETS = [(32, 32), (48, 48)]
+BATCH = 4
+
+
+def _cfg(**kw):
+    base = dict(dataset='synthetic', model='fastscnn', num_class=5,
+                colormap='custom', compute_dtype='float32',
+                save_dir='/tmp/rtseg_segserve_test', use_tb=False)
+    base.update(kw)
+    cfg = SegConfig(**base)
+    cfg.resolve(num_devices=1)
+    return cfg
+
+
+@pytest.fixture(scope='module')
+def cfg():
+    return _cfg()
+
+
+@pytest.fixture(scope='module')
+def model_and_vars(cfg):
+    import jax
+    import jax.numpy as jnp
+    from rtseg_tpu.models import get_model
+    model = get_model(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 32, 32, 3), jnp.float32), False)
+    return model, variables
+
+
+@pytest.fixture(scope='module')
+def engine(cfg, model_and_vars):
+    _, variables = model_and_vars
+    return ServeEngine.from_config(cfg, BUCKETS, BATCH, variables=variables)
+
+
+def _direct_mask(model_and_vars, image):
+    """Reference semantics: unbatched argmax forward."""
+    import jax.numpy as jnp
+    model, variables = model_and_vars
+    out = model.apply(variables, jnp.asarray(image[None]), False)
+    return np.asarray(jnp.argmax(out.astype(jnp.float32), -1))[0]
+
+
+# ------------------------------------------------------------------ buckets
+def test_parse_and_select_bucket():
+    assert parse_buckets('512x1024, 256x512') == [(512, 1024), (256, 512)]
+    buckets = [(64, 64), (32, 32), (64, 128)]
+    assert select_bucket(buckets, 20, 20) == (32, 32)
+    assert select_bucket(buckets, 33, 20) == (64, 64)   # smallest that fits
+    assert select_bucket(buckets, 40, 100) == (64, 128)
+    assert select_bucket(buckets, 65, 10) is None
+
+
+def test_assemble_batch_pads_spatial_and_batch():
+    imgs = [np.ones((3, 4, 3), np.float32), np.full((5, 5, 3), 2.0,
+                                                    np.float32)]
+    out = assemble_batch(imgs, (8, 8), 4)
+    assert out.shape == (4, 8, 8, 3)
+    assert np.array_equal(out[0, :3, :4], imgs[0])
+    assert out[0, 3:].sum() == 0 and out[0, :, 4:].sum() == 0
+    assert np.array_equal(out[1, :5, :5], imgs[1])
+    assert out[2:].sum() == 0                      # padded batch rows
+    with pytest.raises(UnknownBucket):
+        assemble_batch([np.zeros((9, 4, 3), np.float32)], (8, 8), 4)
+    with pytest.raises(ValueError):
+        assemble_batch(imgs * 3, (8, 8), 4)        # 6 requests > batch 4
+
+
+# ------------------------------------------------------------------ batcher
+def test_batcher_coalesces_full_batch():
+    b = MicroBatcher(BUCKETS, max_batch=4, max_wait_ms=500, max_queue=16)
+    futs = [b.submit(np.zeros((32, 32, 3), np.float32)) for _ in range(4)]
+    t0 = time.perf_counter()
+    bucket, reqs = b.get_batch(timeout=1.0)
+    # a full batch releases immediately, not after max_wait_ms
+    assert time.perf_counter() - t0 < 0.4
+    assert bucket == (32, 32) and len(reqs) == 4
+    assert all(not f.done() for f in futs)         # consumer resolves them
+    assert b.stats()['batches'] == 1
+
+
+def test_batcher_releases_partial_batch_after_wait():
+    b = MicroBatcher(BUCKETS, max_batch=4, max_wait_ms=20, max_queue=16)
+    b.submit(np.zeros((32, 32, 3), np.float32))
+    b.submit(np.zeros((30, 31, 3), np.float32))    # same bucket (fits)
+    bucket, reqs = b.get_batch(timeout=2.0)
+    assert bucket == (32, 32) and len(reqs) == 2
+    assert reqs[0].hw == (32, 32) and reqs[1].hw == (30, 31)
+
+
+def test_batcher_batches_are_bucket_homogeneous_and_oldest_first():
+    b = MicroBatcher(BUCKETS, max_batch=4, max_wait_ms=5, max_queue=16)
+    b.submit(np.zeros((48, 48, 3), np.float32))    # oldest
+    b.submit(np.zeros((32, 32, 3), np.float32))
+    b.submit(np.zeros((48, 48, 3), np.float32))
+    first, reqs1 = b.get_batch(timeout=1.0)
+    assert first == (48, 48) and len(reqs1) == 2
+    second, reqs2 = b.get_batch(timeout=1.0)
+    assert second == (32, 32) and len(reqs2) == 1
+
+
+def test_batcher_deadline_drops():
+    b = MicroBatcher(BUCKETS, max_batch=4, max_wait_ms=5, max_queue=16)
+    fut = b.submit(np.zeros((32, 32, 3), np.float32), deadline_ms=1.0)
+    time.sleep(0.02)
+    assert b.get_batch(timeout=0.05) is None       # expired -> dropped
+    assert b.stats()['dropped'] == 1
+    with pytest.raises(ServeDrop):
+        fut.result(timeout=1.0)
+
+
+def test_batcher_backpressure_and_unknown_bucket():
+    b = MicroBatcher(BUCKETS, max_batch=4, max_wait_ms=5000, max_queue=2)
+    b.submit(np.zeros((32, 32, 3), np.float32))
+    b.submit(np.zeros((32, 32, 3), np.float32))
+    with pytest.raises(ServeReject):
+        b.submit(np.zeros((32, 32, 3), np.float32))
+    assert b.stats()['rejected'] == 1
+    with pytest.raises(UnknownBucket):
+        b.submit(np.zeros((64, 64, 3), np.float32))  # no bucket fits
+    b.close()
+    with pytest.raises(ServeReject):
+        b.submit(np.zeros((32, 32, 3), np.float32))
+
+
+# ------------------------------------------------------------------- engine
+def test_engine_seals_one_executable_per_bucket(engine):
+    s = engine.stats()
+    assert s['executables'] == len(BUCKETS)
+    assert s['batch'] == BATCH and s['retraces'] == 0
+
+
+def test_engine_parity_and_batch_padding_determinism(engine,
+                                                     model_and_vars):
+    """A request's mask must not depend on how full its batch was: a
+    partial (padded) batch and a full batch produce bit-identical rows,
+    and both match the unbatched direct apply."""
+    rng = np.random.RandomState(0)
+    imgs = [rng.randn(32, 32, 3).astype(np.float32) for _ in range(3)]
+    full = engine.run((32, 32), assemble_batch(imgs + [imgs[0]],
+                                               (32, 32), BATCH))
+    partial = engine.run((32, 32), assemble_batch(imgs[:1], (32, 32),
+                                                  BATCH))
+    assert full.dtype == np.int8
+    assert np.array_equal(full[0], partial[0])
+    direct = _direct_mask(model_and_vars, imgs[0])
+    assert np.array_equal(full[0].astype(np.int64),
+                          direct.astype(np.int64))
+
+
+def test_engine_unknown_bucket_and_guard_armed(engine):
+    with pytest.raises(UnknownBucket):
+        engine.dispatch((64, 64), np.zeros((BATCH, 64, 64, 3), np.float32))
+    with pytest.raises(UnknownBucket):
+        engine.select(64, 64)
+    # the recompile guard is armed over the sealed executable table: any
+    # post-init growth is a hard error, not a silent hot-path compile
+    from rtseg_tpu.analysis.recompile import RecompileError
+    engine._compiled[('seeded', 'growth')] = None
+    try:
+        with pytest.raises(RecompileError):
+            engine.guard.after_call(engine)
+    finally:
+        del engine._compiled[('seeded', 'growth')]
+    engine.guard.after_call(engine)                # back to baseline: fine
+
+
+def test_engine_from_artifact_parity(cfg, model_and_vars, tmp_path):
+    """StableHLO path: an exported artifact serves through the same engine
+    and matches the ckpt-path engine bit-for-bit (same program)."""
+    import jax
+    from rtseg_tpu.export import export_model, save_exported
+    path = save_exported(
+        export_model(cfg, imgh=32, imgw=32, batch=BATCH, argmax=True,
+                     platforms=(jax.devices()[0].platform,)),
+        str(tmp_path / 'm'))
+    eng = ServeEngine.from_artifact(path)
+    assert eng.buckets == [(32, 32)] and eng.batch == BATCH
+    rng = np.random.RandomState(1)
+    img = rng.randn(32, 32, 3).astype(np.float32)
+    out = eng.run((32, 32), assemble_batch([img], (32, 32), BATCH))
+    # export_model re-inits with PRNGKey(0), same as the fixture vars
+    direct = _direct_mask(model_and_vars, img)
+    assert np.array_equal(out[0].astype(np.int64), direct.astype(np.int64))
+    with pytest.raises(ValueError):
+        ServeEngine.from_artifact(path, batch=BATCH + 1)
+
+
+# ----------------------------------------------------------------- pipeline
+def test_pipeline_end_to_end_mixed_shapes(engine, model_and_vars,
+                                          tmp_path):
+    sink = obs.EventSink(str(tmp_path / 'events-000.jsonl'))
+    obs.set_sink(sink)
+    try:
+        rng = np.random.RandomState(2)
+        imgs = [rng.randn(32, 32, 3).astype(np.float32) for _ in range(5)]
+        imgs += [rng.randn(48, 48, 3).astype(np.float32) for _ in range(3)]
+        with ServePipeline(engine, max_wait_ms=5, max_queue=32) as pipe:
+            futures = [pipe.submit(im) for im in imgs]
+            results = [f.result(timeout=60) for f in futures]
+        for im, res in zip(imgs, results):
+            assert res.mask.shape == im.shape[:2]
+            assert np.array_equal(res.mask.astype(np.int64),
+                                  _direct_mask(model_and_vars,
+                                               im).astype(np.int64))
+            assert set(res.timings) >= {'queue_ms', 'assemble_ms',
+                                        'device_ms', 'post_ms', 'e2e_ms'}
+        assert pipe.stats()['ok'] == len(imgs)
+    finally:
+        obs.set_sink(None)
+        sink.close()
+    events = [json.loads(line) for line in
+              open(str(tmp_path / 'events-000.jsonl'))]
+    req = [e for e in events if e['event'] == 'request']
+    bat = [e for e in events if e['event'] == 'batch']
+    assert len(req) == len(imgs)
+    assert all(e['status'] == 'ok' for e in req)
+    assert bat and sum(e['size'] for e in bat) == len(imgs)
+    assert {e['bucket'] for e in bat} == {'32x32', '48x48'}
+
+
+# --------------------------------------------------------------------- http
+def test_http_server_end_to_end(cfg, engine):
+    from PIL import Image
+    from rtseg_tpu.utils import get_colormap
+    pipe = ServePipeline(engine, max_wait_ms=5, max_queue=32,
+                         preprocess=make_preprocess(cfg))
+    server = make_server(pipe, port=0, colormap=get_colormap(cfg))
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    base = f'http://127.0.0.1:{port}'
+    try:
+        with urllib.request.urlopen(f'{base}/healthz', timeout=30) as r:
+            assert r.status == 200 and json.loads(r.read())['ok']
+        rng = np.random.RandomState(3)
+        buf = io.BytesIO()
+        Image.fromarray((rng.rand(32, 32, 3) * 255).astype(np.uint8)).save(
+            buf, format='PNG')
+        body = buf.getvalue()
+        req = urllib.request.Request(f'{base}/predict', data=body,
+                                     method='POST')
+        with urllib.request.urlopen(req, timeout=60) as r:
+            assert r.status == 200
+            assert r.headers['Content-Type'] == 'image/png'
+            timing = json.loads(r.headers['X-Serve-Timing'])
+            assert 'e2e_ms' in timing and 'decode_ms' in timing
+            mask_rgb = np.asarray(Image.open(io.BytesIO(r.read())))
+            assert mask_rgb.shape == (32, 32, 3)
+        req = urllib.request.Request(f'{base}/predict?raw=1', data=body,
+                                     method='POST')
+        with urllib.request.urlopen(req, timeout=60) as r:
+            assert r.headers['X-Mask-Shape'] == '32,32'
+            assert len(r.read()) == 32 * 32
+        # an image no bucket fits -> 413, not a hang or a retrace
+        buf = io.BytesIO()
+        Image.fromarray(np.zeros((64, 64, 3), np.uint8)).save(
+            buf, format='PNG')
+        req = urllib.request.Request(f'{base}/predict', data=buf.getvalue(),
+                                     method='POST')
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=60)
+        assert ei.value.code == 413
+        with urllib.request.urlopen(f'{base}/stats', timeout=30) as r:
+            stats = json.loads(r.read())
+        assert stats['engine']['executables'] == len(BUCKETS)
+        assert stats['engine']['retraces'] == 0
+    finally:
+        server.shutdown()
+        pipe.close()
+
+
+# -------------------------------------------------------------------- bench
+def test_bench_and_check_gate(engine):
+    imgs = synth_images(BUCKETS, seed=0)
+    with ServePipeline(engine, max_wait_ms=5, max_queue=64) as pipe:
+        report = bench_pipeline(pipe, imgs, requests=24, rps=300.0, seed=0)
+    assert report['ok'] == 24
+    assert report['dropped'] == 0 and report['rejected'] == 0
+    assert report['e2e_p95_ms'] > 0
+    assert report['engine']['executables'] == len(BUCKETS)
+    assert check_report(report, p95_ms=60_000,
+                        expect_buckets=len(BUCKETS)) == []
+    # the gate actually gates
+    assert check_report(report, p95_ms=1e-6)       # p95 over threshold
+    bad = dict(report, dropped=3)
+    assert any('drops' in p for p in check_report(bad, p95_ms=60_000))
+
+
+def test_segserve_cli_bench_check(tmp_path):
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), 'tools'))
+    try:
+        import segserve
+    finally:
+        sys.path.pop(0)
+    obs_dir = str(tmp_path / 'segscope')
+    rc = segserve.main([
+        'bench', '--model', 'fastscnn', '--num_class', '5',
+        '--compute_dtype', 'float32', '--buckets', '32x32', '--batch', '4',
+        '--requests', '8', '--rps', '200', '--max-wait-ms', '10',
+        '--obs-dir', obs_dir, '--check', '--p95-ms', '60000'])
+    assert rc == 0
+    # the run's events feed the segscope serving report
+    from rtseg_tpu.obs.report import load_events, summarize
+    s = summarize(load_events(obs_dir))
+    assert s['serving'] is not None
+    assert s['serving']['ok'] == 8
+    assert s['serve_p99_ms'] > 0
+
+
+# -------------------------------------------------------- segscope serving
+def _req_event(e2e, status='ok', ts=0.0):
+    return {'event': 'request', 'status': status, 'bucket': '32x32',
+            'queue_ms': 1.0, 'assemble_ms': 0.2, 'device_ms': 3.0,
+            'post_ms': 0.1, 'e2e_ms': e2e, 'ts': ts, 'host': 0}
+
+
+def test_report_serving_section_and_diff_regression():
+    from rtseg_tpu.obs.report import diff_table, summarize
+    events = [{'event': 'run_start', 'ts': 0.0, 'host': 0}]
+    events += [_req_event(10.0 + i, ts=0.1 * i) for i in range(20)]
+    events.append(_req_event(0.0, status='dropped', ts=2.0))
+    events.append(_req_event(0.0, status='rejected', ts=2.1))
+    events += [{'event': 'batch', 'bucket': '32x32', 'size': 4, 'cap': 8,
+                'wait_ms': 2.0, 'ts': 1.0, 'host': 0}]
+    s = summarize(events)
+    sv = s['serving']
+    assert sv['requests'] == 22 and sv['ok'] == 20
+    assert sv['dropped'] == 1 and sv['rejected'] == 1
+    assert sv['rps'] > 0
+    assert sv['e2e_p50_ms'] == pytest.approx(19.5, abs=0.6)
+    assert sv['occupancy'] == pytest.approx(0.5)
+    assert s['serve_p99_ms'] == sv['e2e_p99_ms']
+    # diff: a worse serve p99 is flagged REGRESSED
+    worse = [dict(e, e2e_ms=e.get('e2e_ms', 0) * 2) if
+             e.get('event') == 'request' else e for e in events]
+    table = diff_table(s, summarize(worse))
+    row = next(ln for ln in table.splitlines() if 'serve p99' in ln)
+    assert 'REGRESSED' in row
+    # training-only runs: serving rows render as absent, not crash
+    table2 = diff_table(summarize([]), summarize([]))
+    assert '| serve p99 (ms) | — | — | — |' in table2
+
+
+# ---------------------------------------------------------- trainer predict
+def test_trainer_predict_via_engine_byte_identical(tmp_path):
+    """Folder prediction through the serve batcher writes the exact same
+    PNG bytes the one-image-per-step path would: exact-shape buckets plus
+    batch-dim-only padding keep per-image masks bit-identical."""
+    from PIL import Image
+    from rtseg_tpu.train import SegTrainer
+    from rtseg_tpu.utils import get_colormap
+    img_dir = str(tmp_path / 'imgs')
+    os.makedirs(img_dir)
+    rng = np.random.RandomState(0)
+    sizes = [(40, 56), (40, 56), (32, 32)]         # two shape buckets
+    for i, (h, w) in enumerate(sizes):
+        Image.fromarray((rng.rand(h, w, 3) * 255).astype(np.uint8)).save(
+            os.path.join(img_dir, f'im{i}.png'))
+    cfg = _cfg(save_dir=str(tmp_path / 'save'), is_testing=True,
+               test_data_folder=img_dir, load_ckpt=False, test_bs=2,
+               blend_prediction=False)
+    trainer = SegTrainer(cfg)
+    trainer.predict()
+    colormap = get_colormap(cfg)
+    mv = (trainer.model, trainer.predict_vars)
+    for i in range(len(sizes)):
+        out_path = os.path.join(cfg.save_dir, 'predicts', f'im{i}.png')
+        assert os.path.exists(out_path)
+        _, aug, _ = trainer.test_set.get(i)
+        expect = io.BytesIO()
+        Image.fromarray(colormap[_direct_mask(mv, aug)]).save(
+            expect, format='PNG')
+        with open(out_path, 'rb') as f:
+            assert f.read() == expect.getvalue(), f'im{i} differs'
+
+
+# ------------------------------------------------------------ lint coverage
+def _write(root, relpath, text):
+    path = os.path.join(str(root), relpath)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, 'w') as f:
+        f.write(textwrap.dedent(text))
+
+
+def test_lints_cover_serve_package(tmp_path):
+    """TARGET_PREFIXES covers rtseg_tpu/serve/: host effects and segscope
+    calls inside jit-reachable serve code are findings."""
+    from rtseg_tpu.analysis import check_trace_purity
+    from rtseg_tpu.analysis.lint_obs import check_obs_purity
+    from rtseg_tpu.analysis.lint_trace import TARGET_PREFIXES
+    assert any(p.startswith('rtseg_tpu/serve') for p in TARGET_PREFIXES)
+    _write(tmp_path, 'rtseg_tpu/serve/bad.py', '''
+        import time
+        import jax
+        from rtseg_tpu.obs import span
+
+        @jax.jit
+        def traced_infer(x):
+            with span('serve/oops'):
+                t = time.perf_counter()
+            return x * t
+        ''')
+    trace = check_trace_purity(str(tmp_path))
+    assert any(f.path == 'rtseg_tpu/serve/bad.py' and
+               'time.perf_counter' in f.message for f in trace)
+    obs_f = check_obs_purity(str(tmp_path))
+    assert any(f.path == 'rtseg_tpu/serve/bad.py' and 'span' in f.message
+               for f in obs_f)
+    # host-side serve code (no jit root) stays clean
+    _write(tmp_path, 'rtseg_tpu/serve/bad.py', '''
+        import time
+        from rtseg_tpu.obs import span
+
+        def host_loop(q):
+            with span('serve/ok'):
+                return time.perf_counter()
+        ''')
+    assert check_trace_purity(str(tmp_path)) == []
+    assert check_obs_purity(str(tmp_path)) == []

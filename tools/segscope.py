@@ -3,8 +3,12 @@
 
 Reads the per-host event streams a run wrote under config.obs_dir
 (default save_dir/segscope) and prints the step-time/goodput breakdown, or
-compares two runs as a regression table. Pure stdlib+numpy: works on
-machines without jax (e.g. a laptop holding synced run dirs).
+compares two runs as a regression table. Serving runs (tools/segserve.py
+bench --obs-dir) get a serving section — RPS, request p50/p95/p99, stage
+means, drop/reject counts, batch occupancy — from their request/batch
+events, and `diff` flags serve-p99/RPS regressions alongside the training
+rows. Pure stdlib+numpy: works on machines without jax (e.g. a laptop
+holding synced run dirs).
 
 Usage:
     python tools/segscope.py report save/segscope

@@ -1,0 +1,249 @@
+#!/usr/bin/env python
+"""segserve — online inference serving CLI (rtseg_tpu/serve/).
+
+Usage:
+    # HTTP server: POST an image to /predict, GET /healthz, /stats
+    python tools/segserve.py serve --model fastscnn --num_class 19 \
+        --ckpt save/best.ckpt --buckets 512x1024,256x512 --batch 8 \
+        --port 8080
+
+    # open-loop Poisson load test against an in-process pipeline
+    python tools/segserve.py bench --model fastscnn --num_class 19 \
+        --buckets 64x64,96x96 --batch 8 --requests 256 --rps 100 --check
+
+    # same, but through a real localhost HTTP server (one process)
+    python tools/segserve.py bench ... --via-http
+
+    # against an already-running server
+    python tools/segserve.py bench ... --http http://host:8080
+
+Engines load weights from --ckpt (orbax checkpoint) or --artifact
+(jax.export StableHLO from tools/export.py); with neither, random init
+(load-gen / capacity testing only). --obs-dir writes request/batch events
+that `tools/segscope.py report` renders as the serving section.
+
+`bench --check` is the CI gate: exit 1 unless 0 drops, 0 rejections,
+0 errors, 0 retraces, one executable per configured bucket, and e2e p95
+under --p95-ms.
+
+Exit codes: 0 ok, 1 --check failed, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from rtseg_tpu import obs                                      # noqa: E402
+from rtseg_tpu.config import SegConfig                         # noqa: E402
+from rtseg_tpu.serve import (ServeEngine, ServePipeline,       # noqa: E402
+                             bench_http, bench_pipeline,
+                             bench_sequential, check_report, encode_png,
+                             format_report, make_preprocess, make_server,
+                             parse_buckets, synth_images)
+from rtseg_tpu.utils import get_colormap                       # noqa: E402
+
+
+def _add_engine_args(p: argparse.ArgumentParser) -> None:
+    p.add_argument('--model', default='fastscnn')
+    p.add_argument('--num_class', type=int, default=19)
+    p.add_argument('--compute_dtype', default=None,
+                   help='forward dtype (default: bfloat16 on TPU-style '
+                        'resolve; pass float32 on CPU)')
+    p.add_argument('--colormap', default='cityscapes')
+    p.add_argument('--ckpt', default=None,
+                   help='orbax checkpoint dir to load weights from')
+    p.add_argument('--artifact', default=None,
+                   help='StableHLO artifact (tools/export.py); bucket and '
+                        'batch come from its input shape')
+    p.add_argument('--buckets', default='512x1024',
+                   help='comma-separated HxW buckets, e.g. 512x1024,256x512')
+    p.add_argument('--batch', type=int, default=8,
+                   help='fixed per-executable batch size')
+    p.add_argument('--max-wait-ms', type=float, default=5.0,
+                   help='batcher coalescing window')
+    p.add_argument('--max-queue', type=int, default=128,
+                   help='admission bound (requests queued before 503)')
+    p.add_argument('--deadline-ms', type=float, default=None,
+                   help='per-request queue deadline (drop when exceeded)')
+    p.add_argument('--workers', type=int, default=2,
+                   help='preprocess / postprocess threads each')
+
+
+def _build_config(args) -> SegConfig:
+    cfg = SegConfig(dataset='synthetic', model=args.model,
+                    num_class=args.num_class, colormap=args.colormap,
+                    compute_dtype=args.compute_dtype,
+                    save_dir='/tmp/segserve', use_tb=False)
+    cfg.resolve(num_devices=1)
+    return cfg
+
+
+def _build_engine(args, cfg: SegConfig) -> ServeEngine:
+    if args.artifact:
+        return ServeEngine.from_artifact(args.artifact, batch=args.batch)
+    return ServeEngine.from_config(cfg, parse_buckets(args.buckets),
+                                   args.batch, ckpt_path=args.ckpt)
+
+
+def _build_pipeline(args, cfg: SegConfig,
+                    engine: ServeEngine) -> ServePipeline:
+    return ServePipeline(engine, max_wait_ms=args.max_wait_ms,
+                         max_queue=args.max_queue,
+                         deadline_ms=args.deadline_ms,
+                         preprocess=make_preprocess(cfg),
+                         pre_workers=args.workers,
+                         post_workers=args.workers)
+
+
+def cmd_serve(args) -> int:
+    cfg = _build_config(args)
+    engine = _build_engine(args, cfg)
+    pipeline = _build_pipeline(args, cfg, engine)
+    server = make_server(pipeline, host=args.host, port=args.port,
+                         colormap=get_colormap(cfg))
+    host, port = server.server_address[:2]
+    print(f'segserve: {cfg.model} on http://{host}:{port} | buckets '
+          f'{args.buckets} x batch {engine.batch} | POST /predict, '
+          f'GET /healthz /stats', flush=True)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.shutdown()
+        pipeline.close()
+    return 0
+
+
+def cmd_bench(args) -> int:
+    sink = None
+    if args.obs_dir:
+        sink = obs.init_run(args.obs_dir, meta={
+            'serve': True, 'model': args.model, 'buckets': args.buckets,
+            'batch': args.batch, 'rps_target': args.rps})
+        obs.set_sink(sink)
+    if args.http:
+        # external server: pure urllib client — no local engine and no
+        # model/config machinery; the server's buckets do the fitting
+        buckets = parse_buckets(args.buckets)
+        images = synth_images(buckets, seed=args.seed)
+        payloads = [encode_png(im) for im in images]
+        report = bench_http(args.http, payloads, args.requests, args.rps,
+                            seed=args.seed)
+        try:
+            print(json.dumps(report, indent=2) if args.json
+                  else format_report(report), flush=True)
+            if args.check:
+                problems = check_report(report, args.p95_ms)
+                if problems:
+                    print('segserve check FAILED: ' + '; '.join(problems),
+                          file=sys.stderr)
+                    return 1
+            return 0
+        finally:
+            if sink is not None:
+                sink.emit({'event': 'run_end'})
+                sink.close()
+                if obs.get_sink() is sink:
+                    obs.set_sink(None)
+    cfg = _build_config(args)
+    engine = _build_engine(args, cfg)
+    buckets = engine.buckets
+    images = synth_images(buckets, seed=args.seed)
+    try:
+        if args.via_http:
+            pipeline = _build_pipeline(args, cfg, engine)
+            server = make_server(pipeline, host='127.0.0.1', port=0,
+                                 colormap=get_colormap(cfg))
+            port = server.server_address[1]
+            t = threading.Thread(target=server.serve_forever, daemon=True)
+            t.start()
+            try:
+                payloads = [encode_png(im) for im in images]
+                report = bench_http(f'http://127.0.0.1:{port}', payloads,
+                                    args.requests, args.rps,
+                                    seed=args.seed)
+            finally:
+                server.shutdown()
+                pipeline.close()
+            report['engine'] = engine.stats()
+            report['batcher'] = pipeline.batcher.stats()
+        else:
+            with _build_pipeline(args, cfg, engine) as pipeline:
+                report = bench_pipeline(pipeline, images, args.requests,
+                                        args.rps, seed=args.seed,
+                                        deadline_ms=args.deadline_ms)
+        if args.baseline:
+            base_engine = ServeEngine.from_config(
+                cfg, buckets, 1, ckpt_path=args.ckpt,
+                name='serve_baseline')
+            report['baseline'] = bench_sequential(
+                base_engine, images, min(args.requests,
+                                         args.baseline_requests))
+        print(json.dumps(report, indent=2) if args.json
+              else format_report(report), flush=True)
+        if args.check:
+            problems = check_report(report, args.p95_ms,
+                                    expect_buckets=len(buckets))
+            if problems:
+                print('segserve check FAILED: ' + '; '.join(problems),
+                      file=sys.stderr)
+                return 1
+            print(f'segserve check OK: {report["ok"]}/{report["requests"]}'
+                  f' ok, 0 drops/rejects, p95 '
+                  f'{report["e2e_p95_ms"]:.1f} ms <= {args.p95_ms} ms, '
+                  f'{len(buckets)} executables, 0 retraces')
+        return 0
+    finally:
+        if sink is not None:
+            sink.emit({'event': 'run_end'})
+            sink.close()
+            if obs.get_sink() is sink:
+                obs.set_sink(None)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog='segserve', description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = ap.add_subparsers(dest='cmd', required=True)
+
+    sp = sub.add_parser('serve', help='run the HTTP serving front-end')
+    _add_engine_args(sp)
+    sp.add_argument('--host', default='0.0.0.0')
+    sp.add_argument('--port', type=int, default=8080)
+
+    bp = sub.add_parser('bench', help='open-loop Poisson load test')
+    _add_engine_args(bp)
+    bp.add_argument('--requests', type=int, default=256)
+    bp.add_argument('--rps', type=float, default=50.0,
+                    help='target arrival rate (open loop)')
+    bp.add_argument('--seed', type=int, default=0)
+    bp.add_argument('--http', default=None,
+                    help='drive an already-running server at this URL')
+    bp.add_argument('--via-http', action='store_true',
+                    help='start a localhost server in-process and drive '
+                         'it over real HTTP')
+    bp.add_argument('--baseline', action='store_true',
+                    help='also run the closed-loop sequential bs1 '
+                         'baseline and report the throughput ratio')
+    bp.add_argument('--baseline-requests', type=int, default=64)
+    bp.add_argument('--obs-dir', default=None,
+                    help='write segscope request/batch events here')
+    bp.add_argument('--json', action='store_true')
+    bp.add_argument('--check', action='store_true',
+                    help='CI gate (see module docstring)')
+    bp.add_argument('--p95-ms', type=float, default=1000.0,
+                    help='--check e2e p95 threshold')
+    args = ap.parse_args(argv)
+    return cmd_serve(args) if args.cmd == 'serve' else cmd_bench(args)
+
+
+if __name__ == '__main__':
+    sys.exit(main())
