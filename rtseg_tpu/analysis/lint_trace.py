@@ -28,8 +28,12 @@ from .core import Finding, RULE_TRACE, SourceFile, iter_python_files
 #: locks, event emission) can never leak into a jit-reachable inference
 #: path — a serving engine that times or logs inside its traced forward
 #: would bake trace-time values into every compiled bucket executable.
+#: data/ is covered for the same reason on the input side: segpipe's host
+#: machinery (producer threads, shm ring, h2d spans, host RNG) lives one
+#: import away from the on-device augment stage (ops/augment) that the
+#: compiled steps now open with.
 TARGET_PREFIXES = ('rtseg_tpu/train/step.py', 'rtseg_tpu/ops/',
-                   'rtseg_tpu/serve/')
+                   'rtseg_tpu/serve/', 'rtseg_tpu/data/')
 
 #: call names (last dotted segment) that receive functions destined for
 #: tracing — a function passed by name into one of these is a jit root

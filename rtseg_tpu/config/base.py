@@ -120,6 +120,38 @@ class SegConfig:
     watchdog_factor: float = 20.0
     obs_stall_trace: bool = True
 
+    # ----- Input pipeline (segpipe, rtseg_tpu/data/segpipe/) -----
+    # packed sample cache: one-time pass that decodes + pre-resizes the
+    # dataset (the deterministic prefix of the transform stack) into
+    # fixed-shape mmap shards + an index file, content-hashed against
+    # dataset files + transform config (auto-invalidated on change). Per
+    # epoch, sample cost drops from PNG/JPEG decode to an mmap read +
+    # cheap random augment (see BENCHMARKS.md "Loader throughput
+    # methodology", segpipe_cpu.log)
+    segpipe_cache: bool = False
+    cache_dir: Optional[str] = None        # resolved to save_dir/segpack;
+    #                                        point at a stable dir to
+    #                                        amortize the build across runs
+    # multi-process augment workers over a shared-memory ring buffer
+    # (replaces the GIL-bound thread pool for the random-crop/flip/jitter
+    # stage). 0 = in-process threads (base_workers). Determinism contract
+    # is unchanged: per-sample rng is a function of (seed, epoch, process,
+    # batch, slot), never of worker scheduling.
+    aug_workers: int = 0
+    # async device prefetch depth: batches are shipped to the device on a
+    # background thread (h2d overlaps device compute) with this many
+    # batches in flight. 0 = synchronous per-step transfer (seed-era path).
+    device_prefetch: int = 2
+    # ship batches as uint8 HWC (4x fewer H2D bytes) and run the
+    # normalize/flip tail on-device inside the jit'd step
+    # (ops/augment.device_flip_norm — bit-identical to the host
+    # transforms.flip_norm_pack path, pinned by tests/test_segpipe.py).
+    # None = auto: on whenever the dataset's augment tail supports a raw
+    # uint8 handoff (disk datasets with color jitter disabled; the
+    # synthetic dataset is float-native so it resolves off). The resolved
+    # value lands in device_norm_resolved at get_loader() time.
+    device_norm: Optional[bool] = None
+
     # ----- Training setting (base_config.py:64-71) -----
     # torch AMP's role is played by compute_dtype on TPU (bf16 compute, fp32
     # params, no GradScaler). For reference-config migration the flag is
@@ -235,6 +267,7 @@ class SegConfig:
     pack_fullres: bool = False
 
     # ----- Derived fields (filled by resolve(); never set by hand) -----
+    device_norm_resolved: bool = False     # set by data.get_loader()
     train_num: int = 0
     val_num: int = 0
     iters_per_epoch: int = 0
@@ -258,6 +291,8 @@ class SegConfig:
             self.tb_log_dir = f'{self.save_dir}/tb_logs/'
         if self.obs_dir is None:
             self.obs_dir = f'{self.save_dir}/segscope'
+        if self.cache_dir is None:
+            self.cache_dir = f'{self.save_dir}/segpack'
         if self.crop_h is None:
             self.crop_h = self.crop_size
         if self.crop_w is None:

@@ -24,9 +24,30 @@ def get_dataset(config):
     return cls(config, mode='train'), cls(config, mode='val')
 
 
+def _open_cache(dataset, config, pi: int, pc: int):
+    """Build/open the segpipe packed cache for one dataset split; any
+    unsupported layout degrades to the decode path with a warning."""
+    from .segpipe import CacheUnsupported, open_or_build
+    try:
+        return open_or_build(dataset, config.cache_dir,
+                             process_index=pi, process_count=pc)
+    except CacheUnsupported as e:
+        import warnings
+        warnings.warn(f'segpipe cache disabled for '
+                      f'{type(dataset).__name__}: {e}', stacklevel=2)
+        return None
+
+
 def get_loader(config):
     """Build train/val ShardedLoaders; fills config.train_num / val_num and
-    schedule math (reference datasets/__init__.py:21-49 + scheduler seams)."""
+    schedule math (reference datasets/__init__.py:21-49 + scheduler seams).
+
+    segpipe wiring happens here: the packed sample cache (config.
+    segpipe_cache), the multi-process augment workers (config.aug_workers)
+    and the raw uint8 tail (config.device_norm; None = auto — on exactly
+    when both splits' augment tails support the exact uint8 handoff). The
+    resolved raw-tail decision lands in config.device_norm_resolved so the
+    trainer builds the matching compiled steps."""
     train_ds, val_ds = get_dataset(config)
     global_train = config.train_bs * config.gpu_num
     global_val = config.val_bs * config.gpu_num
@@ -42,14 +63,36 @@ def get_loader(config):
 
     pc = jax.process_count()
     pi = jax.process_index()
+
+    train_cache = val_cache = None
+    if config.segpipe_cache:
+        train_cache = _open_cache(train_ds, config, pi, pc)
+        val_cache = _open_cache(val_ds, config, pi, pc)
+
+    raw = config.device_norm
+    supported = (getattr(train_ds, 'supports_raw_tail', False)
+                 and getattr(val_ds, 'supports_raw_tail', False))
+    if raw is None:
+        raw = supported
+    elif raw and not supported:
+        raise ValueError(
+            f'device_norm=True but the {config.dataset} augment tail has '
+            f'no exact uint8 handoff (float-native samples or color '
+            f'jitter enabled); set device_norm=None/False')
+    config.device_norm_resolved = bool(raw)
+
     train_loader = ShardedLoader(
         train_ds, global_train, seed=config.random_seed, shuffle=True,
         drop_last=True, ignore_index=config.ignore_index,
-        process_index=pi, process_count=pc, workers=config.base_workers)
+        process_index=pi, process_count=pc, workers=config.base_workers,
+        cache=train_cache, raw_tail=raw, emit_flags=True,
+        mp_workers=config.aug_workers, tag='train')
     val_loader = ShardedLoader(
         val_ds, global_val, seed=config.random_seed, shuffle=False,
         drop_last=False, ignore_index=config.ignore_index,
-        process_index=pi, process_count=pc, workers=config.base_workers)
+        process_index=pi, process_count=pc, workers=config.base_workers,
+        cache=val_cache, raw_tail=raw, emit_flags=False,
+        mp_workers=config.aug_workers, tag='val')
     return train_loader, val_loader
 
 

@@ -13,8 +13,8 @@ import os
 from collections import namedtuple
 
 import numpy as np
-from PIL import Image
 
+from .protocol import SegpipeFileDataset
 from .transforms import EvalTransform, TrainTransform
 
 Label = namedtuple('Label', ['name', 'id', 'trainId'])
@@ -50,8 +50,9 @@ def encode_target(mask: np.ndarray) -> np.ndarray:
     return ID_TO_TRAIN_ID[np.clip(mask, 0, len(ID_TO_TRAIN_ID) - 1)]
 
 
-class Cityscapes:
+class Cityscapes(SegpipeFileDataset):
     num_class = 19
+    spec_name = 'cityscapes'
 
     def __init__(self, config, mode: str = 'train'):
         data_root = os.path.expanduser(config.data_root)
@@ -73,11 +74,8 @@ class Cityscapes:
                 mask_name = f"{fn.split('_leftImg8bit')[0]}_gtFine_labelIds.png"
                 self.masks.append(os.path.join(city_msk, mask_name))
 
-    def __len__(self):
-        return len(self.images)
-
-    def get(self, index: int, rng: np.random.Generator):
-        image = np.asarray(Image.open(self.images[index]).convert('RGB'))
-        mask = np.asarray(Image.open(self.masks[index]).convert('L'))
-        image, mask = self.transform(image, mask, rng)
-        return image, encode_target(mask).astype(np.int32)
+    # segpipe protocol from SegpipeFileDataset; masks stay RAW label ids
+    # in the packed cache — PadIfNeeded pads masks with 0, which must
+    # mean "unlabeled", so the trainId LUT runs after augment
+    def _encode_mask(self, mask: np.ndarray) -> np.ndarray:
+        return encode_target(mask).astype(np.int32)

@@ -11,14 +11,13 @@ from __future__ import annotations
 
 import os
 
-import numpy as np
 import yaml
-from PIL import Image
 
+from .protocol import SegpipeFileDataset
 from .transforms import EvalTransform, TrainTransform
 
 
-class Custom:
+class Custom(SegpipeFileDataset):
     def __init__(self, config, mode: str = 'train'):
         data_root = os.path.expanduser(config.data_root)
         yaml_path = os.path.join(data_root, 'data.yaml')
@@ -49,11 +48,5 @@ class Custom:
             self.images.append(os.path.join(img_dir, fn))
             self.masks.append(os.path.join(msk_dir, base + '.png'))
 
-    def __len__(self):
-        return len(self.images)
-
-    def get(self, index: int, rng: np.random.Generator):
-        image = np.asarray(Image.open(self.images[index]).convert('RGB'))
-        mask = np.asarray(Image.open(self.masks[index]).convert('L'))
-        image, mask = self.transform(image, mask, rng)
-        return image, mask.astype(np.int32)
+    # segpipe protocol (prepare/augment split, cache_spec, raw tail) is
+    # inherited from SegpipeFileDataset; identity mask encoding

@@ -13,9 +13,15 @@ pipeline:
     multiple of the batch, datasets/__init__.py:25 + drop_last=True);
     val batches pad the tail by repeating the last sample with labels forced
     to ignore_index so the confusion matrix is unaffected.
-  * a background thread prefetches the next batch while the device computes
-    (the DataLoader-worker role; ThreadPool because the host work is
-    cv2/numpy which releases the GIL).
+  * sample fetch goes through a segpipe SampleSource: packed-cache mmap
+    read when a cache is attached (decode fallback otherwise), then the
+    random augment suffix — optionally as the raw uint8 tail whose
+    flip/normalize runs on-device (ops/augment.device_flip_norm).
+  * batch production is parallelized either by an in-process thread pool
+    (``workers``; cv2/numpy release the GIL) or by segpipe's forked
+    augment workers over a shared-memory ring (``mp_workers``), both
+    byte-identical to serial production; a background producer overlaps
+    production with consumption either way.
 """
 
 from __future__ import annotations
@@ -26,7 +32,10 @@ from typing import Iterator, Optional, Tuple
 
 import numpy as np
 
-from ..obs import span
+from ..obs import get_sink, span
+from .segpipe import (AugmentPool, PackedCache, SampleSource,
+                      assemble_batch)
+from .segpipe.source import sample_rngs
 
 
 class ShardedLoader:
@@ -34,7 +43,10 @@ class ShardedLoader:
                  shuffle: bool = True, drop_last: bool = True,
                  ignore_index: int = 255, pad_labels: bool = True,
                  process_index: int = 0, process_count: int = 1,
-                 prefetch: int = 2, workers: int = 0):
+                 prefetch: int = 2, workers: int = 0,
+                 cache: Optional[PackedCache] = None,
+                 raw_tail: bool = False, emit_flags: bool = True,
+                 mp_workers: int = 0, tag: str = 'train'):
         self.dataset = dataset
         self.global_batch = global_batch
         self.local_batch = global_batch // process_count
@@ -50,8 +62,20 @@ class ShardedLoader:
         # intra-batch sample fetch parallelism (the DataLoader num_workers
         # role, reference datasets/__init__.py:35-41); cv2/PIL/numpy release
         # the GIL so threads scale. 0/1 = fetch serially in the producer.
+        # mp_workers > 0 supersedes it with real processes (segpipe).
         self.workers = workers
+        self.mp_workers = mp_workers
+        self.tag = tag
+        self.source = SampleSource(dataset, cache=cache, raw_tail=raw_tail)
+        self.raw_tail = raw_tail
+        self.emit_flags = emit_flags and raw_tail
         self.epoch = 0
+        # satellite fix: the all-ignored dummy batch for empty multi-host
+        # slices used to re-decode dataset.get(0) on EVERY ragged step;
+        # cache it per epoch (val loaders never set_epoch, so theirs is
+        # built exactly once)
+        self._dummy: Optional[tuple] = None
+        self._dummy_epoch: Optional[int] = None
 
     def __len__(self):
         n = len(self.dataset)
@@ -62,6 +86,14 @@ class ShardedLoader:
     def set_epoch(self, epoch: int) -> None:
         self.epoch = epoch
 
+    @property
+    def norm_coeffs(self):
+        """(scale, bias) for the on-device normalize stage, or None when
+        the loader ships host-normalized float32."""
+        if not self.raw_tail:
+            return None
+        return self.dataset.norm_coeffs()
+
     def _epoch_indices(self) -> np.ndarray:
         n = len(self.dataset)
         if self.shuffle:
@@ -69,49 +101,105 @@ class ShardedLoader:
             return rng.permutation(n)
         return np.arange(n)
 
-    def _make_batch(self, idxs: np.ndarray, rngs, pool):
-        n_real = len(idxs)
+    def _strip(self, batch: tuple) -> tuple:
+        """Drop the flip-flag plane for consumers whose compiled step has
+        no flag argument (val: the draws are always (False, False))."""
+        if self.raw_tail and not self.emit_flags:
+            return batch[:2]
+        return batch
+
+    def _dummy_batch(self, rng) -> tuple:
+        """Ragged multi-host tail where this process's slice is empty:
+        an all-ignored batch so every host still joins the collectives."""
+        if self._dummy is not None and self._dummy_epoch == self.epoch:
+            return self._dummy
         want = self.local_batch
-        if n_real == 0:
-            # ragged multi-host tail where this process's slice is empty:
-            # emit an all-ignored batch so every host still joins the
-            # collectives for this step
-            img0, mask0 = self.dataset.get(0, rngs[0])
-            images = np.repeat(img0[None], want, axis=0)
-            masks = np.full((want,) + mask0.shape, self.ignore_index,
-                            mask0.dtype)
-            return images, masks
-        if pool is not None:
-            samples = list(pool.map(
-                lambda a: self.dataset.get(int(a[0]), a[1]),
-                zip(idxs, rngs)))
-        else:
-            samples = [self.dataset.get(int(i), r)
-                       for i, r in zip(idxs, rngs)]
-        images = np.stack([s[0] for s in samples])
-        masks = np.stack([s[1] for s in samples])
-        if n_real < want:                       # ragged val tail: pad+ignore
-            reps = want - n_real
-            images = np.concatenate(
-                [images, np.repeat(images[-1:], reps, axis=0)])
-            pad_masks = np.full((reps,) + masks.shape[1:], self.ignore_index,
-                                masks.dtype)
-            masks = np.concatenate([masks, pad_masks])
-        return images, masks
+        s0 = self.source.get(0, rng)
+        img0, mask0 = s0[0], s0[1]
+        images = np.repeat(np.asarray(img0)[None], want, axis=0)
+        masks = np.full((want,) + mask0.shape, self.ignore_index,
+                        mask0.dtype)
+        batch = (images, masks)
+        if self.raw_tail:
+            batch = batch + (np.zeros((want, 2), np.uint8),)
+        self._dummy = batch
+        self._dummy_epoch = self.epoch
+        return batch
+
+    def _make_batch(self, idxs: np.ndarray, rngs, pool):
+        if len(idxs) == 0:
+            return self._dummy_batch(rngs[0])
+        return assemble_batch(self.source, idxs, rngs, self.local_batch,
+                              self.ignore_index,
+                              map_fn=pool.map if pool is not None else None)
 
     def _sample_rngs(self, batch_idx: int):
-        """Deterministic per-sample augmentation rng: a fixed function of
-        (seed, epoch, process, batch, slot) so parallel fetch order cannot
-        change the draws (same contract as the reference's seeded workers)."""
-        return [np.random.default_rng(
-            (self.seed, self.epoch, self.process_index, batch_idx, j))
-            for j in range(self.local_batch)]
+        """Deterministic per-sample augmentation rng (same contract as the
+        reference's seeded workers) — shared with the forked augment
+        workers via segpipe.source.sample_rngs, the single copy of the
+        derivation."""
+        return sample_rngs(self.seed, self.epoch, self.process_index,
+                           batch_idx, self.local_batch)
 
-    def __iter__(self) -> Iterator[Tuple[np.ndarray, np.ndarray]]:
+    def _local_slices(self, indices: np.ndarray):
+        """[(batch_index, this process's index slice)] for the epoch."""
+        out = []
+        for b in range(len(self)):
+            start = b * self.global_batch
+            batch_idx = indices[start:start + self.global_batch]
+            # this process's contiguous slice of the global batch
+            lo = self.process_index * self.local_batch
+            hi = lo + self.local_batch
+            out.append((b, batch_idx[lo:hi]))
+        return out
+
+    def _emit_cache_event(self, extra_hits: int = 0,
+                          extra_misses: int = 0) -> None:
+        sink = get_sink()
+        h, m = self.source.take_counts()
+        h += extra_hits
+        m += extra_misses
+        self.last_cache_counts = (h, m)
+        if sink is not None and (h or m):
+            sink.emit({'event': 'cache', 'tag': self.tag,
+                       'epoch': self.epoch, 'hits': h, 'misses': m,
+                       'cached': self.source.cache is not None})
+
+    # ------------------------------------------------------------- iteration
+    def _iter_mp(self) -> Iterator[tuple]:
+        """Forked augment workers over the shared-memory ring."""
+        slices = self._local_slices(self._epoch_indices())
+        work = [(b, idxs) for b, idxs in slices if len(idxs)]
+        probe = self.source.get(0, self._sample_rngs(0)[0])
+        # drain the probe's count before forking: workers inherit the
+        # source, and a non-zero counter would be re-reported once per
+        # worker (triple-counting the probe in cache telemetry)
+        probe_h, probe_m = self.source.take_counts()
+        pool = AugmentPool(
+            self.source, self.local_batch,
+            probe[0].shape, probe[0].dtype, probe[1].shape, probe[1].dtype,
+            seed=self.seed, epoch=self.epoch,
+            process_index=self.process_index,
+            ignore_index=self.ignore_index, workers=self.mp_workers)
+        try:
+            it = pool.run(work)
+            for b, idxs in slices:
+                with span('data/produce'):
+                    batch = (self._dummy_batch(self._sample_rngs(b)[0])
+                             if len(idxs) == 0 else next(it))
+                yield self._strip(batch)
+        finally:
+            # probe + worker-side counts are tallied explicitly; dummy
+            # fetches (parent-side, post-fork) drain from the source
+            # inside _emit_cache_event
+            self._emit_cache_event(probe_h + pool.hits,
+                                   probe_m + pool.misses)
+            pool.close()
+
+    def _iter_threaded(self) -> Iterator[tuple]:
+        """In-process producer thread (+ optional fetch thread pool)."""
         from concurrent.futures import ThreadPoolExecutor
-        indices = self._epoch_indices()
-        n = len(indices)
-        nb = len(self)
+        slices = self._local_slices(self._epoch_indices())
         pool = (ThreadPoolExecutor(max_workers=self.workers)
                 if self.workers > 1 else None)
 
@@ -128,13 +216,7 @@ class ShardedLoader:
 
         def producer(q: queue.Queue):
             try:
-                for b in range(nb):
-                    start = b * self.global_batch
-                    batch_idx = indices[start:start + self.global_batch]
-                    # this process's contiguous slice of the global batch
-                    lo = self.process_index * self.local_batch
-                    hi = lo + self.local_batch
-                    local_idx = batch_idx[lo:hi]
+                for b, local_idx in slices:
                     # segscope: producer-side batch production time — the
                     # consumer-side wait is timed by the trainer's
                     # StepCollector; comparing the two separates "loader
@@ -142,7 +224,7 @@ class ShardedLoader:
                     with span('data/produce'):
                         batch = self._make_batch(local_idx,
                                                  self._sample_rngs(b), pool)
-                    if not put(q, batch):
+                    if not put(q, self._strip(batch)):
                         return                  # consumer went away
                 put(q, None)
             except BaseException as e:          # surface worker errors
@@ -163,5 +245,11 @@ class ShardedLoader:
             # unblock the producer if the consumer exits early (exception in
             # the train step, early stop, abandoned iterator)
             stop.set()
+            self._emit_cache_event()
             if pool is not None:
                 pool.shutdown(wait=False, cancel_futures=True)
+
+    def __iter__(self) -> Iterator[Tuple[np.ndarray, ...]]:
+        if self.mp_workers > 0:
+            return self._iter_mp()
+        return self._iter_threaded()

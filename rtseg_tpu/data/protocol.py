@@ -1,0 +1,73 @@
+"""Shared segpipe protocol for file-backed datasets.
+
+One implementation of the prepare/augment split both disk datasets use
+(``get == augment(*prepare(i), rng)``, byte-identical to the original
+single-pass ``get``): ``prepare`` is the deterministic decode + resize
+head the packed cache stores once; ``augment``/``augment_raw`` are the
+random suffix (host-normalize vs raw-uint8 flavors). Subclasses provide
+``images``/``masks``/``transform`` and override the two variation
+points:
+
+  * ``spec_name`` — the dataset tag in the cache content hash;
+  * ``_encode_mask`` — mask post-processing AFTER the augment suffix
+    (Cityscapes' raw-id -> trainId LUT; identity int32 cast for Custom).
+    Post-augment because PadIfNeeded pads masks with raw 0, which must
+    keep its raw-id meaning until encoding; the LUT is elementwise so it
+    commutes with the flips ``augment_raw`` defers to the device.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+from PIL import Image
+
+
+class SegpipeFileDataset:
+    spec_name = 'custom'
+
+    def __len__(self):
+        return len(self.images)
+
+    def _encode_mask(self, mask: np.ndarray) -> np.ndarray:
+        return mask.astype(np.int32)
+
+    def prepare(self, index: int):
+        image = np.asarray(Image.open(self.images[index]).convert('RGB'))
+        mask = np.asarray(Image.open(self.masks[index]).convert('L'))
+        return self.transform.prefix(image, mask)
+
+    def augment(self, image, mask, rng: np.random.Generator):
+        image, mask = self.transform.suffix(image, mask, rng)
+        return image, self._encode_mask(mask)
+
+    def augment_raw(self, image, mask, rng: np.random.Generator):
+        """uint8 image + unflipped encoded mask + flip draws, for the
+        on-device flip/normalize stage (ops/augment.device_flip_norm)."""
+        image, mask, flips = self.transform.suffix_raw(image, mask, rng)
+        return image, self._encode_mask(mask), flips
+
+    @property
+    def supports_raw_tail(self) -> bool:
+        return self.transform.supports_raw_tail
+
+    def norm_coeffs(self):
+        return self.transform.norm_coeffs()
+
+    def cache_spec(self) -> dict:
+        """Identity of the prepare() output for the packed-cache content
+        hash: source files (path/size/mtime_ns — nanosecond stamps, so a
+        same-size same-second rewrite still re-keys) + the prefix-stage
+        transform config."""
+        c = self.transform.config
+        files = []
+        for p in (*self.images, *self.masks):
+            st = os.stat(p)
+            files.append((p, st.st_size, st.st_mtime_ns))
+        return {'dataset': self.spec_name, 'scale': c.scale,
+                'square': self.transform.square_size, 'files': files}
+
+    def get(self, index: int, rng: np.random.Generator):
+        image, mask = self.prepare(index)
+        return self.augment(image, mask, rng)

@@ -1,0 +1,27 @@
+"""segpipe — the packed input pipeline (see README "Input pipeline").
+
+Three composable pieces, each exact w.r.t. the seed-era path:
+
+  * :mod:`cache`   — packed sample cache: the deterministic decode+resize
+    head of every dataset, built once into fixed-shape mmap shards,
+    content-hashed against dataset files + transform config;
+  * :mod:`workers` — multi-process augment workers over a shared-memory
+    ring buffer (the random crop/flip/jitter suffix), same (seed, epoch,
+    index) determinism contract as the serial path;
+  * :mod:`prefetch` — async uint8 device prefetch: ``make_global_array``
+    on a background thread, depth-2 buffer, ``data/h2d`` spans.
+
+The on-device half of the raw uint8 handoff (flip + normalize inside the
+jit'd step) lives in :mod:`rtseg_tpu.ops.augment`, covered by the
+trace-purity/obs-purity lints like every other op.
+"""
+
+from .cache import (CacheUnsupported, PackedCache, build_cache, cache_key,
+                    open_or_build)
+from .prefetch import DevicePrefetcher
+from .source import SampleSource, assemble_batch
+from .workers import AugmentPool
+
+__all__ = ['AugmentPool', 'CacheUnsupported', 'DevicePrefetcher',
+           'PackedCache', 'SampleSource', 'assemble_batch', 'build_cache',
+           'cache_key', 'open_or_build']

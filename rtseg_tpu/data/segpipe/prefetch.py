@@ -1,0 +1,114 @@
+"""Async device prefetch: overlap H2D transfer with device compute.
+
+The seed-era trainer called ``make_global_array`` synchronously inside the
+step loop — every step paid the full host->device copy on the critical
+path, and paid it in float32 (4x the bytes of the uint8 batches the raw
+augment tail produces). DevicePrefetcher moves that transfer onto a
+background thread with a small bounded buffer (default depth 2): while the
+device chews on step N, the host is already shipping batch N+1 (and the
+loader's own producer is assembling N+2). The trainer's ``put_fn`` wraps
+each transfer in a ``data/h2d`` span, so segscope reports show exactly how
+much wall time the transfer takes and whether it is hidden
+(tools/segscope.py report's h2d row).
+
+Ordering is preserved (single producer thread, FIFO queue); exceptions
+from the loader or the transfer re-raise in the consumer; ``close()``
+tears the thread down without deadlocking even when the consumer abandons
+mid-epoch (step exception, early stop).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterable, Iterator
+
+
+class DevicePrefetcher:
+    """Iterate ``put_fn(batch) for batch in it`` with ``depth`` transfers
+    in flight on a background thread."""
+
+    def __init__(self, it: Iterable, put_fn: Callable[[Any], Any],
+                 depth: int = 2):
+        assert depth >= 1
+        self._src = it
+        self._put_fn = put_fn
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name='segpipe-h2d')
+        self._thread.start()
+
+    # ------------------------------------------------------- producer thread
+    def _offer(self, item) -> bool:
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _run(self) -> None:
+        it = iter(self._src)
+        try:
+            while not self._stop.is_set():
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    self._offer(None)
+                    return
+                dev = self._put_fn(batch)
+                if not self._offer(dev):
+                    return              # consumer went away
+        except BaseException as e:      # loader/transfer errors -> consumer
+            self._offer(e)
+        finally:
+            # the generator is owned by THIS thread: closing it here runs
+            # the loader's finally (producer-thread/pool teardown)
+            close = getattr(it, 'close', None)
+            if close is not None:
+                try:
+                    close()
+                except Exception:   # noqa: BLE001 — teardown best-effort
+                    pass
+
+    # --------------------------------------------------------- consumer side
+    def __iter__(self) -> Iterator:
+        return self
+
+    def __next__(self):
+        while True:
+            try:
+                item = self._q.get(timeout=1.0)
+                break
+            except queue.Empty:
+                if not self._thread.is_alive():
+                    # thread always offers None/exception before exiting
+                    # unless it was killed hard; don't hang on it
+                    raise StopIteration
+        if item is None:
+            self._stop.set()
+            raise StopIteration
+        if isinstance(item, BaseException):
+            self._stop.set()
+            raise item
+        return item
+
+    def close(self) -> None:
+        """Stop the producer and release the underlying iterator; safe to
+        call multiple times and from ``finally`` blocks."""
+        self._stop.set()
+        # unblock a producer waiting on a full queue
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=5.0)
+
+    def __enter__(self) -> 'DevicePrefetcher':
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

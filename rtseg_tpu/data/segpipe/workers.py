@@ -1,0 +1,276 @@
+"""Multi-process augment workers over a shared-memory ring buffer.
+
+The seed-era loader parallelized the per-sample fetch with a thread pool
+— fine while cv2/PIL hold the GIL released, but the pure-numpy parts of
+the augment suffix (crop views, stacking, jitter blends) and the packed-
+cache fast path (mmap read + crop) are GIL-bound, so threads stop scaling
+exactly when the cache makes samples cheap. This pool moves the
+random-augment stage into real processes:
+
+  * a ring of ``slots`` batch-sized buffers in one
+    ``multiprocessing.shared_memory`` block — workers write augmented
+    batches straight into the slot (no pickling of image tensors, no
+    pipe copies); the parent copies out (one u8/f32 memcpy) and recycles
+    the slot;
+  * tasks are (slot, batch_index, sample indices); each worker reseeds
+    per-sample generators from (seed, epoch, process, batch, slot_in_
+    batch) — the loader's existing determinism contract — so batch
+    content is independent of which worker runs it and byte-identical to
+    the serial path (pinned by tests/test_segpipe.py);
+  * worker exceptions are pickled back and re-raised in the parent; a
+    worker that dies without reporting (segfault, OOM-kill) is detected
+    by liveness polling and surfaces as a RuntimeError instead of a hang.
+
+Start method: fork where available (Linux — workers inherit the dataset,
+the packed cache's read-only mmaps and loaded libraries for free), spawn
+otherwise (everything shipped is picklable).
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import pickle
+import queue as queue_mod
+import traceback
+from typing import Dict, Iterator, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .source import SampleSource, assemble_batch, sample_rngs
+
+
+def _slot_layout(want: int, img_shape, img_dtype, mask_shape, mask_dtype,
+                 raw_tail: bool):
+    img_dtype, mask_dtype = np.dtype(img_dtype), np.dtype(mask_dtype)
+    img_b = want * int(np.prod(img_shape)) * img_dtype.itemsize
+    mask_b = want * int(np.prod(mask_shape)) * mask_dtype.itemsize
+    flag_b = want * 2 if raw_tail else 0
+    return {
+        'want': want,
+        'img_shape': tuple(img_shape), 'img_dtype': img_dtype,
+        'mask_shape': tuple(mask_shape), 'mask_dtype': mask_dtype,
+        'raw_tail': raw_tail,
+        'img_b': img_b, 'mask_b': mask_b, 'flag_b': flag_b,
+        'slot_b': img_b + mask_b + flag_b,
+    }
+
+
+def _write_slot(buf, layout, slot: int, out) -> None:
+    """Copy one assembled batch into the ring slot; no views escape (a
+    live view would block SharedMemory.close with BufferError)."""
+    img_v, mask_v, flag_v = _slot_views(buf, layout, slot)
+    img_v[:] = out[0]
+    mask_v[:] = out[1]
+    if flag_v is not None:
+        flag_v[:] = out[2]
+
+
+def _read_slot(buf, layout, slot: int):
+    """Copy one batch out of the ring slot (the slot is recycled the
+    moment this returns); no views escape."""
+    img_v, mask_v, flag_v = _slot_views(buf, layout, slot)
+    out = (np.array(img_v), np.array(mask_v))
+    if flag_v is not None:
+        out = out + (np.array(flag_v),)
+    return out
+
+
+def _slot_views(buf, layout, slot: int):
+    base = slot * layout['slot_b']
+    want = layout['want']
+    img = np.frombuffer(buf, layout['img_dtype'], offset=base,
+                        count=want * int(np.prod(layout['img_shape']))
+                        ).reshape((want,) + layout['img_shape'])
+    mask = np.frombuffer(buf, layout['mask_dtype'],
+                         offset=base + layout['img_b'],
+                         count=want * int(np.prod(layout['mask_shape']))
+                         ).reshape((want,) + layout['mask_shape'])
+    flags = None
+    if layout['raw_tail']:
+        flags = np.frombuffer(buf, np.uint8,
+                              offset=base + layout['img_b']
+                              + layout['mask_b'],
+                              count=want * 2).reshape(want, 2)
+    return img, mask, flags
+
+
+def _worker_main(shm, layout, source: SampleSource, seed: int,
+                 process_index: int, ignore_index: int, task_q, result_q):
+    # ``shm`` arrives by fork inheritance (no reattach, no duplicate
+    # resource-tracker registration) or, under spawn, by pickle-by-name
+    try:
+        import cv2
+        cv2.setNumThreads(0)        # no per-worker thread fan-out on top
+    except Exception:   # noqa: BLE001 — cv2-free sources still work
+        pass
+    try:
+        while True:
+            task = task_q.get()
+            if task is None:
+                return
+            slot, epoch, b, idxs = task
+            try:
+                rngs = sample_rngs(seed, epoch, process_index, b,
+                                   layout['want'])
+                out = assemble_batch(source, idxs, rngs, layout['want'],
+                                     ignore_index)
+                _write_slot(shm.buf, layout, slot, out)
+                result_q.put((b, slot, None, source.take_counts()))
+            except BaseException as e:      # report, keep serving
+                try:
+                    payload = pickle.dumps(e)
+                except Exception:   # noqa: BLE001 — unpicklable exception
+                    payload = None
+                result_q.put((b, slot,
+                              (payload, type(e).__name__, str(e),
+                               traceback.format_exc()), (0, 0)))
+    finally:
+        del shm                     # parent owns close()+unlink()
+
+
+class AugmentPool:
+    """One epoch's worth of multi-process batch production.
+
+    ``run(batches)`` consumes an iterable of (batch_index, local_idxs)
+    and yields completed (images, masks[, flags]) batches **in batch
+    order**, keeping up to ``slots`` batches in flight across ``workers``
+    processes. Use as a context manager — exit tears the processes and
+    the shared-memory ring down even when the consumer abandons early.
+    """
+
+    def __init__(self, source: SampleSource, want: int, img_shape,
+                 img_dtype, mask_shape, mask_dtype, seed: int, epoch: int,
+                 process_index: int, ignore_index: int, workers: int,
+                 slots: Optional[int] = None):
+        from multiprocessing import shared_memory
+        assert workers >= 1
+        self.layout = _slot_layout(want, img_shape, img_dtype, mask_shape,
+                                   mask_dtype, source.raw_tail)
+        self.slots = slots if slots is not None else workers + 2
+        self.epoch = epoch
+        self.hits = 0
+        self.misses = 0
+        try:
+            ctx = mp.get_context('fork')
+        except ValueError:          # no fork on this platform
+            ctx = mp.get_context('spawn')
+        self._shm = shared_memory.SharedMemory(
+            create=True, size=max(1, self.slots * self.layout['slot_b']))
+        self._task_q = ctx.Queue()
+        self._result_q = ctx.Queue()
+        self._procs = [
+            ctx.Process(target=_worker_main,
+                        args=(self._shm, self.layout, source, seed,
+                              process_index, ignore_index, self._task_q,
+                              self._result_q),
+                        daemon=True, name=f'segpipe-aug-{w}')
+            for w in range(workers)]
+        import warnings
+        with warnings.catch_warnings():
+            # jax warns that os.fork() from a multithreaded process can
+            # deadlock; these children never call into jax (numpy/cv2/mp
+            # only) — the same trade torch's DataLoader workers make
+            warnings.filterwarnings('ignore', message='.*os.fork.*',
+                                    category=RuntimeWarning)
+            for p in self._procs:
+                p.start()
+        self._closed = False
+
+    # ------------------------------------------------------------- epoch run
+    def run(self, batches: Sequence[Tuple[int, np.ndarray]]
+            ) -> Iterator[tuple]:
+        todo = list(batches)
+        free = list(range(self.slots))
+        done: Dict[int, tuple] = {}
+        next_yield = todo[0][0] if todo else 0
+        submit_at = 0
+        last = todo[-1][0] if todo else -1
+        while next_yield <= last:
+            while submit_at < len(todo) and free:
+                b, idxs = todo[submit_at]
+                self._task_q.put((free.pop(), self.epoch, b,
+                                  np.asarray(idxs)))
+                submit_at += 1
+            if next_yield in done:
+                out = done.pop(next_yield)
+                next_yield += 1
+                yield out
+                continue
+            try:
+                b, slot, err, counts = self._result_q.get(timeout=1.0)
+            except queue_mod.Empty:
+                dead = [p for p in self._procs if not p.is_alive()]
+                if dead:
+                    raise RuntimeError(
+                        f'augment worker {dead[0].name} died '
+                        f'(exitcode {dead[0].exitcode}) without reporting '
+                        f'a result — batch production cannot continue')
+                continue
+            if counts:
+                self.hits += counts[0]
+                self.misses += counts[1]
+            if err is not None:
+                payload, typ, msg, tb = err
+                exc = None
+                if payload is not None:
+                    try:
+                        exc = pickle.loads(payload)
+                    # anything — multi-arg __init__ exceptions raise
+                    # TypeError, __main__ classes ImportError under spawn;
+                    # never let a rehydration failure mask the real error
+                    except Exception:   # noqa: BLE001
+                        exc = None
+                if exc is not None:
+                    raise exc
+                raise RuntimeError(
+                    f'augment worker failed on batch {b}: {typ}: {msg}\n'
+                    f'{tb}')
+            # copy out of the ring so the slot can be recycled immediately
+            done[b] = _read_slot(self._shm.buf, self.layout, slot)
+            free.append(slot)
+
+    # -------------------------------------------------------------- teardown
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for _ in self._procs:
+            try:
+                self._task_q.put_nowait(None)
+            except Exception:   # noqa: BLE001 — full queue on teardown
+                pass
+        for p in self._procs:
+            p.join(timeout=2.0)
+        for p in self._procs:
+            if p.is_alive():
+                p.terminate()
+                p.join(timeout=2.0)
+        # drain result queue so its feeder thread lets the process exit
+        try:
+            while True:
+                self._result_q.get_nowait()
+        except queue_mod.Empty:
+            pass
+        for q in (self._task_q, self._result_q):
+            try:
+                q.close()
+                q.join_thread()
+            except Exception:   # noqa: BLE001 — already-closed queue
+                pass
+        try:
+            self._shm.close()
+            self._shm.unlink()
+        except Exception:   # noqa: BLE001 — double unlink on races
+            pass
+
+    def __enter__(self) -> 'AugmentPool':
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:   # noqa: BLE001 — interpreter teardown
+            pass

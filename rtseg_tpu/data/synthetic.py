@@ -35,6 +35,23 @@ class Synthetic:
     def __len__(self):
         return self.length
 
+    # segpipe protocol: the whole sample is a deterministic function of
+    # (mode, index), so prepare() is the full generation and augment() the
+    # identity — a packed cache turns per-epoch RNG rendering into an mmap
+    # read. Float-native images: no uint8 raw tail.
+    supports_raw_tail = False
+
+    def prepare(self, index: int):
+        return self.get(index)
+
+    def augment(self, image, mask, rng: np.random.Generator = None):
+        return image, mask
+
+    def cache_spec(self) -> dict:
+        return {'dataset': 'synthetic', 'mode': self.mode,
+                'length': self.length, 'h': self.h, 'w': self.w,
+                'num_class': self.num_class}
+
     def get(self, index: int, rng: np.random.Generator = None):
         # content depends only on (mode, index) -> reproducible across
         # runs/hosts, and val never aliases train samples

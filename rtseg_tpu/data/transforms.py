@@ -210,7 +210,14 @@ def resize_to_square(image, mask, size: int):
 
 class TrainTransform:
     """The reference train-time stack; `identity_norm` selects the custom
-    dataset's Normalize(mean=0, std=1) variant."""
+    dataset's Normalize(mean=0, std=1) variant.
+
+    Split into a deterministic ``prefix`` (square resize + fixed scale —
+    what the segpipe packed cache stores once) and a random ``suffix``
+    (random-scale/pad/crop/jitter/flips/normalize — recomputed per epoch),
+    with ``__call__ = suffix ∘ prefix`` so the split is byte-identical to
+    the original single pass (pinned by tests/test_segpipe.py).
+    """
 
     def __init__(self, config, identity_norm: bool = False,
                  square_size: Optional[int] = None):
@@ -218,26 +225,67 @@ class TrainTransform:
         self.identity_norm = identity_norm
         self.square_size = square_size
 
-    def __call__(self, image, mask, rng: np.random.Generator):
+    @property
+    def supports_raw_tail(self) -> bool:
+        """Whether ``suffix_raw`` can hand off uint8: color jitter promotes
+        to float32, so the 4x-smaller uint8 device transfer is exact only
+        with jitter disabled."""
+        c = self.config
+        return c.brightness == 0 and c.contrast == 0 and c.saturation == 0
+
+    def norm_coeffs(self):
+        """(scale, bias) of the normalize tail — the constants the
+        on-device stage (ops/augment.device_flip_norm) bakes into the
+        compiled step."""
+        return _norm_coeffs(self.identity_norm)
+
+    def prefix(self, image, mask):
+        """Deterministic, rng-free head: cacheable per sample."""
         c = self.config
         if self.square_size:
             image, mask = resize_to_square(image, mask, self.square_size)
-        image, mask = scale(image, mask, c.scale)
+        return scale(image, mask, c.scale)
+
+    def _suffix_head(self, image, mask, rng: np.random.Generator):
+        """Shared random stage up to (but not including) the flip draws."""
+        c = self.config
         image, mask = random_scale(image, mask, c.randscale, rng)
         image, mask = pad_if_needed(image, mask, c.crop_h, c.crop_w)
         image, mask = random_crop(image, mask, c.crop_h, c.crop_w, rng)
-        image = color_jitter(image, c.brightness, c.contrast, c.saturation, rng)
+        image = color_jitter(image, c.brightness, c.contrast, c.saturation,
+                             rng)
         # same rng draw order as horizontal_flip/vertical_flip, but the
-        # flips are folded into the fused normalize pass
+        # flips are folded into the fused normalize pass (or deferred to
+        # the device by suffix_raw)
         do_h = c.h_flip > 0 and rng.random() < c.h_flip
         do_v = c.v_flip > 0 and rng.random() < c.v_flip
-        image, mask = flip_norm_pack(image, mask, do_h, do_v,
-                                     self.identity_norm)
-        return image, mask
+        return image, mask, do_h, do_v
+
+    def suffix(self, image, mask, rng: np.random.Generator):
+        """Random tail incl. the host normalize/flip pack (f32 out)."""
+        image, mask, do_h, do_v = self._suffix_head(image, mask, rng)
+        return flip_norm_pack(image, mask, do_h, do_v, self.identity_norm)
+
+    def suffix_raw(self, image, mask, rng: np.random.Generator):
+        """Random tail WITHOUT the normalize/flip pack: returns the
+        pre-normalize (uint8) image, the unflipped mask and the flip draws
+        — the device-side stage applies flips + normalize inside the jit'd
+        step. Identical rng draw sequence to ``suffix``; requires
+        ``supports_raw_tail`` (jitter would promote the image to f32)."""
+        image, mask, do_h, do_v = self._suffix_head(image, mask, rng)
+        image = np.ascontiguousarray(image)      # crop yields strided views
+        if mask is not None:
+            mask = np.ascontiguousarray(mask)
+        return image, mask, (do_h, do_v)
+
+    def __call__(self, image, mask, rng: np.random.Generator):
+        image, mask = self.prefix(image, mask)
+        return self.suffix(image, mask, rng)
 
 
 class EvalTransform:
-    """The reference val/test stack: (square) scale + normalize."""
+    """The reference val/test stack: (square) scale + normalize. Same
+    prefix/suffix split as TrainTransform (the suffix is rng-free)."""
 
     def __init__(self, config, identity_norm: bool = False,
                  square_size: Optional[int] = None):
@@ -245,13 +293,32 @@ class EvalTransform:
         self.identity_norm = identity_norm
         self.square_size = square_size
 
-    def __call__(self, image, mask=None, rng=None):
+    #: no jitter in the eval stack — the uint8 handoff is always exact
+    supports_raw_tail = True
+
+    def norm_coeffs(self):
+        return _norm_coeffs(self.identity_norm)
+
+    def prefix(self, image, mask):
         c = self.config
         if self.square_size:
             image, mask = resize_to_square(image, mask, self.square_size)
-        image, mask = scale(image, mask, c.scale)
+        return scale(image, mask, c.scale)
+
+    def suffix(self, image, mask, rng=None):
         image, mask = flip_norm_pack(image, mask, False, False,
                                      self.identity_norm)
+        return image, mask
+
+    def suffix_raw(self, image, mask, rng=None):
+        image = np.ascontiguousarray(image)
+        if mask is not None:
+            mask = np.ascontiguousarray(mask)
+        return image, mask, (False, False)
+
+    def __call__(self, image, mask=None, rng=None):
+        image, mask = self.prefix(image, mask)
+        image, mask = self.suffix(image, mask, rng)
         if mask is None:
             return image
         return image, mask

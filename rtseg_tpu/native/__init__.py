@@ -37,14 +37,24 @@ def _build() -> Optional[Path]:
     """Compile (or reuse) the shared library; never raises — any failure
     (no compiler, read-only package dir, ...) degrades to the numpy path."""
     try:
-        if _SO.exists() and _SO.stat().st_mtime >= _SRC.stat().st_mtime:
+        # stale when older than the source OR this builder (whose flags
+        # are part of the kernel's numerics contract, e.g. fp-contract)
+        newest_dep = max(_SRC.stat().st_mtime,
+                         Path(__file__).stat().st_mtime)
+        if _SO.exists() and _SO.stat().st_mtime >= newest_dep:
             return _SO
         _SO.parent.mkdir(exist_ok=True)
         cc = os.environ.get('CC', 'cc')
         # compile to a temp name + atomic rename: a concurrent process
         # must never dlopen a half-written ELF
         tmp = _SO.with_suffix(f'.{os.getpid()}.tmp.so')
-        cmd = [cc, '-O3', '-shared', '-fPIC', '-o', str(tmp), str(_SRC)]
+        # -ffp-contract=off: the kernel's px*scale+bias must round twice
+        # like the numpy path (and the segpipe device LUT derived from
+        # it) — GCC's GNU-mode default of fp-contract=fast would emit
+        # fmadd on FMA-baseline targets (aarch64, x86-64-v3) and break
+        # the pinned host/device bit-parity by 1 ulp
+        cmd = [cc, '-O3', '-ffp-contract=off', '-shared', '-fPIC',
+               '-o', str(tmp), str(_SRC)]
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.replace(tmp, _SO)
     except (OSError, subprocess.SubprocessError):

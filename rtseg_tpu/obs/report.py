@@ -160,6 +160,22 @@ def summarize(events: List[dict]) -> Dict[str, Any]:
         agg['count'] += 1
         agg['total_s'] = round(agg['total_s'] + float(e.get('dur_s', 0.0)),
                                6)
+
+    # segpipe: host->device transfer stage (data/h2d spans from the
+    # trainer's put path — under async prefetch this time overlaps device
+    # compute, so a large h2d total with near-zero data-wait is healthy)
+    h2d = spans.get('data/h2d')
+    h2d_s = float(h2d['total_s']) if h2d else None
+    h2d_n = int(h2d['count']) if h2d else 0
+    # segpipe: packed-cache hit rate (per-epoch 'cache' events from the
+    # loaders; hits = mmap reads, misses = decode-path fetches). Only
+    # cache-backed loaders count — uncached runs also emit decode-fetch
+    # events (cached: false) but a run with no cache has no hit rate.
+    cev = [e for e in events if e.get('event') == 'cache'
+           and e.get('cached') and mine(e)]
+    hits = sum(int(e.get('hits', 0)) for e in cev)
+    misses = sum(int(e.get('misses', 0)) for e in cev)
+    cache_hit_rate = hits / (hits + misses) if (hits + misses) else None
     memory = next((e for e in reversed(events)
                    if e.get('event') == 'memory' and mine(e)), None)
 
@@ -179,6 +195,11 @@ def summarize(events: List[dict]) -> Dict[str, Any]:
         'compile_s': compile_s,
         'stalls': len(stalls),
         'wall_s': wall,
+        'h2d_s': h2d_s,
+        'h2d_transfers': h2d_n,
+        'cache_hits': hits,
+        'cache_misses': misses,
+        'cache_hit_rate': cache_hit_rate,
         'epochs': len([e for e in events if e.get('event') == 'epoch'
                        and e.get('kind') == 'train' and mine(e)]),
         'serving': serving,
@@ -216,6 +237,18 @@ def format_summary(s: Dict[str, Any], path: str = '') -> str:
         f'  stalls         : {s["stalls"]}',
         f'  wall           : {s["wall_s"]:.1f} s',
     ]
+    if s.get('h2d_s') is not None:
+        per = (1e3 * s['h2d_s'] / s['h2d_transfers']
+               if s['h2d_transfers'] else 0.0)
+        lines.append(
+            f'  h2d            : {s["h2d_s"]:.2f} s over '
+            f'{s["h2d_transfers"]} transfers ({per:.2f} ms each'
+            f'{", overlapped" if s["data_wait_frac"] < 0.01 else ""})')
+    if s.get('cache_hit_rate') is not None:
+        lines.append(
+            f'  cache-hit rate : {100 * s["cache_hit_rate"]:.1f}% '
+            f'({s["cache_hits"]}/{s["cache_hits"] + s["cache_misses"]} '
+            f'sample fetches from the packed cache)')
     if s.get('serving'):
         sv = s['serving']
 
@@ -257,6 +290,8 @@ _DIFF_ROWS = (
     ('step_p95_s', 'step p95 (ms)', 1e3, False),
     ('imgs_per_sec', 'imgs/sec', 1.0, True),
     ('data_wait_frac', 'data-wait (%)', 100.0, False),
+    ('h2d_s', 'h2d (s)', 1.0, False),
+    ('cache_hit_rate', 'cache-hit (%)', 100.0, True),
     ('goodput', 'goodput (%)', 100.0, True),
     ('compile_s', 'compile (s)', 1.0, False),
     ('stalls', 'stalls', 1.0, False),

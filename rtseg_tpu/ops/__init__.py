@@ -1,3 +1,4 @@
+from .augment import device_flip_norm, device_normalize
 from .resize import (resize_bilinear, resize_nearest, pixel_shuffle,
                      scale_resize, final_upsample, set_defer_final_upsample,
                      get_defer_final_upsample)
@@ -7,6 +8,7 @@ from .pool import (max_pool, avg_pool, max_pool_argmax_2x2, max_unpool_2x2,
 from .shuffle import channel_shuffle, channel_split
 
 __all__ = [
+    'device_flip_norm', 'device_normalize',
     'resize_bilinear', 'resize_nearest', 'pixel_shuffle', 'scale_resize',
     'final_upsample', 'set_defer_final_upsample', 'get_defer_final_upsample',
     'fused_path', 'resize_argmax',

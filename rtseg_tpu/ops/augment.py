@@ -1,0 +1,77 @@
+"""On-device augmentation tail: flip + normalize inside the jit'd step.
+
+The device half of segpipe's raw uint8 handoff (data/transforms.py
+``suffix_raw``): the loader ships batches as uint8 HWC — 4x fewer H2D
+bytes than the host-normalized float32 path — plus a per-sample [B, 2]
+uint8 plane of (h_flip, v_flip) draws, and the compiled train/eval step
+opens with this stage. Bit-parity with the host path
+(``transforms.flip_norm_pack``) is exact and pinned by
+tests/test_segpipe.py:
+
+  * flips are pure permutations (jnp reverse / where), identical to the
+    numpy views the host path materializes;
+  * normalize is a 256-entry per-channel lookup table precomputed on the
+    host with the host path's exact rounding (f32(f32(v) * scale) + bias,
+    two roundings). A naive on-device ``x * scale + bias`` is NOT
+    bit-safe: XLA's CPU backend contracts the multiply-add into an FMA
+    (single rounding, 1-ulp difference on ~half the pixels — and
+    jax.lax.optimization_barrier does not block the LLVM-level
+    contraction). uint8 input means the whole normalize is a function of
+    256 values per channel, so a gather reproduces the host arithmetic
+    exactly on every backend with no float math on device.
+
+Everything here is trace-pure jnp (no host RNG, clocks or I/O) — the
+trace-purity/obs-purity lints cover this file via the ``rtseg_tpu/ops/``
+target prefix like every other op kernel.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def _norm_lut(scale, bias) -> np.ndarray:
+    """[256, C] float32 table: lut[v, c] == host normalize of pixel v in
+    channel c, with the host path's exact two-rounding arithmetic
+    (transforms.flip_norm_pack: ``out = x.astype(f32); out *= scale;
+    out += bias``)."""
+    v = np.arange(256, dtype=np.float32)[:, None]
+    lut = v * np.asarray(scale, np.float32)
+    lut += np.asarray(bias, np.float32)
+    return lut
+
+
+def device_normalize(images, scale, bias):
+    """uint8 HWC batch -> normalized float32, bit-identical to the host
+    normalize tail (no-flip variant — the eval transform never flips)."""
+    if images.dtype != jnp.uint8:
+        # non-u8 batches never take this stage in production (the raw
+        # tail ships u8 by contract); keep a sane fallback for ad-hoc use
+        return images.astype(jnp.float32) \
+            * jnp.asarray(np.asarray(scale, np.float32)) \
+            + jnp.asarray(np.asarray(bias, np.float32))
+    c = images.shape[-1]
+    lut = jnp.asarray(_norm_lut(scale, bias).reshape(-1))
+    idx = images.astype(jnp.int32) * c + jnp.arange(c, dtype=jnp.int32)
+    return lut[idx]
+
+
+def device_flip_norm(images, masks, flags, scale, bias):
+    """Per-sample flips + normalize for train batches.
+
+    images: [B, H, W, C] uint8 (pre-flip, pre-normalize)
+    masks:  [B, H, W] int32 (pre-flip)
+    flags:  [B, 2] uint8 — (h_flip, v_flip) host rng draws
+    Returns (normalized f32 images, flipped masks). Flips run on the
+    uint8 plane (cheaper moves), matching the host order flip-then-
+    normalize; flips and the elementwise normalize commute exactly.
+    """
+    do_h = flags[:, 0].astype(jnp.bool_)
+    do_v = flags[:, 1].astype(jnp.bool_)
+    x = jnp.where(do_h[:, None, None, None], images[:, :, ::-1, :], images)
+    x = jnp.where(do_v[:, None, None, None], x[:, ::-1, :, :], x)
+    x = device_normalize(x, scale, bias)
+    m = jnp.where(do_h[:, None, None], masks[:, :, ::-1], masks)
+    m = jnp.where(do_v[:, None, None], m[:, ::-1, :], m)
+    return x, m

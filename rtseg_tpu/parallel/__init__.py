@@ -1,7 +1,9 @@
 from .mesh import (DATA_AXIS, SPATIAL_AXIS, batch_sharding, batch_spec,
-                   init_multihost, local_batch_size, main_rank,
-                   make_global_array, make_mesh, process_count, replicated)
+                   data_sharding, init_multihost, local_batch_size,
+                   main_rank, make_global_array, make_mesh, process_count,
+                   replicated)
 
 __all__ = ['DATA_AXIS', 'SPATIAL_AXIS', 'batch_sharding', 'batch_spec',
-           'init_multihost', 'local_batch_size', 'main_rank',
-           'make_global_array', 'make_mesh', 'process_count', 'replicated']
+           'data_sharding', 'init_multihost', 'local_batch_size',
+           'main_rank', 'make_global_array', 'make_mesh', 'process_count',
+           'replicated']

@@ -68,6 +68,12 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, batch_spec(mesh))
 
 
+def data_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for per-sample side planes ([B, k] — batch axis only; the
+    segpipe flip-flag plane has no spatial dim to put on 'spatial')."""
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
 def make_global_array(local_data: np.ndarray,
                       sharding: NamedSharding) -> jax.Array:
     """Assemble a process-local host batch into a global device array.

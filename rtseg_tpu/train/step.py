@@ -27,8 +27,10 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..losses import (get_detail_loss_fn, get_kd_loss_fn, get_loss_fn,
                       laplacian_pyramid)
 from ..nn import set_bn_axis
-from ..ops import resize_argmax, resize_bilinear, resize_nearest
+from ..ops import (device_flip_norm, device_normalize, resize_argmax,
+                   resize_bilinear, resize_nearest)
 from ..parallel import batch_spec
+from ..parallel.mesh import DATA_AXIS
 from ..utils.metrics import confusion_matrix
 from .state import TrainState, ema_update
 
@@ -170,11 +172,19 @@ def _make_forward_loss(config, model, apply_train, base_rng,
 
 
 def build_train_step(config, model, optimizer, mesh: Mesh,
-                     teacher_model=None, teacher_variables=None) -> Callable:
+                     teacher_model=None, teacher_variables=None,
+                     norm_coeffs=None) -> Callable:
     """Returns step(state, images, masks) -> (state, metrics_dict).
 
     images: [global_B, H, W, 3] fp32/bf16, masks: [global_B, H, W] int32,
     both sharded over the mesh batch axes; state is replicated.
+
+    With ``norm_coeffs=(scale, bias)`` (segpipe's uint8 raw-tail handoff)
+    the signature becomes step(state, images_u8, masks, flags): batches
+    arrive uint8 HWC with per-sample flip draws in ``flags`` [B, 2] u8,
+    and the step opens with the on-device flip+normalize stage
+    (ops/augment.device_flip_norm) — bit-identical to host-normalized
+    input, 4x fewer H2D bytes.
 
     Two compilation strategies:
       * data-only mesh -> shard_map with explicit lax.pmean collectives
@@ -189,7 +199,8 @@ def build_train_step(config, model, optimizer, mesh: Mesh,
     from ..parallel.mesh import SPATIAL_AXIS
     if SPATIAL_AXIS in mesh.axis_names:
         return _build_train_step_gspmd(config, model, optimizer, mesh,
-                                       teacher_model, teacher_variables)
+                                       teacher_model, teacher_variables,
+                                       norm_coeffs)
     axes = _mesh_axes(mesh)
     total_itrs = max(int(config.total_itrs), 1)
 
@@ -202,7 +213,10 @@ def build_train_step(config, model, optimizer, mesh: Mesh,
     forward_loss = _make_forward_loss(config, model, apply_train, base_rng,
                                       axes, teacher_model, teacher_variables)
 
-    def step(state: TrainState, images, masks):
+    def step(state: TrainState, images, masks, flags=None):
+        if norm_coeffs is not None:
+            images, masks = device_flip_norm(images, masks, flags,
+                                             *norm_coeffs)
         grad_fn = jax.value_and_grad(forward_loss, has_aux=True)
         (loss, (new_bs, metrics)), grads = grad_fn(
             state.params, state.batch_stats, images, masks, state.step)
@@ -244,16 +258,23 @@ def build_train_step(config, model, optimizer, mesh: Mesh,
         return new_state, metrics
 
     bspec = batch_spec(mesh)
-    sharded = _shard_map(step, mesh,
-                         in_specs=(P(), bspec, bspec),
-                         out_specs=(P(), P()))
+    if norm_coeffs is not None:
+        sharded = _shard_map(step, mesh,
+                             in_specs=(P(), bspec, bspec, P(DATA_AXIS)),
+                             out_specs=(P(), P()))
+    else:
+        def step2(state, images, masks):
+            return step(state, images, masks)
+        sharded = _shard_map(step2, mesh,
+                             in_specs=(P(), bspec, bspec),
+                             out_specs=(P(), P()))
     return _pin_bn_axis(jax.jit(sharded, donate_argnums=(0,)), bn_axis,
                         config)
 
 
 def _build_train_step_gspmd(config, model, optimizer, mesh: Mesh,
-                            teacher_model=None,
-                            teacher_variables=None) -> Callable:
+                            teacher_model=None, teacher_variables=None,
+                            norm_coeffs=None) -> Callable:
     """GSPMD train step: one jit'd program with sharding annotations; XLA
     partitions convs over ('data', 'spatial') with automatic halo exchange
     and turns the global-mean loss/BN statistics into collectives."""
@@ -267,7 +288,10 @@ def _build_train_step_gspmd(config, model, optimizer, mesh: Mesh,
     forward_loss = _make_forward_loss(config, model, apply_train, base_rng,
                                       (), teacher_model, teacher_variables)
 
-    def step(state: TrainState, images, masks):
+    def step(state: TrainState, images, masks, flags=None):
+        if norm_coeffs is not None:
+            images, masks = device_flip_norm(images, masks, flags,
+                                             *norm_coeffs)
         grad_fn = jax.value_and_grad(forward_loss, has_aux=True)
         (loss, (new_bs, metrics)), grads = grad_fn(
             state.params, state.batch_stats, images, masks, state.step)
@@ -295,9 +319,13 @@ def _build_train_step_gspmd(config, model, optimizer, mesh: Mesh,
 
     bsh = batch_sharding(mesh)
     rep = replicated(mesh)
+    in_sh = (rep, bsh, bsh)
+    if norm_coeffs is not None:
+        # flags are [B, 2]: batch axis only (no spatial dim to shard)
+        in_sh = in_sh + (NamedSharding(mesh, P(DATA_AXIS)),)
     # BN batch stats are already global reductions under GSPMD -> no axis
     return _pin_bn_axis(jax.jit(step,
-                                in_shardings=(rep, bsh, bsh),
+                                in_shardings=in_sh,
                                 out_shardings=(rep, rep),
                                 donate_argnums=(0,)), None, config)
 
@@ -315,8 +343,8 @@ def _resolve_fused_head(config, spatial: bool) -> bool:
     return bool(fused) and not spatial
 
 
-def build_eval_step(config, model, mesh: Mesh, use_ema: bool = True
-                    ) -> Callable:
+def build_eval_step(config, model, mesh: Mesh, use_ema: bool = True,
+                    norm_coeffs=None) -> Callable:
     """Returns eval_step(state, images, masks) -> (C, C) confusion matrix,
     psum'd over the mesh (replaces torchmetrics' internal sync,
     core/seg_trainer.py:131-137). Runs the EMA weights, like the reference
@@ -346,6 +374,10 @@ def build_eval_step(config, model, mesh: Mesh, use_ema: bool = True
     fused = _resolve_fused_head(config, spatial)
 
     def forward_cm(state: TrainState, images, masks):
+        if norm_coeffs is not None:
+            # segpipe raw-tail batches arrive uint8; normalize on-device
+            # (the eval transform never flips, so no flag plane here)
+            images = device_normalize(images, *norm_coeffs)
         params = state.ema_params if use_ema else state.params
         bs = state.ema_batch_stats if use_ema else state.batch_stats
         out = model.apply({'params': params, 'batch_stats': bs},

@@ -32,8 +32,8 @@ from ..data import get_loader, get_test_loader
 from ..models import get_model, get_teacher_model
 from .. import obs
 from ..obs import StallWatchdog, StepCollector, emit_memory, span
-from ..parallel import (batch_sharding, init_multihost, main_rank,
-                        make_global_array, make_mesh, replicated)
+from ..parallel import (batch_sharding, data_sharding, init_multihost,
+                        main_rank, make_global_array, make_mesh, replicated)
 from ..utils import (TBWriter, get_colormap, get_logger, iou_from_cm,
                      log_config, mkdir, save_config, set_seed)
 from .checkpoint import (load_meta, restore_train_ckpt, restore_weights,
@@ -106,10 +106,17 @@ class SegTrainer:
                                       tv.get('batch_stats', {}))
             teacher_vars = {'params': tp, 'batch_stats': tbs}
 
+        # segpipe raw uint8 tail: get_loader resolved whether batches ship
+        # uint8 + flip flags (device_norm_resolved); the compiled steps
+        # then open with the on-device flip/normalize stage
+        norm_coeffs = (self.train_loader.norm_coeffs
+                       if config.device_norm_resolved else None)
         self.train_step = build_train_step(config, self.model, self.optimizer,
                                            self.mesh, teacher_model,
-                                           teacher_vars)
-        self.eval_step = build_eval_step(config, self.model, self.mesh)
+                                           teacher_vars,
+                                           norm_coeffs=norm_coeffs)
+        self.eval_step = build_eval_step(config, self.model, self.mesh,
+                                         norm_coeffs=norm_coeffs)
         if config.recompile_guard:
             # fail loudly on any post-warmup retrace of a compiled step
             # (static-shape promise; see analysis/recompile.py)
@@ -117,6 +124,7 @@ class SegTrainer:
             self.train_step = guard_step(self.train_step, 'train_step')
             self.eval_step = guard_step(self.eval_step, 'eval_step')
         self._batch_sharding = batch_sharding(self.mesh)
+        self._flag_sharding = data_sharding(self.mesh)
         self.load_ckpt()
 
     def _load_pretrained_backbone(self) -> None:
@@ -215,14 +223,37 @@ class SegTrainer:
                                 self.best_score)
 
     # ------------------------------------------------------------------- run
-    def _put(self, images: np.ndarray, masks: np.ndarray):
-        # process-local numpy -> global sharded array; correct under real
+    def _put(self, batch):
+        # process-local numpy -> global sharded arrays; correct under real
         # multi-process jax.distributed runs, identical to a sharded
-        # device_put when single-process (see parallel.make_global_array)
-        imgs = make_global_array(images, self._batch_sharding)
-        msks = make_global_array(masks.astype(np.int32),
-                                 self._batch_sharding)
+        # device_put when single-process (see parallel.make_global_array).
+        # Called from the DevicePrefetcher's background thread in the
+        # default pipeline (config.device_prefetch > 0), so the transfer
+        # overlaps device compute; the data/h2d span feeds the segscope
+        # report's h2d row either way. Raw-tail batches carry a third
+        # [B, 2] uint8 flip-flag plane, sharded on the batch axis only.
+        images, masks = batch[0], batch[1]
+        with span('data/h2d'):
+            imgs = make_global_array(images, self._batch_sharding)
+            # no-copy when the loader already yields int32 (it does; the
+            # old astype always copied)
+            msks = make_global_array(np.asarray(masks, np.int32),
+                                     self._batch_sharding)
+            if len(batch) > 2:
+                return imgs, msks, make_global_array(batch[2],
+                                                     self._flag_sharding)
         return imgs, msks
+
+    def _batches(self, loader):
+        """Device-resident batch stream: async prefetch (depth
+        config.device_prefetch) or the synchronous per-step transfer when
+        prefetch is disabled. Yields tuples ready to splat into the
+        compiled step."""
+        from ..data.segpipe import DevicePrefetcher
+        if self.config.device_prefetch > 0:
+            return DevicePrefetcher(loader, self._put,
+                                    depth=self.config.device_prefetch)
+        return map(self._put, loader)
 
     def run(self) -> float:
         cfg = self.config
@@ -305,39 +336,49 @@ class SegTrainer:
         step0 = int(self.state.step)
         tb_buf = []
         tb_every = cfg.log_interval if cfg.log_interval > 0 else 50
-        for i, (images, masks) in enumerate(col.wrap(self.train_loader)):
-            if profiling and i == 1:          # skip the compile step
-                jax.profiler.start_trace(cfg.profile_dir)
-            imgs, msks = self._put(images, masks)
-            with span('train/dispatch', record=False):
-                self.state, metrics = self.train_step(self.state, imgs,
-                                                      msks)
-            loss_sum = metrics['loss'] if loss_sum is None \
-                else loss_sum + metrics['loss']
-            n_steps += 1
-            col.end_step(step=step0 + n_steps)
-            if profiling and i == cfg.profile_steps:
-                jax.block_until_ready(self.state.params)
-                jax.profiler.stop_trace()
-                profiling = False
-                self.logger.info(f'Profiler trace in {cfg.profile_dir}')
-            if (cfg.log_interval > 0 and self.main_rank
-                    and (i + 1) % cfg.log_interval == 0):
-                # first log point of the epoch reads the current loss (one
-                # host sync per epoch); later points read the lagged one
-                li, ll = lag if lag is not None else (i, metrics['loss'])
-                ips, dwf = col.interval_stats()
-                self.logger.info(
-                    f'Epoch:{self.cur_epoch + 1}/{cfg.total_epoch} | '
-                    f'Iter:{li + 1}/{nb} | Loss:{float(ll):.4g} | '
-                    f'{ips:.1f} imgs/s | data-wait {100 * dwf:.0f}%')
-                lag = (i, metrics['loss'])
-            if self.main_rank and cfg.use_tb:
-                # buffer the device scalars; one batched host readback per
-                # log interval instead of a per-scalar pull every step
-                tb_buf.append((step0 + n_steps, metrics))
-                if len(tb_buf) >= tb_every:
-                    self._flush_tb(tb_buf)
+        batches = self._batches(self.train_loader)
+        try:
+            for i, batch in enumerate(col.wrap(batches)):
+                if profiling and i == 1:      # skip the compile step
+                    jax.profiler.start_trace(cfg.profile_dir)
+                with span('train/dispatch', record=False):
+                    self.state, metrics = self.train_step(self.state,
+                                                          *batch)
+                loss_sum = metrics['loss'] if loss_sum is None \
+                    else loss_sum + metrics['loss']
+                n_steps += 1
+                col.end_step(step=step0 + n_steps)
+                if profiling and i == cfg.profile_steps:
+                    jax.block_until_ready(self.state.params)
+                    jax.profiler.stop_trace()
+                    profiling = False
+                    self.logger.info(f'Profiler trace in {cfg.profile_dir}')
+                if (cfg.log_interval > 0 and self.main_rank
+                        and (i + 1) % cfg.log_interval == 0):
+                    # first log point of the epoch reads the current loss
+                    # (one host sync per epoch); later points read the
+                    # lagged one
+                    li, ll = lag if lag is not None else (i,
+                                                          metrics['loss'])
+                    ips, dwf = col.interval_stats()
+                    self.logger.info(
+                        f'Epoch:{self.cur_epoch + 1}/{cfg.total_epoch} | '
+                        f'Iter:{li + 1}/{nb} | Loss:{float(ll):.4g} | '
+                        f'{ips:.1f} imgs/s | data-wait {100 * dwf:.0f}%')
+                    lag = (i, metrics['loss'])
+                if self.main_rank and cfg.use_tb:
+                    # buffer the device scalars; one batched host readback
+                    # per log interval instead of a per-scalar pull every
+                    # step
+                    tb_buf.append((step0 + n_steps, metrics))
+                    if len(tb_buf) >= tb_every:
+                        self._flush_tb(tb_buf)
+        finally:
+            # tear the prefetch thread (and through it the loader's
+            # producer/worker pool) down even when a step raises
+            close = getattr(batches, 'close', None)
+            if close is not None:
+                close()
         if profiling:                         # epoch shorter than the window
             jax.profiler.stop_trace()
         if metrics is None:
@@ -385,36 +426,43 @@ class SegTrainer:
         cm_host = np.zeros((cfg.num_class, cfg.num_class), np.int64)
         cm_dev, dev_pixels = None, 0
         # eval_step psums the matrix over the whole mesh, so each cell is
-        # bounded by the GLOBAL pixel count, not this process's share
-        procs = jax.process_count()
+        # bounded by the GLOBAL pixel count — msks is the global sharded
+        # array here, so .size is exactly that count
         checked_bound = False
         col = StepCollector(self._obs_sink, 'val',
                             imgs_per_step=cfg.val_bs * cfg.gpu_num,
                             jitted=getattr(self.eval_step, 'jitted', None),
                             watchdog=self._watchdog, epoch=self.cur_epoch)
-        for images, masks in col.wrap(self.val_loader):
-            if not checked_bound:
-                # the cross-batch accumulator is flushed below before int32
-                # could overflow, but a single global batch beyond 2^31 px
-                # would overflow inside confusion_matrix's int32 psum itself
-                # (documented bound, utils/metrics.py) — fail loudly here
-                # instead of silently corrupting counts
-                if masks.size * procs >= np.iinfo(np.int32).max:
-                    raise ValueError(
-                        f'Global val batch has {masks.size * procs} pixels, '
-                        f'>= int32 max: shrink val batch or process count '
-                        f'(per-call bound of the on-device confusion matrix)')
-                checked_bound = True
-            if (cm_dev is not None and
-                    dev_pixels + masks.size * procs >= np.iinfo(np.int32).max):
-                cm_host += np.asarray(cm_dev, np.int64)
-                cm_dev, dev_pixels = None, 0
-            imgs, msks = self._put(images, masks)
-            with span('val/dispatch', record=False):
-                part = self.eval_step(self.state, imgs, msks)
-            cm_dev = part if cm_dev is None else cm_dev + part
-            dev_pixels += masks.size * procs
-            col.end_step()
+        batches = self._batches(self.val_loader)
+        try:
+            for imgs, msks in col.wrap(batches):
+                if not checked_bound:
+                    # the cross-batch accumulator is flushed below before
+                    # int32 could overflow, but a single global batch
+                    # beyond 2^31 px would overflow inside
+                    # confusion_matrix's int32 psum itself (documented
+                    # bound, utils/metrics.py) — fail loudly here instead
+                    # of silently corrupting counts
+                    if msks.size >= np.iinfo(np.int32).max:
+                        raise ValueError(
+                            f'Global val batch has {msks.size} pixels, '
+                            f'>= int32 max: shrink val batch or process '
+                            f'count (per-call bound of the on-device '
+                            f'confusion matrix)')
+                    checked_bound = True
+                if (cm_dev is not None and
+                        dev_pixels + msks.size >= np.iinfo(np.int32).max):
+                    cm_host += np.asarray(cm_dev, np.int64)
+                    cm_dev, dev_pixels = None, 0
+                with span('val/dispatch', record=False):
+                    part = self.eval_step(self.state, imgs, msks)
+                cm_dev = part if cm_dev is None else cm_dev + part
+                dev_pixels += msks.size
+                col.end_step()
+        finally:
+            close = getattr(batches, 'close', None)
+            if close is not None:
+                close()
         if cm_dev is None:
             raise RuntimeError('Validation loader yielded no batches.')
         with span('val/readback'):

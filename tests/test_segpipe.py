@@ -1,0 +1,511 @@
+"""segpipe: packed sample cache, multi-process augment workers, async
+uint8 device prefetch, and the on-device flip/normalize stage.
+
+The load-bearing contract everywhere: the packed pipeline is *exact*.
+For a fixed (seed, epoch), batches produced through any combination of
+{cache, mp workers, raw uint8 tail + on-device normalize} are
+byte-identical to the seed-era decode path (reference DataLoader
+semantics, datasets/__init__.py:21-65) — so the perf levers can default
+on without changing a single training trajectory.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+from PIL import Image
+
+from rtseg_tpu.config import SegConfig
+from rtseg_tpu.data import get_loader
+from rtseg_tpu.data.loader import ShardedLoader
+from rtseg_tpu.data.segpipe import (CacheUnsupported, DevicePrefetcher,
+                                    PackedCache, build_cache, cache_key,
+                                    open_or_build)
+from rtseg_tpu.data.transforms import TrainTransform, flip_norm_pack
+
+pytestmark = pytest.mark.filterwarnings(
+    'ignore:.*os.fork.*:RuntimeWarning')
+
+
+# --------------------------------------------------------------- fixtures
+
+def _write_png(path, arr):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    Image.fromarray(arr).save(path)
+
+
+@pytest.fixture()
+def custom_root(tmp_path):
+    root = tmp_path / 'custom'
+    rng = np.random.RandomState(7)
+    for mode, n in (('train', 10), ('val', 5)):
+        for i in range(n):
+            _write_png(str(root / mode / 'imgs' / f'{i}.png'),
+                       rng.randint(0, 255, (40, 50, 3), dtype=np.uint8))
+            _write_png(str(root / mode / 'masks' / f'{i}.png'),
+                       rng.randint(0, 3, (40, 50), dtype=np.uint8))
+    with open(root / 'data.yaml', 'w') as f:
+        f.write(f'path: {root}\nnames:\n  0: bg\n  1: a\n  2: b\n')
+    return str(root)
+
+
+def _cfg(custom_root, tmp_path, **kw):
+    base = dict(dataset='custom', data_root=custom_root, num_class=3,
+                train_size=32, test_size=32, crop_size=24, train_bs=1,
+                val_bs=1, h_flip=0.5, randscale=0.2,
+                save_dir=str(tmp_path / 'save'))
+    base.update(kw)
+    cfg = SegConfig(**base)
+    cfg.resolve(num_devices=1)
+    return cfg
+
+
+def _loaders(custom_root, tmp_path, **kw):
+    cfg = _cfg(custom_root, tmp_path, **kw)
+    return cfg, get_loader(cfg)
+
+
+def _materialize(loader, epochs=(0, 1)):
+    out = []
+    for ep in epochs:
+        loader.set_epoch(ep)
+        out.append(list(loader))
+    return out
+
+
+def _assert_batches_equal(a, b):
+    assert len(a) == len(b)
+    for ea, eb in zip(a, b):
+        assert len(ea) == len(eb)
+        for ba, bb in zip(ea, eb):
+            assert len(ba) == len(bb)
+            for xa, xb in zip(ba, bb):
+                assert xa.dtype == xb.dtype
+                np.testing.assert_array_equal(xa, xb)
+
+
+# ------------------------------------------------- transform split + tails
+
+def test_transform_prefix_suffix_composition():
+    """__call__ == suffix ∘ prefix, bitwise, with every random stage on."""
+    cfg = SegConfig(dataset='custom', num_class=3, crop_size=16,
+                    randscale=0.3, brightness=0.2, contrast=0.2,
+                    saturation=0.2, h_flip=0.5, v_flip=0.5,
+                    save_dir='/tmp/rtseg_segpipe_t')
+    cfg.resolve(num_devices=1)
+    t = TrainTransform(cfg, square_size=24)
+    rng = np.random.RandomState(3)
+    img = rng.randint(0, 255, (20, 30, 3), np.uint8).astype(np.uint8)
+    mask = rng.randint(0, 3, (20, 30)).astype(np.uint8)
+    a_img, a_mask = t(img, mask, np.random.default_rng(11))
+    pi, pm = t.prefix(img, mask)
+    b_img, b_mask = t.suffix(pi, pm, np.random.default_rng(11))
+    np.testing.assert_array_equal(a_img, b_img)
+    np.testing.assert_array_equal(a_mask, b_mask)
+
+
+def test_suffix_raw_matches_host_tail():
+    """suffix_raw consumes the same draws as suffix; applying the host
+    flip_norm_pack to its output reproduces suffix bit-for-bit."""
+    cfg = SegConfig(dataset='custom', num_class=3, crop_size=16,
+                    randscale=0.3, h_flip=0.5, v_flip=0.5,
+                    save_dir='/tmp/rtseg_segpipe_t')
+    cfg.resolve(num_devices=1)
+    t = TrainTransform(cfg)
+    assert t.supports_raw_tail
+    rng = np.random.RandomState(5)
+    img = rng.randint(0, 255, (24, 28, 3), np.uint8).astype(np.uint8)
+    mask = rng.randint(0, 3, (24, 28)).astype(np.uint8)
+    for seed in range(6):          # covers flip on/off combinations
+        want = t.suffix(img, mask, np.random.default_rng(seed))
+        ri, rm, (do_h, do_v) = t.suffix_raw(img, mask,
+                                            np.random.default_rng(seed))
+        assert ri.dtype == np.uint8
+        got = flip_norm_pack(ri, rm, do_h, do_v, t.identity_norm)
+        np.testing.assert_array_equal(want[0], got[0])
+        np.testing.assert_array_equal(want[1], got[1])
+
+
+def test_jitter_disables_raw_tail():
+    cfg = SegConfig(dataset='custom', num_class=3, crop_size=16,
+                    brightness=0.2, save_dir='/tmp/rtseg_segpipe_t')
+    cfg.resolve(num_devices=1)
+    assert not TrainTransform(cfg).supports_raw_tail
+
+
+# -------------------------------------------------------------- the cache
+
+def test_cache_golden_identity_vs_decode_path(custom_root, tmp_path):
+    """Golden-aug satellite: segpack-path batches are byte-identical to
+    decode-path batches for fixed (seed, epoch), train and val."""
+    cfg0, (tl0, vl0) = _loaders(custom_root, tmp_path, device_norm=False)
+    cfg1, (tl1, vl1) = _loaders(custom_root, tmp_path, device_norm=False,
+                                segpipe_cache=True)
+    assert tl1.source.cache is not None and vl1.source.cache is not None
+    _assert_batches_equal(_materialize(tl0), _materialize(tl1))
+    _assert_batches_equal([list(vl0)], [list(vl1)])
+
+
+def test_cache_hits_counted(custom_root, tmp_path):
+    cfg, (tl, _) = _loaders(custom_root, tmp_path, segpipe_cache=True)
+    list(tl)
+    h, m = tl.last_cache_counts      # (hits, misses) of the last epoch
+    assert h > 0 and m == 0
+
+
+def test_cache_invalidation_on_transform_and_data_change(custom_root,
+                                                         tmp_path):
+    from rtseg_tpu.data import Custom
+    cfg_a = _cfg(custom_root, tmp_path)
+    cfg_b = _cfg(custom_root, tmp_path, train_size=28)   # prefix change
+    ka = cache_key(Custom(cfg_a, 'train'))
+    kb = cache_key(Custom(cfg_b, 'train'))
+    assert ka != kb
+    # data change (mtime/size of one source file) also re-keys
+    img0 = os.path.join(custom_root, 'train', 'imgs', '0.png')
+    arr = np.asarray(Image.open(img0))
+    time.sleep(0.01)
+    _write_png(img0, np.ascontiguousarray(arr[:, ::-1]))
+    os.utime(img0, (time.time() + 5, time.time() + 5))
+    kc = cache_key(Custom(cfg_a, 'train'))
+    assert kc != ka
+    # distinct keys build distinct dirs; both open cleanly side by side
+    ca = open_or_build(Custom(cfg_a, 'train'), cfg_a.cache_dir)
+    cb = open_or_build(Custom(cfg_b, 'train'), cfg_b.cache_dir)
+    assert ca.path != cb.path
+    assert ca.img_shape == (32, 32, 3) and cb.img_shape == (28, 28, 3)
+
+
+def test_cache_rejects_ragged_shapes(tmp_path):
+    class Ragged:
+        def __len__(self):
+            return 3
+
+        def prepare(self, i):
+            return (np.zeros((4 + i, 4, 3), np.uint8),
+                    np.zeros((4 + i, 4), np.uint8))
+
+        def cache_spec(self):
+            return {'dataset': 'ragged'}
+
+    with pytest.raises(CacheUnsupported, match='fixed-shape'):
+        build_cache(Ragged(), str(tmp_path / 'ragged-cache'))
+    assert not os.path.exists(str(tmp_path / 'ragged-cache'))
+
+
+def test_cache_roundtrip_and_pickle(custom_root, tmp_path):
+    from rtseg_tpu.data import Custom
+    import pickle
+    cfg = _cfg(custom_root, tmp_path)
+    ds = Custom(cfg, 'train')
+    cache = open_or_build(ds, cfg.cache_dir)
+    assert len(cache) == len(ds)
+    for i in (0, len(ds) - 1):
+        ci, cm = cache.read(i)
+        di, dm = ds.prepare(i)
+        np.testing.assert_array_equal(ci, di)
+        np.testing.assert_array_equal(cm, dm)
+    # picklable with mmaps dropped (spawn-mode workers)
+    c2 = pickle.loads(pickle.dumps(cache))
+    np.testing.assert_array_equal(c2.read(1)[0], cache.read(1)[0])
+    # reopen resolves to the same directory (no rebuild)
+    c3 = open_or_build(ds, cfg.cache_dir)
+    assert c3.path == cache.path
+
+
+# ------------------------------------------------ multi-process augmenters
+
+def test_mp_workers_byte_identity(custom_root, tmp_path):
+    """Worker scheduling cannot change batch content: forked shm-ring
+    production == serial production, cache on, raw tail on, 2 epochs."""
+    _, (tl_serial, _) = _loaders(custom_root, tmp_path, segpipe_cache=True)
+    _, (tl_mp, _) = _loaders(custom_root, tmp_path, segpipe_cache=True,
+                             aug_workers=2)
+    assert tl_serial.raw_tail and tl_mp.raw_tail     # auto device_norm
+    _assert_batches_equal(_materialize(tl_serial), _materialize(tl_mp))
+    # exact fetch accounting across the fork: per epoch, 1 probe + one
+    # fetch per sample, all cache hits, probe counted exactly once
+    h, m = tl_mp.last_cache_counts
+    assert (h, m) == (len(tl_mp.dataset) + 1, 0)
+
+
+class _Boom:
+    """Legacy-protocol dataset whose fetch explodes on index 3."""
+
+    def __init__(self, n=8, kill=False):
+        self.n = n
+        self.kill = kill
+
+    def __len__(self):
+        return self.n
+
+    def get(self, i, rng):
+        if i == 3:
+            if self.kill:
+                os._exit(3)          # simulated segfault/OOM-kill
+            raise ValueError('boom at 3')
+        return np.full((4, 4, 3), i, np.float32), np.full((4, 4), i,
+                                                          np.int32)
+
+
+def test_mp_worker_exception_propagates():
+    loader = ShardedLoader(_Boom(), global_batch=4, shuffle=False,
+                           mp_workers=2)
+    with pytest.raises(ValueError, match='boom at 3'):
+        list(loader)
+
+
+def test_mp_worker_hard_death_raises():
+    loader = ShardedLoader(_Boom(kill=True), global_batch=4, shuffle=False,
+                           mp_workers=2)
+    with pytest.raises(RuntimeError, match='died'):
+        list(loader)
+
+
+# ------------------------------------- on-device flip/normalize bit-parity
+
+def test_device_flip_norm_bit_parity():
+    """uint8 transfer + on-device normalize == host float32 path, every
+    bit, through jit, all four flip combinations."""
+    import jax
+    from rtseg_tpu.data.transforms import _norm_coeffs
+    from rtseg_tpu.ops import device_flip_norm, device_normalize
+
+    rng = np.random.RandomState(0)
+    imgs = rng.randint(0, 255, (4, 10, 12, 3), np.uint8).astype(np.uint8)
+    masks = rng.randint(0, 19, (4, 10, 12)).astype(np.int32)
+    flags = np.array([[0, 0], [1, 0], [0, 1], [1, 1]], np.uint8)
+    for identity in (False, True):
+        scale, bias = _norm_coeffs(identity)
+        fn = jax.jit(lambda i, m, f: device_flip_norm(i, m, f, scale,
+                                                      bias))
+        x, m = fn(imgs, masks, flags)
+        x, m = np.asarray(x), np.asarray(m)
+        for j in range(4):
+            want_i, want_m = flip_norm_pack(
+                imgs[j], masks[j], bool(flags[j, 0]), bool(flags[j, 1]),
+                identity)
+            np.testing.assert_array_equal(x[j], want_i)
+            np.testing.assert_array_equal(m[j], want_m)
+        xn = np.asarray(jax.jit(
+            lambda i: device_normalize(i, scale, bias))(imgs))
+        for j in range(4):
+            want_i, _ = flip_norm_pack(imgs[j], None, False, False,
+                                       identity)
+            np.testing.assert_array_equal(xn[j], want_i)
+
+
+def test_train_step_raw_tail_parity(custom_root, tmp_path):
+    """One compiled fastscnn step, host-normalized f32 batch vs uint8 +
+    flags batch with the on-device stage: identical loss and weights."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+    from rtseg_tpu.models import get_model
+    from rtseg_tpu.parallel.mesh import DATA_AXIS
+    from rtseg_tpu.train.optim import get_optimizer
+    from rtseg_tpu.train.state import create_train_state
+    from rtseg_tpu.train.step import build_train_step
+    from rtseg_tpu.ops import device_flip_norm
+
+    cfg = _cfg(custom_root, tmp_path, model='fastscnn',
+               compute_dtype='float32', train_bs=2, crop_size=32)
+    cfg.resolve_schedule(train_num=8)
+    model = get_model(cfg)
+    opt = get_optimizer(cfg)
+    mesh = Mesh(np.array(jax.devices()[:1]), (DATA_AXIS,))
+    state0 = create_train_state(model, opt, jax.random.PRNGKey(0),
+                                jnp.zeros((1, 32, 32, 3), jnp.float32))
+    rng = np.random.RandomState(1)
+    imgs_u8 = rng.randint(0, 255, (2, 32, 32, 3), np.uint8).astype(np.uint8)
+    masks = rng.randint(0, 3, (2, 32, 32)).astype(np.int32)
+    flags = np.array([[1, 0], [0, 0]], np.uint8)
+    from rtseg_tpu.data.transforms import _norm_coeffs
+    coeffs = _norm_coeffs(True)
+
+    # host path input = what the classic loader would ship
+    host_imgs, host_masks = device_flip_norm(imgs_u8, masks, flags,
+                                             *coeffs)
+    step_host = build_train_step(cfg, model, opt, mesh)
+    s_a, m_a = step_host(state0, np.asarray(host_imgs),
+                         np.asarray(host_masks))
+
+    step_raw = build_train_step(cfg, model, opt, mesh, norm_coeffs=coeffs)
+    state0b = create_train_state(model, opt, jax.random.PRNGKey(0),
+                                 jnp.zeros((1, 32, 32, 3), jnp.float32))
+    s_b, m_b = step_raw(state0b, imgs_u8, masks, flags)
+
+    assert float(m_a['loss']) == float(m_b['loss'])
+    flat_a = jax.tree.leaves(s_a.params)
+    flat_b = jax.tree.leaves(s_b.params)
+    for la, lb in zip(flat_a, flat_b):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+# ------------------------------------------------------- device prefetcher
+
+def test_prefetcher_order_and_stop():
+    src = list(range(20))
+    pf = DevicePrefetcher(iter(src), lambda x: x * 2, depth=2)
+    assert list(pf) == [x * 2 for x in src]
+    pf.close()                      # idempotent after exhaustion
+
+    # early abandon: close() must not hang and must stop the producer
+    pf2 = DevicePrefetcher(iter(src), lambda x: x, depth=2)
+    assert next(pf2) == 0
+    pf2.close()
+    assert not pf2._thread.is_alive()
+
+
+def test_prefetcher_propagates_errors():
+    def put(x):
+        if x == 3:
+            raise RuntimeError('h2d exploded')
+        return x
+
+    pf = DevicePrefetcher(iter(range(10)), put, depth=2)
+    with pytest.raises(RuntimeError, match='h2d exploded'):
+        list(pf)
+    pf.close()
+
+
+def test_prefetcher_closes_source_generator():
+    closed = []
+
+    def gen():
+        try:
+            for i in range(100):
+                yield i
+        finally:
+            closed.append(True)
+
+    pf = DevicePrefetcher(gen(), lambda x: x, depth=1)
+    assert next(pf) == 0
+    pf.close()
+    time.sleep(0.05)
+    assert closed == [True]
+
+
+# ------------------------------------------------ dummy-batch satellite fix
+
+class _CountingDataset:
+    def __init__(self, n=6):
+        self.n = n
+        self.zero_fetches = 0
+
+    def __len__(self):
+        return self.n
+
+    def get(self, i, rng):
+        if i == 0:
+            self.zero_fetches += 1
+        return np.full((4, 4, 3), i, np.float32), np.full((4, 4), i,
+                                                          np.int32)
+
+
+def test_empty_slice_dummy_batch_cached_across_ragged_steps():
+    """Val loaders never set_epoch, so the all-ignored dummy batch for
+    empty multi-host slices is built once — not re-decoded per ragged
+    step/epoch (the seed-era behavior)."""
+    ds = _CountingDataset(6)
+    loader = ShardedLoader(ds, global_batch=4, shuffle=False,
+                           drop_last=False, process_index=1,
+                           process_count=2, ignore_index=255, tag='val')
+    epochs = [list(loader), list(loader)]     # two val passes, epoch pinned
+    for batches in epochs:
+        assert len(batches) == 2
+        imgs, masks = batches[1]              # the empty-slice step
+        assert (masks == 255).all()
+        assert imgs.shape[0] == loader.local_batch
+    assert ds.zero_fetches == 1               # was: one decode per pass
+
+
+# ---------------------------------------------------- report + bench + e2e
+
+def test_report_h2d_and_cache_lines(tmp_path):
+    from rtseg_tpu.obs import EventSink
+    from rtseg_tpu.obs.report import (diff_table, format_summary,
+                                      load_events, summarize)
+    p = str(tmp_path / 'obs' / 'events-000.jsonl')
+    sink = EventSink(p, static={'host': 0})
+    sink.emit({'event': 'run_start', 'model': 'm'})
+    for i in range(4):
+        sink.emit({'event': 'step', 'kind': 'train', 'dur_s': 0.1,
+                   'data_wait_s': 0.01 if i else 0.0, 'imgs': 8,
+                   **({'compile': True} if i == 0 else {})})
+        sink.emit({'event': 'span', 'name': 'data/h2d', 'dur_s': 0.004,
+                   'depth': 0})
+    sink.emit({'event': 'cache', 'tag': 'train', 'epoch': 0, 'hits': 30,
+               'misses': 2, 'cached': True})
+    # decode-fetch telemetry from an UNcached loader must not create or
+    # skew a hit rate (a run with no cache has no cache-hit line)
+    sink.emit({'event': 'cache', 'tag': 'val', 'epoch': 0, 'hits': 0,
+               'misses': 40, 'cached': False})
+    sink.emit({'event': 'run_end', 'wall_s': 1.0})
+    sink.close()
+    s = summarize(load_events(os.path.dirname(p)))
+    assert s['h2d_transfers'] == 4
+    assert abs(s['h2d_s'] - 0.016) < 1e-9
+    assert s['cache_hits'] == 30 and s['cache_misses'] == 2
+    assert abs(s['cache_hit_rate'] - 30 / 32) < 1e-9
+    text = format_summary(s)
+    assert 'h2d' in text and 'cache-hit rate' in text
+
+    # diff: >5% worse data-wait flags REGRESSED on the data-wait row
+    worse = dict(s)
+    worse['data_wait_frac'] = s['data_wait_frac'] * 1.5
+    table = diff_table(s, worse)
+    row = next(ln for ln in table.splitlines() if 'data-wait' in ln)
+    assert 'REGRESSED' in row
+    ok = diff_table(s, dict(s))
+    row = next(ln for ln in ok.splitlines() if 'data-wait' in ln)
+    assert 'REGRESSED' not in row
+
+
+def test_benchmark_all_data_mode(tmp_path, monkeypatch, capsys):
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), 'tools'))
+    import benchmark_all
+    obs_dir = str(tmp_path / 'obs')
+    monkeypatch.setattr(sys, 'argv', [
+        'benchmark_all.py', '--data', '--data-samples', '6',
+        '--imgh', '48', '--imgw', '64', '--batch', '2',
+        '--data-epochs', '1', '--obs-dir', obs_dir])
+    assert benchmark_all.main() == 0
+    out = capsys.readouterr().out
+    assert 'segpipe cache' in out and 'speedup' in out
+    from rtseg_tpu import obs
+    snk = obs.get_sink()            # bench installed a global sink
+    obs.set_sink(None)
+    if snk is not None:
+        snk.close()
+    from rtseg_tpu.obs.report import load_events
+    events = load_events(obs_dir)
+    data_rows = [e for e in events if e.get('event') == 'bench_result'
+                 and e.get('mode') == 'data']
+    assert {e['path'] for e in data_rows} == {'decode', 'cached'}
+    assert all(e['imgs_per_sec'] > 0 for e in data_rows)
+
+
+def test_trainer_segpipe_e2e(custom_root, tmp_path):
+    """SegTrainer with the whole pipeline on (cache + mp workers + uint8
+    prefetch + on-device normalize): runs, hits the cache 100%, emits h2d
+    spans, and the raw-tail step signature round-trips through train+val."""
+    from rtseg_tpu.train import SegTrainer
+    from rtseg_tpu.obs.report import load_events, summarize
+    cfg = _cfg(custom_root, tmp_path, model='fastscnn', train_bs=1,
+               val_bs=1, total_epoch=1, val_interval=1,
+               compute_dtype='float32', use_tb=False, use_ema=True,
+               base_workers=0, log_interval=0, load_ckpt=False,
+               save_ckpt=False, segpipe_cache=True, aug_workers=2,
+               device_prefetch=2)
+    trainer = SegTrainer(cfg)
+    assert cfg.device_norm_resolved
+    score = trainer.run()
+    assert 0.0 <= score <= 1.0
+    s = summarize(load_events(cfg.obs_dir))
+    assert s['train_steps'] > 0 and s['stalls'] == 0
+    assert s['h2d_transfers'] > 0
+    assert s['cache_hit_rate'] == 1.0

@@ -223,6 +223,97 @@ def bench_train(name, batch, h, w, queue, trials):
     return ips, flops / batch
 
 
+def _make_png_dataset(root, n, h, w, seed=0):
+    """Synthesize a Custom-layout PNG dataset (real decode cost) for the
+    offline loader benchmark."""
+    import os
+    from PIL import Image
+    rng = np.random.RandomState(seed)
+    for mode, k in (('train', n), ('val', max(2, n // 8))):
+        os.makedirs(os.path.join(root, mode, 'imgs'), exist_ok=True)
+        os.makedirs(os.path.join(root, mode, 'masks'), exist_ok=True)
+        for i in range(k):
+            Image.fromarray(rng.randint(0, 255, (h, w, 3), dtype=np.uint8)
+                            ).save(os.path.join(root, mode, 'imgs',
+                                                f'{i:04d}.png'))
+            Image.fromarray(rng.randint(0, 5, (h, w), dtype=np.uint8)
+                            ).save(os.path.join(root, mode, 'masks',
+                                                f'{i:04d}.png'))
+    with open(os.path.join(root, 'data.yaml'), 'w') as f:
+        f.write(f'path: {root}\nnames:\n'
+                + ''.join(f'  {i}: c{i}\n' for i in range(5)))
+
+
+def bench_data(args, sink) -> int:
+    """Offline loader throughput: imgs/sec through the full batch-
+    production path (fetch + augment + stack), decode path vs segpipe
+    packed cache, no device work. The numbers BENCHMARKS.md "Loader
+    throughput methodology" and segpipe_cpu.log commit come from here."""
+    import tempfile
+    import time
+    from rtseg_tpu.config import SegConfig
+    from rtseg_tpu.data import get_dataset
+    from rtseg_tpu.data.loader import ShardedLoader
+    from rtseg_tpu.data.segpipe import open_or_build
+
+    work = args.data_root or tempfile.mkdtemp(prefix='segpipe_bench_')
+    if args.data_root is None:
+        print(f'# generating {args.data_samples} {args.imgw}x{args.imgh} '
+              f'PNGs under {work}', flush=True)
+        _make_png_dataset(work, args.data_samples, args.imgh, args.imgw)
+    cfg = SegConfig(dataset=args.data_dataset, data_root=work, num_class=5,
+                    crop_size=min(args.imgh, args.imgw) // 2,
+                    train_size=args.imgh if args.data_dataset == 'custom'
+                    else None,
+                    h_flip=0.5, randscale=0.1,
+                    save_dir=tempfile.mkdtemp(prefix='segpipe_bench_save_'))
+    cfg.resolve(num_devices=1)
+    train_ds, _ = get_dataset(cfg)
+
+    def run(cache, tag):
+        loader = ShardedLoader(
+            train_ds, global_batch=min(args.batch, len(train_ds)), seed=0,
+            shuffle=True, drop_last=True, cache=cache,
+            mp_workers=args.aug_workers, tag=tag,
+            workers=0 if args.aug_workers else 4)
+        imgs = 0
+        t0 = time.perf_counter()
+        for ep in range(args.data_epochs):
+            loader.set_epoch(ep)
+            for batch in loader:
+                imgs += len(batch[0])
+        dur = time.perf_counter() - t0
+        return imgs / dur, imgs
+
+    decode_ips, n_imgs = run(None, 'decode')
+    t0 = time.perf_counter()
+    cache = open_or_build(train_ds, cfg.cache_dir)
+    build_s = time.perf_counter() - t0
+    cached_ips, _ = run(cache, 'cached')
+    for tag, ips in (('decode', decode_ips), ('cached', cached_ips)):
+        print(json.dumps({
+            'metric': f'loader {tag} imgs/sec '
+                      f'({args.imgw}x{args.imgh} PNG, bs{args.batch}, '
+                      f'{args.aug_workers} aug workers)',
+            'value': round(ips, 1), 'unit': 'imgs/sec'}), flush=True)
+        if sink is not None:
+            sink.emit({'event': 'bench_result', 'mode': 'data',
+                       'path': tag, 'imgs_per_sec': round(ips, 2),
+                       'imgs': n_imgs, 'batch': args.batch,
+                       'imgh': args.imgh, 'imgw': args.imgw,
+                       'aug_workers': args.aug_workers,
+                       'cache_build_s': round(build_s, 3)})
+    print(f'\n| path | loader imgs/sec (offline, bs{args.batch}, '
+          f'{args.data_epochs} epochs) |')
+    print('|---|---|')
+    print(f'| decode | {decode_ips:.1f} |')
+    print(f'| segpipe cache | {cached_ips:.1f} |')
+    print(f'\ncache build: {build_s:.2f}s one-time '
+          f'({build_s * decode_ips / max(n_imgs // args.data_epochs, 1):.2f} '
+          f'decode-epochs equivalent) | speedup {cached_ips / decode_ips:.2f}x')
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument('--models', type=str, default=DEFAULT_MODELS)
@@ -238,6 +329,22 @@ def main() -> int:
     mode.add_argument('--eval', action='store_true',
                       help='benchmark the validation step (EMA forward + '
                            'on-device confusion matrix)')
+    mode.add_argument('--data', action='store_true',
+                      help='offline input-pipeline throughput (imgs/sec '
+                           'through batch production, no device): decode '
+                           'path vs segpipe packed cache')
+    ap.add_argument('--data-root', default=None,
+                    help='--data: existing dataset root (default: '
+                         'synthesize a PNG dataset in a temp dir)')
+    ap.add_argument('--data-dataset', default='custom',
+                    help='--data: dataset type for --data-root')
+    ap.add_argument('--data-samples', type=int, default=48,
+                    help='--data: synthesized PNG count')
+    ap.add_argument('--data-epochs', type=int, default=3,
+                    help='--data: epochs per timed pass')
+    ap.add_argument('--aug-workers', type=int, default=0,
+                    help='--data: segpipe multi-process augment workers '
+                         '(0 = thread pool)')
     ap.add_argument('--s2d', action='store_true',
                     help='enable s2d_stem input packing (config.s2d_stem)')
     ap.add_argument('--detail-remat', action='store_true',
@@ -290,6 +397,9 @@ def main() -> int:
                                   'batch': args.batch,
                                   'imgh': args.imgh, 'imgw': args.imgw})
         obs.set_sink(sink)
+
+    if args.data:
+        return bench_data(args, sink)
 
     BENCH_S2D['on'] = args.s2d
     BENCH_S2D['segnet_pack'] = args.segnet_pack

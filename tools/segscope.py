@@ -7,8 +7,11 @@ compares two runs as a regression table. Serving runs (tools/segserve.py
 bench --obs-dir) get a serving section — RPS, request p50/p95/p99, stage
 means, drop/reject counts, batch occupancy — from their request/batch
 events, and `diff` flags serve-p99/RPS regressions alongside the training
-rows. Pure stdlib+numpy: works on machines without jax (e.g. a laptop
-holding synced run dirs).
+rows. segpipe runs add an h2d stage row (host->device transfer seconds;
+"overlapped" when data-wait is ~0) and a packed-cache hit-rate line from
+the loaders' per-epoch cache events; `diff` marks data-wait/h2d
+regressions >5% as REGRESSED. Pure stdlib+numpy: works on machines
+without jax (e.g. a laptop holding synced run dirs).
 
 Usage:
     python tools/segscope.py report save/segscope
