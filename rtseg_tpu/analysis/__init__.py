@@ -27,9 +27,11 @@ from .lint_registry import check_registry_consistency
 from .lint_trace import check_trace_purity
 from .lint_evidence import check_evidence_citations
 from .lint_obs import check_obs_purity
+from .lint_warm import check_warm_key_coverage
 # audit modules defer their jax imports to call time, so importing the
 # package stays jax-free
-from .recompile import RecompileError, RecompileGuard, guard_step
+from .recompile import (PIN_ATTRS, RecompileError, RecompileGuard,
+                        guard_step, introspectable)
 from .shape_audit import AuditResult, audit_model, audit_zoo, zoo_variants
 from .step_harness import (StepArtifacts, build_step_artifacts, iter_eqns,
                            needed_invars)
@@ -47,7 +49,9 @@ __all__ = [
     'suppressed_at',
     'check_import_hygiene', 'check_registry_consistency',
     'check_trace_purity', 'check_evidence_citations', 'check_obs_purity',
-    'RecompileError', 'RecompileGuard', 'guard_step',
+    'check_warm_key_coverage',
+    'PIN_ATTRS', 'RecompileError', 'RecompileGuard', 'guard_step',
+    'introspectable',
     'AuditResult', 'audit_model', 'audit_zoo', 'zoo_variants',
     'StepArtifacts', 'build_step_artifacts', 'iter_eqns', 'needed_invars',
     'audit_donation', 'check_donation_acceptance', 'check_donation_intent',

@@ -32,8 +32,12 @@ from .core import Finding, RULE_TRACE, SourceFile, iter_python_files
 #: machinery (producer threads, shm ring, h2d spans, host RNG) lives one
 #: import away from the on-device augment stage (ops/augment) that the
 #: compiled steps now open with.
+#: warm/ is covered so the executable-cache plumbing (hashing, pickling,
+#: wall clocks, event emission) can never leak into a jit-reachable path —
+#: warm_step's wrapper sits one call away from the compiled executables.
 TARGET_PREFIXES = ('rtseg_tpu/train/step.py', 'rtseg_tpu/ops/',
-                   'rtseg_tpu/serve/', 'rtseg_tpu/data/')
+                   'rtseg_tpu/serve/', 'rtseg_tpu/data/',
+                   'rtseg_tpu/warm/')
 
 #: call names (last dotted segment) that receive functions destined for
 #: tracing — a function passed by name into one of these is a jit root

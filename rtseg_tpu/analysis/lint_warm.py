@@ -1,0 +1,74 @@
+"""Rule `warm-key`: the segwarm executable-cache key must cover every
+trace-global pin the RecompileGuard tracks.
+
+The ExeCache (warm/exe_cache.py) hashes PIN_KEYS — the trace-global pin
+values a built step bakes into its trace — into every cache key. The
+RecompileGuard's mirrored-pin contract (analysis/recompile.py PIN_ATTRS)
+is the authoritative list of those globals. If someone adds a pin there
+(a new trace-time switch like s2d_stem was) without also hashing it into
+the cache key, two lowerings that differ only in that pin could alias one
+cache entry — a *stale hit*, the one failure mode segwarm promises never
+to produce. A stale executable is far worse than a slow start: it
+silently runs the wrong program.
+
+This rule is pure metadata comparison — it imports the two tuples (both
+modules are jax-free at import time, keeping the lint tier jax-free) and
+fails on any PIN_ATTRS entry missing from PIN_KEYS. The finding lands on
+the PIN_KEYS definition line so the fix location is the message.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from .core import Finding, SourceFile
+
+RULE_WARM = 'warm-key'
+
+_EXE_CACHE_PATH = 'rtseg_tpu/warm/exe_cache.py'
+
+
+def _pin_keys_line(files, root: str) -> int:
+    """Line of the PIN_KEYS assignment in exe_cache.py (1 if the scan
+    can't find it — the finding must still surface)."""
+    sf: Optional[SourceFile] = None
+    for f in (files or ()):
+        if f.relpath.replace('\\', '/') == _EXE_CACHE_PATH:
+            sf = f
+            break
+    if sf is None:
+        try:
+            sf = SourceFile.load(root, _EXE_CACHE_PATH)
+        except (OSError, SyntaxError):
+            return 1
+    for lineno, line in enumerate(sf.text.splitlines(), start=1):
+        if line.startswith('PIN_KEYS'):
+            return lineno
+    return 1
+
+
+def check_warm_key_coverage(root: str, files=None,
+                            pin_attrs: Optional[Sequence[str]] = None,
+                            pin_keys: Optional[Sequence[str]] = None
+                            ) -> List[Finding]:
+    """One finding per RecompileGuard pin the cache key omits.
+
+    ``pin_attrs``/``pin_keys`` default to the live tuples; tests inject
+    seeded values to pin the failure mode."""
+    if pin_attrs is None:
+        from .recompile import PIN_ATTRS
+        pin_attrs = PIN_ATTRS
+    if pin_keys is None:
+        from ..warm.exe_cache import PIN_KEYS
+        pin_keys = PIN_KEYS
+    missing = [a for a in pin_attrs if a not in pin_keys]
+    if not missing:
+        return []
+    line = _pin_keys_line(files, root)
+    return [Finding(
+        rule=RULE_WARM, path=_EXE_CACHE_PATH, line=line,
+        message=(f'executable-cache key omits trace-global pin(s) '
+                 f'{missing} tracked by analysis/recompile.py PIN_ATTRS — '
+                 f'add them to PIN_KEYS (and hash their values in '
+                 f'cache_key) or cached executables can stale-hit across '
+                 f'pin flips'))]

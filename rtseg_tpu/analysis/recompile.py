@@ -68,16 +68,35 @@ class RecompileGuard:
                 f'flipping between calls.')
 
 
-#: step-wrapper attributes to mirror (train/step.py _pin_bn_axis contract)
-_MIRRORED_ATTRS = ('jitted', 'pin', 'bn_axis', 's2d_stem', 'defer_upsample')
+#: the trace-global pins a built step bakes into its trace (train/step.py
+#: _pin_bn_axis contract). This tuple is a *compatibility surface*: the
+#: segwarm executable-cache key must cover every entry (warm/exe_cache.py
+#: PIN_KEYS), enforced by the `warm-key` lint (analysis/lint_warm.py) —
+#: add a pin here and the build fails until the cache key hashes it too.
+PIN_ATTRS = ('bn_axis', 's2d_stem', 'defer_upsample')
+
+#: step-wrapper attributes to mirror across wrapper layers (guard_step,
+#: warm/prime.py). `_cache_size` lets the guard and the segscope collector
+#: introspect compile activity through any wrapper uniformly.
+_MIRRORED_ATTRS = ('jitted', 'pin', '_cache_size') + PIN_ATTRS
+
+
+def introspectable(step_fn: Any) -> Any:
+    """The object whose ``_cache_size`` tracks this step's compiles: the
+    wrapper itself when it exposes one (warm/prime.py counts executable
+    builds), else the underlying jit object."""
+    if hasattr(step_fn, '_cache_size'):
+        return step_fn
+    return getattr(step_fn, 'jitted', step_fn)
 
 
 def guard_step(step_fn: Callable, name: str, warmup: int = 1) -> Callable:
     """Wrap a built step so every call is followed by a cache-growth check.
 
-    Accepts either a bare jitted callable or the _pin_bn_axis wrapper
-    (whose `.jitted` is the actual jit object holding the cache)."""
-    jitted = getattr(step_fn, 'jitted', step_fn)
+    Accepts a bare jitted callable, the _pin_bn_axis wrapper (whose
+    `.jitted` is the actual jit object holding the cache), or a warm_step
+    wrapper (whose own `_cache_size` counts executable builds)."""
+    jitted = introspectable(step_fn)
     guard = RecompileGuard(name, warmup=warmup)
 
     def wrapper(*args, **kwargs):

@@ -152,6 +152,31 @@ class SegConfig:
     # value lands in device_norm_resolved at get_loader() time.
     device_norm: Optional[bool] = None
 
+    # ----- Warm starts (segwarm, rtseg_tpu/warm/) -----
+    # persistent compile cache + serialized AOT executables: the first run
+    # pays the XLA compile bill and stores both jax's persistent
+    # compilation cache (every jit path) and serialized whole executables
+    # (ExeCache: serve buckets, train/eval steps); the second run
+    # deserializes and performs zero fresh XLA compiles on those paths
+    # (pinned by tests/test_segwarm.py; cold-vs-warm numbers in
+    # segwarm_cpu.log). Any cache incompatibility degrades to a fresh
+    # compile with a warning — never a crash or a stale hit.
+    compile_cache: bool = False
+    compile_cache_dir: Optional[str] = None    # resolved to
+    #                                            save_dir/segwarm; point at
+    #                                            a stable dir to share the
+    #                                            warmth across runs/replicas
+    # store gates, mirrored into jax_persistent_cache_min_entry_size_bytes
+    # / _min_compile_time_secs. Default 0 = cache everything: segwarm's
+    # targets (CI jobs, short runs, serving replicas) are exactly the
+    # workloads whose compiles fall under jax's default 1 s minimum
+    compile_cache_min_entry_bytes: int = 0
+    compile_cache_min_compile_secs: float = 0.0
+    # ServeEngine bucket-table compilation threads (XLA compile releases
+    # the GIL, so cold multi-bucket init scales with cores). 0 = auto:
+    # min(len(buckets), os.cpu_count()); 1 = sequential
+    compile_workers: int = 0
+
     # ----- Training setting (base_config.py:64-71) -----
     # torch AMP's role is played by compute_dtype on TPU (bf16 compute, fp32
     # params, no GradScaler). For reference-config migration the flag is
@@ -293,6 +318,8 @@ class SegConfig:
             self.obs_dir = f'{self.save_dir}/segscope'
         if self.cache_dir is None:
             self.cache_dir = f'{self.save_dir}/segpack'
+        if self.compile_cache_dir is None:
+            self.compile_cache_dir = f'{self.save_dir}/segwarm'
         if self.crop_h is None:
             self.crop_h = self.crop_size
         if self.crop_w is None:

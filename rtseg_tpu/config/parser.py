@@ -144,6 +144,10 @@ def get_parser() -> argparse.ArgumentParser:
     p.add_argument('--kd_loss_type', type=str, choices=['kl_div', 'mse'])
     p.add_argument('--kd_loss_coefficient', type=float)
     p.add_argument('--kd_temperature', type=float)
+    # Warm starts (segwarm)
+    p.add_argument('--compile_cache', type=_bool)
+    p.add_argument('--compile_cache_dir', type=str)
+    p.add_argument('--compile_workers', type=int)
     # Numerics
     p.add_argument('--compute_dtype', type=str, choices=['bfloat16', 'float32'])
     return p

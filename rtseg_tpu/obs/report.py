@@ -90,6 +90,15 @@ def summarize(events: List[dict]) -> Dict[str, Any]:
     compile_s = float(sum(e['dur_s'] for e in tsteps + vsteps
                           if e.get('compile')))
     stalls = [e for e in events if e.get('event') == 'stall']
+    # segwarm: one `compile` event per executable build (trainer steps,
+    # serve buckets, bench compiles), flagged cache_hit when the segwarm
+    # cache served it — the cold-vs-warm startup story. Host-0 only, like
+    # the other timing stats.
+    builds = [e for e in events if e.get('event') == 'compile' and mine(e)]
+    startup_cold_s = float(sum(e.get('dur_s', 0.0) for e in builds
+                               if not e.get('cache_hit')))
+    startup_warm_s = float(sum(e.get('dur_s', 0.0) for e in builds
+                               if e.get('cache_hit')))
 
     if end is not None and 'wall_s' in end:
         wall = float(end['wall_s'])
@@ -193,6 +202,12 @@ def summarize(events: List[dict]) -> Dict[str, Any]:
         'data_wait_frac': sum(waits) / busy if busy > 0 else 0.0,
         'goodput': productive / wall if wall > 0 else 0.0,
         'compile_s': compile_s,
+        'startup_compiles': len(builds),
+        'startup_cache_hits': len([e for e in builds
+                                   if e.get('cache_hit')]),
+        'startup_compile_s': startup_cold_s + startup_warm_s,
+        'startup_cold_s': startup_cold_s,
+        'startup_warm_s': startup_warm_s,
         'stalls': len(stalls),
         'wall_s': wall,
         'h2d_s': h2d_s,
@@ -237,6 +252,13 @@ def format_summary(s: Dict[str, Any], path: str = '') -> str:
         f'  stalls         : {s["stalls"]}',
         f'  wall           : {s["wall_s"]:.1f} s',
     ]
+    if s.get('startup_compiles'):
+        lines.append(
+            f'  startup compile: {s["startup_compile_s"]:.2f} s over '
+            f'{s["startup_compiles"]} executables '
+            f'({s["startup_compiles"] - s["startup_cache_hits"]} fresh '
+            f'{s["startup_cold_s"]:.2f} s, {s["startup_cache_hits"]} '
+            f'cache-hit {s["startup_warm_s"]:.2f} s)')
     if s.get('h2d_s') is not None:
         per = (1e3 * s['h2d_s'] / s['h2d_transfers']
                if s['h2d_transfers'] else 0.0)
@@ -294,6 +316,9 @@ _DIFF_ROWS = (
     ('cache_hit_rate', 'cache-hit (%)', 100.0, True),
     ('goodput', 'goodput (%)', 100.0, True),
     ('compile_s', 'compile (s)', 1.0, False),
+    # segwarm: executable-build seconds at startup (a warm-start
+    # regression — cache misses creeping back in — shows here)
+    ('startup_compile_s', 'startup compile (s)', 1.0, False),
     ('stalls', 'stalls', 1.0, False),
     # serving rows (None — rendered as '—' — for training-only runs)
     ('serve_p99_ms', 'serve p99 (ms)', 1.0, False),

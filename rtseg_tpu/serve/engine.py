@@ -96,11 +96,14 @@ class ServeEngine:
 
     def __init__(self, fn: Callable, buckets: Sequence[Bucket], batch: int,
                  name: str = 'serve_engine',
-                 pin: Optional[Callable[[], None]] = None):
+                 pin: Optional[Callable[[], None]] = None,
+                 exe_cache=None, pins=None, compile_workers: int = 0):
         if not buckets:
             raise ValueError('ServeEngine needs at least one bucket')
         if batch < 1:
             raise ValueError(f'batch must be >= 1, got {batch}')
+        import os
+        import time
         import jax
         import jax.numpy as jnp
         self.buckets: List[Bucket] = sorted({(int(h), int(w))
@@ -112,15 +115,52 @@ class ServeEngine:
         self._calls = {b: 0 for b in self.buckets}
         self._images = 0
         self._retraces = 0        # guard trips observed (see dispatch)
+        self.exe_cache = exe_cache
+        self.cache_hits = 0       # executables served from the exe cache
         jitted = jax.jit(fn)
+        # phase 1, sequential: trace + lower each bucket. Lowering reads
+        # the process-global trace flags, so `pin` must precede it and the
+        # loop cannot be parallelized; it is the cheap part anyway.
+        lowereds = []
         for b in self.buckets:
             if pin is not None:
                 pin()
             spec = jax.ShapeDtypeStruct((self.batch, b[0], b[1], 3),
                                         jnp.float32)
-            with span('serve/compile', bucket=f'{b[0]}x{b[1]}',
-                      batch=self.batch):
-                self._compiled[b] = jitted.lower(spec).compile()
+            lowereds.append((b, jitted.lower(spec)))
+
+        # phase 2, concurrent: compile (or deserialize) the bucket table
+        # in a thread pool — XLA compilation releases the GIL, so a cold
+        # multi-bucket init scales with cores instead of serializing
+        def build(b, lowered):
+            tag = f'{b[0]}x{b[1]}'
+            with span('serve/compile', bucket=tag, batch=self.batch):
+                if exe_cache is not None:
+                    compiled, hit = exe_cache.load_or_compile(
+                        lowered, name=f'{name}:{tag}', pins=pins)
+                else:
+                    from ..warm import emit_compile_event
+                    t0 = time.perf_counter()
+                    compiled, hit = lowered.compile(), False
+                    emit_compile_event(f'{name}:{tag}',
+                                       time.perf_counter() - t0, False)
+            return compiled, hit
+
+        workers = int(compile_workers) or min(len(lowereds),
+                                              os.cpu_count() or 1)
+        if workers > 1 and len(lowereds) > 1:
+            from concurrent.futures import ThreadPoolExecutor
+            with ThreadPoolExecutor(max_workers=workers,
+                                    thread_name_prefix=f'{name}-compile'
+                                    ) as pool:
+                futures = [(b, pool.submit(build, b, lo))
+                           for b, lo in lowereds]
+                results = [(b, f.result()) for b, f in futures]
+        else:
+            results = [(b, build(b, lo)) for b, lo in lowereds]
+        for b, (compiled, hit) in results:
+            self._compiled[b] = compiled
+            self.cache_hits += int(hit)
         # arm the guard over the executable table: _cache_size plays the
         # role of the jit cache's introspection hook
         self._cache_size = lambda: len(self._compiled)
@@ -169,6 +209,7 @@ class ServeEngine:
             'images': self._images,
             'retraces': self._retraces
             + max(0, len(self._compiled) - len(self.buckets)),
+            'cache_hits': self.cache_hits,
         }
 
     # -------------------------------------------------------- constructors
@@ -179,7 +220,15 @@ class ServeEngine:
         """Engine from the configured model: weights from ``variables`` or
         a checkpoint (random init when neither is given — load-gen only).
         The inference head is the export head (int8 argmax), so the ckpt
-        and StableHLO paths are the same program."""
+        and StableHLO paths are the same program.
+
+        With ``config.compile_cache``, bucket executables come from the
+        segwarm ExeCache under ``config.compile_cache_dir`` — a second
+        replica's init deserializes instead of recompiling. The inference
+        fn closes over the weights, so they lower as program *constants*:
+        the content hash over the lowered text therefore covers the weight
+        values themselves, and two checkpoints can never alias one cache
+        entry (pinned by tests/test_segwarm.py)."""
         import jax
         import jax.numpy as jnp
         from ..export import build_inference_fn
@@ -208,15 +257,30 @@ class ServeEngine:
             set_stem_packing(s2d)
             set_defer_final_upsample(False)
 
-        return cls(fn, buckets, batch, name=name, pin=pin)
+        exe_cache = None
+        pins = None
+        if getattr(config, 'compile_cache', False):
+            from ..warm import ExeCache, make_pins
+            exe_cache = ExeCache.from_config(config)
+            # the same pin set the RecompileGuard mirrors on trainer steps
+            # (analysis/recompile.py PIN_ATTRS), at this engine's values —
+            # make_pins fails loudly if a new pin is ever omitted here
+            pins = make_pins(bn_axis=None, s2d_stem=s2d,
+                             defer_upsample=False)
+        return cls(fn, buckets, batch, name=name, pin=pin,
+                   exe_cache=exe_cache, pins=pins,
+                   compile_workers=getattr(config, 'compile_workers', 0))
 
     @classmethod
     def from_artifact(cls, path: str, batch: Optional[int] = None,
-                      name: str = 'serve_engine') -> 'ServeEngine':
+                      name: str = 'serve_engine',
+                      exe_cache=None) -> 'ServeEngine':
         """Engine from a serialized ``jax.export`` StableHLO artifact
         (rtseg_tpu/export.py). The artifact's input aval fixes the bucket;
         a symbolic batch dimension takes ``batch`` from the caller, a
-        static one must match it."""
+        static one must match it. ``exe_cache`` (a segwarm ExeCache) makes
+        repeat inits deserialize the compiled executable instead of
+        re-running XLA over the artifact."""
         from ..export import load_exported
         exported = load_exported(path)
         aval = exported.in_avals[0]
@@ -230,4 +294,5 @@ class ServeEngine:
         elif batch is None:
             raise ValueError(
                 f'artifact {path} has a symbolic batch dim; pass batch=')
-        return cls(exported.call, [(int(h), int(w))], int(batch), name=name)
+        return cls(exported.call, [(int(h), int(w))], int(batch), name=name,
+                   exe_cache=exe_cache)

@@ -15,7 +15,8 @@ from __future__ import annotations
 
 import json
 import os
-from typing import Any, Dict, Optional, Tuple
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import orbax.checkpoint as ocp
@@ -23,6 +24,58 @@ import orbax.checkpoint as ocp
 from .state import TrainState
 
 _META = 'meta.json'
+
+
+def snapshot_state(state):
+    """Device-side copy of a train state for an async checkpoint write.
+
+    The compiled train step donates its state argument, so the buffers
+    ``state`` holds now will be *deleted* the moment the next step runs —
+    a background thread doing ``jax.device_get`` on them would race that
+    donation. ``jnp.copy`` per leaf dispatches asynchronously (cheap
+    enqueue, no host sync) and yields fresh buffers nothing ever donates;
+    the writer thread reads those back at its leisure."""
+    import jax.numpy as jnp
+    return jax.tree.map(jnp.copy, state)
+
+
+class AsyncCkptWriter:
+    """One-deep background checkpoint writer.
+
+    ``submit(fn)`` first joins any write still in flight (saves stay
+    ordered on disk and at most one snapshot is resident), then runs
+    ``fn`` on a daemon thread. A failed write re-raises on the next
+    ``submit``/``join`` — the epoch loop hears about a bad disk at the
+    next save instead of silently training past it. ``join()`` must also
+    run before anything *reads* the checkpoint (resume, val_best) and at
+    the end of ``run()``."""
+
+    def __init__(self):
+        self._thread: Optional[threading.Thread] = None
+        self._err: Optional[BaseException] = None
+
+    def submit(self, fn: Callable[[], None]) -> None:
+        self.join()
+
+        def run():
+            try:
+                fn()
+            except BaseException as e:   # noqa: BLE001 — re-raised on join
+                self._err = e
+
+        self._thread = threading.Thread(target=run, name='ckpt-writer',
+                                        daemon=True)
+        self._thread.start()
+
+    def join(self) -> None:
+        t = self._thread
+        if t is not None:
+            t.join()
+            self._thread = None
+        if self._err is not None:
+            err, self._err = self._err, None
+            raise RuntimeError(
+                'background checkpoint write failed') from err
 
 
 def _ckptr():

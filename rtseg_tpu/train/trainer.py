@@ -36,8 +36,10 @@ from ..parallel import (batch_sharding, data_sharding, init_multihost,
                         main_rank, make_global_array, make_mesh, replicated)
 from ..utils import (TBWriter, get_colormap, get_logger, iou_from_cm,
                      log_config, mkdir, save_config, set_seed)
-from .checkpoint import (load_meta, restore_train_ckpt, restore_weights,
-                         save_best_ckpt, save_train_ckpt)
+from ..analysis.recompile import introspectable
+from .checkpoint import (AsyncCkptWriter, load_meta, restore_train_ckpt,
+                         restore_weights, save_train_ckpt,
+                         save_weights_ckpt, snapshot_state)
 from .optim import get_optimizer
 from .state import create_train_state
 from .step import build_eval_step, build_train_step
@@ -52,6 +54,13 @@ class SegTrainer:
         # fields (lr scaling, workers) to the actual mesh size
         config.resolve(num_devices=n_devices)
         self.config = config
+        if config.compile_cache:
+            # segwarm: point jax's persistent compilation cache at
+            # compile_cache_dir before anything compiles (model init's
+            # eager ops included) — the second run of this config loads
+            # every XLA executable instead of rebuilding it
+            from ..warm import enable_compile_cache
+            enable_compile_cache(config)
         self.main_rank = main_rank()
         self.logger = get_logger(config, self.main_rank)
         mkdir(config.save_dir)
@@ -70,6 +79,9 @@ class SegTrainer:
             return
 
         self.writer = TBWriter(config, self.main_rank)
+        # checkpoint writes happen off the epoch loop (see save_ckpt);
+        # join before every read/re-save and at run() end
+        self._ckpt_writer = AsyncCkptWriter()
         # segscope telemetry: every host writes its own JSONL event stream
         # (tools/segscope.py report aggregates); the watchdog thread is
         # started/stopped by run()
@@ -117,6 +129,17 @@ class SegTrainer:
                                            norm_coeffs=norm_coeffs)
         self.eval_step = build_eval_step(config, self.model, self.mesh,
                                          norm_coeffs=norm_coeffs)
+        self._exe_cache = None
+        if config.compile_cache:
+            # segwarm executable cache: each step's first call AOT-lowers
+            # with the real args and deserializes the stored executable on
+            # a warm start (compiles-and-stores cold) — see warm/prime.py
+            from ..warm import ExeCache, warm_step
+            self._exe_cache = ExeCache.from_config(config)
+            self.train_step = warm_step(self.train_step, self._exe_cache,
+                                        'train_step')
+            self.eval_step = warm_step(self.eval_step, self._exe_cache,
+                                       'eval_step')
         if config.recompile_guard:
             # fail loudly on any post-warmup retrace of a compiled step
             # (static-shape promise; see analysis/recompile.py)
@@ -214,13 +237,36 @@ class SegTrainer:
         # base_trainer.py:152-154, where the branch is a latent NameError)
         name = cfg.ckpt_name or ('best.ckpt' if best else 'last.ckpt')
         path = os.path.join(cfg.save_dir, name)
-        with span('ckpt/save', best=best):
+        # async write: the epoch loop pays only for joining the previous
+        # write plus a device-side state copy (async dispatch) — the
+        # device_get readback and the orbax serialization run on the
+        # writer thread, overlapped with the next epoch's compute. The
+        # `ckpt/save` span is therefore the enqueue cost; `ckpt/flush`
+        # (emitted by the writer) is the actual readback+write time.
+        with span('ckpt/save', best=best, phase='enqueue'):
+            self._ckpt_writer.join()
+            epoch, score = self.cur_epoch + 1, float(self.best_score)
             if best:
-                save_best_ckpt(path, self.state, self.cur_epoch + 1,
-                               self.best_score)
+                # best.ckpt writes only the EMA slots (reference
+                # base_trainer.py:155,161-162) — snapshot just those two
+                # trees, not the 3-4x of params/opt_state the write
+                # would never read
+                ema_p = jax.tree.map(jnp.copy, self.state.ema_params)
+                ema_bs = jax.tree.map(jnp.copy, self.state.ema_batch_stats)
+
+                def write():
+                    with span('ckpt/flush', best=True):
+                        save_weights_ckpt(path, ema_p, ema_bs,
+                                          cur_epoch=epoch,
+                                          best_score=score)
             else:
-                save_train_ckpt(path, self.state, self.cur_epoch + 1,
-                                self.best_score)
+                snap = snapshot_state(self.state)
+
+                def write():
+                    with span('ckpt/flush', best=False):
+                        save_train_ckpt(path, snap, epoch, score)
+
+            self._ckpt_writer.submit(write)
 
     # ------------------------------------------------------------------- run
     def _put(self, batch):
@@ -287,20 +333,27 @@ class SegTrainer:
                     f'{time.perf_counter() - start:.1f}s')
             score = self.val_best()
         finally:
-            if self._watchdog is not None:
-                self._watchdog.stop()
-                self._watchdog = None
-            if self._obs_sink is not None:
-                # wall_s is the goodput denominator: the run() loop proper
-                # (trainer construction is not counted; see BENCHMARKS.md
-                # "Goodput")
-                self._obs_sink.emit({
-                    'event': 'run_end',
-                    'wall_s': round(time.perf_counter() - start, 3)})
-                self._obs_sink.close()
-                if obs.get_sink() is self._obs_sink:
-                    obs.set_sink(None)
-                self._obs_sink = None
+            # the last checkpoint write must land (and any write error
+            # surface) before the run is declared over — but a failed
+            # write must not skip the watchdog/sink teardown, so the
+            # join wraps the rest of the cleanup
+            try:
+                self._ckpt_writer.join()
+            finally:
+                if self._watchdog is not None:
+                    self._watchdog.stop()
+                    self._watchdog = None
+                if self._obs_sink is not None:
+                    # wall_s is the goodput denominator: the run() loop
+                    # proper (trainer construction is not counted; see
+                    # BENCHMARKS.md "Goodput")
+                    self._obs_sink.emit({
+                        'event': 'run_end',
+                        'wall_s': round(time.perf_counter() - start, 3)})
+                    self._obs_sink.close()
+                    if obs.get_sink() is self._obs_sink:
+                        obs.set_sink(None)
+                    self._obs_sink = None
         self.writer.close()
         return score
 
@@ -328,7 +381,7 @@ class SegTrainer:
         # async dispatch is untouched.
         col = StepCollector(self._obs_sink, 'train',
                             imgs_per_step=cfg.train_bs * cfg.gpu_num,
-                            jitted=getattr(self.train_step, 'jitted', None),
+                            jitted=introspectable(self.train_step),
                             watchdog=self._watchdog, epoch=self.cur_epoch)
         # event/TB step ids are derived host-side from one sync per epoch
         # (the compiled step advances state.step by exactly 1), so the loop
@@ -431,7 +484,7 @@ class SegTrainer:
         checked_bound = False
         col = StepCollector(self._obs_sink, 'val',
                             imgs_per_step=cfg.val_bs * cfg.gpu_num,
-                            jitted=getattr(self.eval_step, 'jitted', None),
+                            jitted=introspectable(self.eval_step),
                             watchdog=self._watchdog, epoch=self.cur_epoch)
         batches = self._batches(self.val_loader)
         try:
@@ -496,6 +549,7 @@ class SegTrainer:
         (reference base_trainer.py:165-186)."""
         cfg = self.config
         best_path = os.path.join(cfg.save_dir, 'best.ckpt')
+        self._ckpt_writer.join()      # best.ckpt may still be in flight
         if load_meta(best_path) is None:
             return self.validate(val_best=True)
         p, bs = restore_weights(best_path, self.state.ema_params,

@@ -1,0 +1,39 @@
+"""segwarm — persistent compile cache + zero-compile warm starts.
+
+The whole repo attacks steady-state step time; this package attacks
+time-to-first-step. Every trainer launch, ServeEngine init, and CI job used
+to pay the full XLA compile bill from scratch — seconds that segscope
+attributes as lost goodput (obs/collector.py compile attribution) and that
+dominate short jobs, autoscaled serving replicas, and zoo sweeps. Two
+complementary mechanisms, both behind ``config.compile_cache``:
+
+  * :mod:`compile_cache` — jax's persistent XLA compilation cache
+    (``jax_compilation_cache_dir``) for every jit path in the process,
+    including eager op-by-op compiles during model init;
+  * :mod:`exe_cache`     — :class:`ExeCache`, serialization of whole
+    AOT-compiled executables (``jax.experimental.serialize_executable``)
+    keyed by a content hash over the lowered StableHLO text, jax/jaxlib
+    versions, backend + device topology, and the trace-global pins the
+    RecompileGuard tracks (analysis/recompile.py PIN_ATTRS). A hit
+    deserializes in milliseconds instead of recompiling; any load or
+    compatibility error degrades to a fresh compile with a warning —
+    never a crash and never a stale hit.
+  * :mod:`prime`         — ``warm_step``: wraps a built train/eval step so
+    its first call AOT-lowers with the real args and compiles *through*
+    the ExeCache, then dispatches straight to the compiled executable.
+
+This module must stay importable without jax (the segcheck ``warm-key``
+lint compares PIN_ATTRS against PIN_KEYS in the jax-free lint tier); all
+jax imports live inside functions.
+"""
+
+from .compile_cache import enable_compile_cache
+from .exe_cache import (PIN_KEYS, ExeCache, cache_key, clear_cache,
+                        emit_compile_event, scan_cache, timed_compile)
+from .prime import make_pins, step_pins, warm_step
+
+__all__ = [
+    'ExeCache', 'PIN_KEYS', 'cache_key', 'clear_cache', 'emit_compile_event',
+    'enable_compile_cache', 'make_pins', 'scan_cache', 'step_pins',
+    'timed_compile', 'warm_step',
+]

@@ -1,0 +1,54 @@
+"""Persistent XLA compilation cache wiring (mechanism 1 of segwarm).
+
+jax ships a content-addressed on-disk cache of compiled XLA executables
+(``jax_compilation_cache_dir``): every backend compile — jit dispatch,
+AOT ``lower().compile()``, even the op-by-op programs of eager model init —
+is stored keyed by the computation + compile options + versions, and the
+next process to compile the identical program loads it instead. segwarm
+turns it on for the whole process from ``config.compile_cache``; the knobs
+below default to "cache everything" because the workloads segwarm targets
+(CI jobs, short runs, serving replicas) are exactly the ones whose
+compiles fall under jax's default 1-second minimum.
+
+This is the safety-net layer: it needs no key management from us (jax owns
+invalidation) and it catches every jit path the :class:`~.ExeCache` does
+not explicitly front.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def enable_compile_cache(config=None, cache_dir: Optional[str] = None,
+                         min_entry_bytes: Optional[int] = None,
+                         min_compile_secs: Optional[float] = None) -> str:
+    """Point jax's persistent compilation cache at ``<dir>/xla``.
+
+    Pass either a resolved SegConfig (reads ``compile_cache_dir`` and the
+    min-entry/min-compile knobs) or explicit arguments. Idempotent; returns
+    the directory actually configured. Must run before the executables it
+    should cache are compiled — the trainer and the serve CLI call it
+    first thing after config resolution.
+    """
+    if config is not None:
+        cache_dir = cache_dir or config.compile_cache_dir
+        if min_entry_bytes is None:
+            min_entry_bytes = config.compile_cache_min_entry_bytes
+        if min_compile_secs is None:
+            min_compile_secs = config.compile_cache_min_compile_secs
+    if not cache_dir:
+        raise ValueError('enable_compile_cache needs a cache_dir (resolve '
+                         'the config or pass one explicitly)')
+    xla_dir = os.path.join(os.path.abspath(cache_dir), 'xla')
+    os.makedirs(xla_dir, exist_ok=True)
+    import jax
+    jax.config.update('jax_enable_compilation_cache', True)
+    jax.config.update('jax_compilation_cache_dir', xla_dir)
+    jax.config.update('jax_persistent_cache_min_entry_size_bytes',
+                      int(0 if min_entry_bytes is None else min_entry_bytes))
+    jax.config.update('jax_persistent_cache_min_compile_time_secs',
+                      float(0.0 if min_compile_secs is None
+                            else min_compile_secs))
+    return xla_dir

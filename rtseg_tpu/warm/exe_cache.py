@@ -1,0 +1,408 @@
+"""ExeCache — serialized AOT executables keyed by a content hash.
+
+The persistent XLA cache (compile_cache.py) shortcuts the *XLA compile*;
+this layer shortcuts the whole ``lower().compile()`` product: the compiled
+executable itself is pickled (``jax.experimental.serialize_executable``)
+and reloaded in milliseconds on the next init. That is what turns a
+multi-bucket ServeEngine init or a trainer's first step from seconds of
+compile into a disk read.
+
+The cache key is a sha256 over everything that could make a stored
+executable wrong to reuse:
+
+  * the lowered StableHLO text — the program itself, which also encodes
+    input shapes/dtypes, shardings, and donation;
+  * jax + jaxlib versions (serialized executables are not portable across
+    releases);
+  * backend platform, device kinds, device/process counts (an executable
+    compiled for 8 virtual CPUs must not load onto 1, or onto a TPU);
+  * the trace-global pins the RecompileGuard tracks (PIN_KEYS, audited
+    against analysis/recompile.py PIN_ATTRS by the ``warm-key`` lint) —
+    belt-and-braces on top of the lowered text, so a pin that changes
+    behavior without changing this particular program can still never
+    alias two entries;
+  * caller-provided ``extra`` (e.g. an artifact path's content hash).
+
+Safety contract (pinned by tests/test_segwarm.py): a hit is bit-identical
+to a fresh compile of the same lowering; ANY load, version, or
+compatibility error falls back to a fresh compile with a warning and a
+record in ``fallbacks.jsonl`` — never a crash, never a stale hit.
+
+Module-level code is jax-free (the segcheck ``warm-key`` lint imports
+PIN_KEYS in the jax-less lint tier); jax is imported inside functions.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import threading
+import time
+import warnings
+from typing import Any, Dict, Optional, Tuple
+
+#: trace-global pins folded into every cache key. Must cover every pin the
+#: RecompileGuard mirrors on step wrappers (analysis/recompile.py
+#: PIN_ATTRS) — the `warm-key` lint (analysis/lint_warm.py) fails the
+#: build if a pin is added there but omitted here, because a key that
+#: ignores a trace-global is a stale-hit waiting to happen.
+PIN_KEYS = ('bn_axis', 's2d_stem', 'defer_upsample')
+
+_EXE_SUFFIX = '.exe'
+_META_SUFFIX = '.json'
+_FALLBACK_LOG = 'fallbacks.jsonl'
+
+
+def exe_dir(cache_dir: str) -> str:
+    """Where executable entries live under a segwarm cache dir — the one
+    place the ``exe/`` layout literal is spelled (compile_cache.py owns
+    the sibling ``xla/``)."""
+    return os.path.join(os.path.abspath(cache_dir), 'exe')
+
+
+def backend_fingerprint() -> Dict[str, Any]:
+    """The device-topology part of the cache key: platform, device kinds,
+    device/process counts, and the process's XLA flags. Serialized
+    executables bind device ids, so any topology change must miss — and
+    XLA_FLAGS can change codegen without changing the lowered text, so a
+    flag flip must miss too (never a stale hit)."""
+    import jax
+    devs = jax.devices()
+    return {
+        'platform': devs[0].platform,
+        'device_kinds': sorted({d.device_kind for d in devs}),
+        'n_devices': len(devs),
+        'n_processes': jax.process_count(),
+        'xla_flags': os.environ.get('XLA_FLAGS', ''),
+    }
+
+
+def _versions() -> Dict[str, str]:
+    import jax
+    import jaxlib
+    return {'jax': jax.__version__, 'jaxlib': jaxlib.__version__}
+
+
+def cache_key(lowered_text: str, pins: Optional[Dict[str, Any]] = None,
+              extra: Any = None,
+              versions: Optional[Dict[str, str]] = None,
+              backend: Optional[Dict[str, Any]] = None) -> str:
+    """Content hash for one lowered program. ``versions``/``backend``
+    default to the live process (overridable for tests)."""
+    ident = {
+        'versions': versions if versions is not None else _versions(),
+        'backend': backend if backend is not None else backend_fingerprint(),
+        'pins': {k: repr(v) for k, v in sorted((pins or {}).items())},
+        'extra': repr(extra) if extra is not None else None,
+    }
+    h = hashlib.sha256()
+    h.update(json.dumps(ident, sort_keys=True).encode())
+    h.update(b'\x00')
+    h.update(lowered_text.encode())
+    return h.hexdigest()
+
+
+def emit_compile_event(name: str, dur_s: float, cache_hit: bool,
+                       nbytes: Optional[int] = None,
+                       key: Optional[str] = None, **attrs: Any) -> None:
+    """Structured segscope ``compile`` event: one per executable build,
+    flagged with whether the cache served it. obs/report.py aggregates
+    these into the cold-vs-warm startup-compile seconds, and the segwarm
+    CI gate asserts a warm run's events are all ``cache_hit``."""
+    from ..obs import get_sink
+    sink = get_sink()
+    if sink is None:
+        return
+    ev: Dict[str, Any] = {'event': 'compile', 'name': name,
+                          'dur_s': round(dur_s, 6), 'cache_hit': cache_hit}
+    if nbytes is not None:
+        ev['bytes'] = int(nbytes)
+    if key is not None:
+        ev['key'] = key[:16]
+    ev.update(attrs)
+    sink.emit(ev)
+
+
+def timed_compile(lowered, name: str, cache: Optional['ExeCache'] = None,
+                  pins: Optional[Dict[str, Any]] = None):
+    """(compiled, first-call compile seconds, label) for one lowering —
+    through ``cache`` when given (labels ``warm cache-hit`` / ``warm
+    miss, stored``), else a fresh compile (``cold``). One segscope
+    ``compile`` event either way, so cold and warm bench runs feed the
+    startup-compile metric symmetrically. The labels are a documented
+    contract (BENCHMARKS.md "Cold-vs-warm startup methodology") — this is
+    the one place they are spelled, shared by benchmark_all.py and
+    test_speed.py."""
+    t0 = time.perf_counter()
+    if cache is not None:
+        compiled, hit = cache.load_or_compile(lowered, name=name, pins=pins)
+        return (compiled, time.perf_counter() - t0,
+                'warm cache-hit' if hit else 'warm miss, stored')
+    compiled = lowered.compile()
+    dur = time.perf_counter() - t0
+    emit_compile_event(name, dur, False)
+    return compiled, dur, 'cold'
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    tmp = f'{path}.tmp.{os.getpid()}.{threading.get_ident()}'
+    with open(tmp, 'wb') as f:
+        f.write(data)
+    os.replace(tmp, path)
+
+
+class ExeCache:
+    """On-disk cache of serialized compiled executables.
+
+    Layout under ``root``: ``<key>.exe`` (pickled payload + arg pytrees)
+    with a ``<key>.json`` provenance sidecar (name, versions, backend,
+    pins, bytes, original compile seconds, hit count), plus
+    ``fallbacks.jsonl`` recording every load error that degraded to a
+    fresh compile. Thread-safe — ServeEngine's bucket pool shares one
+    instance across workers; writes are atomic tmp+rename so concurrent
+    processes can share a directory (last store wins).
+    """
+
+    @classmethod
+    def from_config(cls, config) -> 'ExeCache':
+        """The one way a resolved SegConfig becomes an ExeCache — entries
+        under ``compile_cache_dir/exe`` with the config's store gates.
+        Keeps the trainer, the serve engine, and the CLIs from each
+        restating (and drifting on) the layout."""
+        return cls(exe_dir(config.compile_cache_dir),
+                   min_entry_bytes=config.compile_cache_min_entry_bytes,
+                   min_compile_secs=config.compile_cache_min_compile_secs)
+
+    @classmethod
+    def at(cls, cache_dir: str) -> 'ExeCache':
+        """ExeCache under a bare segwarm cache dir (default store gates) —
+        the CLI/bench entry point when no resolved config is in hand."""
+        return cls(exe_dir(cache_dir))
+
+    def __init__(self, root: str, min_entry_bytes: int = 0,
+                 min_compile_secs: float = 0.0):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+        self.min_entry_bytes = int(min_entry_bytes)
+        self.min_compile_secs = float(min_compile_secs)
+        self._lock = threading.Lock()
+        # process-lifetime counters (segwarm.py stats merges these with the
+        # persisted per-entry metadata)
+        self.hits = 0
+        self.misses = 0
+        self.fallbacks = 0            # artifact present but unloadable
+        self.store_failures = 0
+        self.bytes_read = 0
+        self.bytes_written = 0
+        self.hit_s = 0.0              # deserialize time
+        self.miss_s = 0.0             # fresh-compile time
+
+    # ------------------------------------------------------------- paths
+    def _exe_path(self, key: str) -> str:
+        return os.path.join(self.root, key + _EXE_SUFFIX)
+
+    def _meta_path(self, key: str) -> str:
+        return os.path.join(self.root, key + _META_SUFFIX)
+
+    # ------------------------------------------------------------ public
+    def load_or_compile(self, lowered, name: str,
+                        pins: Optional[Dict[str, Any]] = None,
+                        extra: Any = None) -> Tuple[Any, bool]:
+        """Deserialize the executable for ``lowered`` if a compatible entry
+        exists, else ``lowered.compile()`` and store. Returns
+        ``(compiled, cache_hit)``. Emits one segscope ``compile`` event
+        either way."""
+        key = cache_key(lowered.as_text(), pins=pins, extra=extra)
+        t0 = time.perf_counter()
+        compiled, nbytes = self._try_load(key, name)
+        if compiled is not None:
+            dur = time.perf_counter() - t0
+            with self._lock:
+                self.hits += 1
+                self.hit_s += dur
+                self.bytes_read += nbytes
+            self._bump_hit(key)
+            emit_compile_event(name, dur, True, nbytes=nbytes, key=key)
+            return compiled, True
+        # fresh timer: a fallback's failed read/unpickle must not inflate
+        # the recorded compile seconds (provenance + compile event)
+        t0 = time.perf_counter()
+        compiled = lowered.compile()
+        dur = time.perf_counter() - t0
+        with self._lock:
+            self.misses += 1
+            self.miss_s += dur
+        stored = self._try_store(key, name, compiled, dur, pins)
+        emit_compile_event(name, dur, False, nbytes=stored, key=key)
+        return compiled, False
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                'root': self.root, 'hits': self.hits, 'misses': self.misses,
+                'fallbacks': self.fallbacks,
+                'store_failures': self.store_failures,
+                'bytes_read': self.bytes_read,
+                'bytes_written': self.bytes_written,
+                'hit_s': round(self.hit_s, 4),
+                'miss_s': round(self.miss_s, 4),
+            }
+
+    # ----------------------------------------------------------- internals
+    def _try_load(self, key: str, name: str
+                  ) -> Tuple[Optional[Any], int]:
+        """(compiled, bytes) on a good hit; (None, 0) on miss OR on any
+        load error — the error path records a fallback and warns, so a
+        corrupt/incompatible artifact costs one compile, never a crash."""
+        path = self._exe_path(key)
+        if not os.path.exists(path):
+            return None, 0
+        try:
+            with open(path, 'rb') as f:
+                blob = f.read()
+            entry = pickle.loads(blob)
+            from jax.experimental import serialize_executable
+            compiled = serialize_executable.deserialize_and_load(
+                entry['payload'], entry['in_tree'], entry['out_tree'])
+            return compiled, len(blob)
+        except Exception as e:   # noqa: BLE001 — ANY load error must
+            #                      degrade to a fresh compile (corrupt
+            #                      file, jaxlib drift, missing device ids)
+            self._record_fallback(key, name, e)
+            return None, 0
+
+    def _try_store(self, key: str, name: str, compiled, compile_s: float,
+                   pins: Optional[Dict[str, Any]]) -> Optional[int]:
+        """Serialize + write one entry; returns stored bytes or None when
+        skipped/failed. Serialization failures (a backend without
+        executable serialization) only lose the warm start, never the
+        compile we just did."""
+        if compile_s < self.min_compile_secs:
+            return None
+        try:
+            from jax.experimental import serialize_executable
+            payload, in_tree, out_tree = serialize_executable.serialize(
+                compiled)
+            blob = pickle.dumps({'payload': payload, 'in_tree': in_tree,
+                                 'out_tree': out_tree})
+            if len(blob) < self.min_entry_bytes:
+                return None
+            meta = {
+                'key': key, 'name': name, 'created': time.time(),
+                'compile_s': round(compile_s, 4), 'bytes': len(blob),
+                'pins': {k: repr(v) for k, v in sorted((pins or {}).items())},
+                'hits': 0,
+                **_versions(), **backend_fingerprint(),
+            }
+            _atomic_write(self._exe_path(key), blob)
+            _atomic_write(self._meta_path(key),
+                          json.dumps(meta, indent=1).encode())
+            with self._lock:
+                self.bytes_written += len(blob)
+            return len(blob)
+        except Exception as e:   # noqa: BLE001 — storing is best-effort
+            with self._lock:
+                self.store_failures += 1
+            warnings.warn(f'segwarm: could not serialize {name!r} for the '
+                          f'executable cache ({type(e).__name__}: {e}); '
+                          f'this run keeps its fresh compile', stacklevel=3)
+            return None
+
+    def _bump_hit(self, key: str) -> None:
+        """Best-effort per-entry hit counter in the provenance sidecar
+        (what `segwarm.py stats` reports across processes). The
+        read-modify-write is not cross-process atomic: simultaneous inits
+        can undercount by a hit — acceptable for bookkeeping, so don't
+        gate `stats --check --min-hits` tighter than sequential runs
+        guarantee."""
+        try:
+            with open(self._meta_path(key)) as f:
+                meta = json.load(f)
+            meta['hits'] = int(meta.get('hits', 0)) + 1
+            meta['last_used'] = time.time()
+            _atomic_write(self._meta_path(key),
+                          json.dumps(meta, indent=1).encode())
+        except Exception:   # noqa: BLE001 — stats bookkeeping only
+            pass
+
+    def _record_fallback(self, key: str, name: str, err: Exception) -> None:
+        with self._lock:
+            self.fallbacks += 1
+        warnings.warn(f'segwarm: cached executable for {name!r} '
+                      f'({key[:16]}…) failed to load '
+                      f'({type(err).__name__}: {err}); falling back to a '
+                      f'fresh compile', stacklevel=3)
+        try:
+            line = json.dumps({'ts': time.time(), 'key': key, 'name': name,
+                               'error': f'{type(err).__name__}: {err}'})
+            with self._lock:
+                with open(os.path.join(self.root, _FALLBACK_LOG), 'a') as f:
+                    f.write(line + '\n')
+        except OSError:
+            pass
+
+
+# -------------------------------------------------------------- CLI helpers
+def scan_cache(cache_dir: str) -> Dict[str, Any]:
+    """Aggregate one segwarm cache directory (``<dir>/exe`` entries +
+    sidecars + fallback log, ``<dir>/xla`` persistent-cache files) into the
+    stats `tools/segwarm.py stats` prints. Pure stdlib — runs on machines
+    without jax."""
+    cache_dir = os.path.abspath(cache_dir)
+    entries_dir = exe_dir(cache_dir)
+    entries = []
+    if os.path.isdir(entries_dir):
+        for fn in sorted(os.listdir(entries_dir)):
+            if not fn.endswith(_META_SUFFIX):
+                continue
+            try:
+                with open(os.path.join(entries_dir, fn)) as f:
+                    entries.append(json.load(f))
+            except (OSError, json.JSONDecodeError):
+                continue
+    fallbacks = []
+    fb_path = os.path.join(entries_dir, _FALLBACK_LOG)
+    if os.path.exists(fb_path):
+        with open(fb_path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    fallbacks.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+    xla_dir = os.path.join(cache_dir, 'xla')
+    xla_files = []
+    if os.path.isdir(xla_dir):
+        for dirpath, _, filenames in os.walk(xla_dir):
+            xla_files.extend(os.path.join(dirpath, fn) for fn in filenames)
+    return {
+        'cache_dir': cache_dir,
+        'entries': entries,
+        'n_entries': len(entries),
+        'bytes': sum(int(e.get('bytes', 0)) for e in entries),
+        'hits': sum(int(e.get('hits', 0)) for e in entries),
+        'fallbacks': fallbacks,
+        'n_fallbacks': len(fallbacks),
+        'xla_entries': len(xla_files),
+        'xla_bytes': sum(os.path.getsize(p) for p in xla_files
+                         if os.path.exists(p)),
+    }
+
+
+def clear_cache(cache_dir: str) -> int:
+    """Remove every cached artifact (exe entries, sidecars, fallback log,
+    persistent-XLA files) under ``cache_dir``; returns files removed."""
+    import shutil
+    removed = 0
+    for sub in ('exe', 'xla'):
+        d = os.path.join(os.path.abspath(cache_dir), sub)
+        if not os.path.isdir(d):
+            continue
+        for dirpath, _, filenames in os.walk(d):
+            removed += len(filenames)
+        shutil.rmtree(d)
+    return removed

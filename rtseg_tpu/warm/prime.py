@@ -1,0 +1,82 @@
+"""warm_step — route a built train/eval step through the ExeCache.
+
+The step builders (train/step.py) return jit-wrapped callables that trace
+and compile lazily on the first call. This wrapper keeps that laziness —
+the first call still defines the shapes/shardings, so nothing has to guess
+batch geometry up front — but replaces the compile half: it AOT-lowers
+with the real first-call args (trace cost only) and obtains the executable
+through :class:`~.ExeCache.load_or_compile`. On a warm start that is a
+millisecond deserialize instead of the XLA compile; either way every later
+call dispatches straight to the compiled executable, bypassing jit's
+dispatch machinery entirely.
+
+Static-shape contract: a Compiled executable accepts exactly the avals it
+was built for, so a drifting batch shape raises a TypeError naming the
+mismatch — the same promise config.recompile_guard enforces on the jit
+path, now structural. The wrapper exposes ``_cache_size`` (number of
+executables built: 0 then 1) so the RecompileGuard and the segscope
+StepCollector attribute the first call's lower+load time as compile time
+through the exact introspection they already use
+(analysis/recompile.py ``introspectable``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+from ..analysis.recompile import _MIRRORED_ATTRS, PIN_ATTRS
+from .exe_cache import ExeCache
+
+
+def step_pins(step_fn: Any) -> Dict[str, Any]:
+    """The trace-global pin values a built step wrapper carries
+    (train/step.py _pin_bn_axis) — the PIN_ATTRS part of its cache key."""
+    return {k: getattr(step_fn, k, None) for k in PIN_ATTRS}
+
+
+def make_pins(**values: Any) -> Dict[str, Any]:
+    """Pins dict for a cache key from explicit values, validated against
+    PIN_ATTRS — a call site that omits (or invents) a pin fails loudly
+    instead of silently thinning the key the warm-key lint protects."""
+    missing = [a for a in PIN_ATTRS if a not in values]
+    extra = [k for k in values if k not in PIN_ATTRS]
+    if missing or extra:
+        raise ValueError(
+            f'pins must cover exactly analysis/recompile.py PIN_ATTRS '
+            f'{PIN_ATTRS}: missing {missing}, unknown {extra}')
+    return values
+
+
+def warm_step(step_fn: Callable, cache: ExeCache, name: str,
+              extra: Any = None) -> Callable:
+    """Wrap a built step (the _pin_bn_axis wrapper) so its first call
+    compiles through ``cache`` and later calls run the executable
+    directly. Composes under analysis/recompile.guard_step."""
+    jitted = getattr(step_fn, 'jitted', step_fn)
+    pin: Optional[Callable[[], None]] = getattr(step_fn, 'pin', None)
+    pins = step_pins(step_fn)
+    holder: Dict[str, Any] = {'compiled': None}
+
+    def wrapper(*args, **kwargs):
+        compiled = holder['compiled']
+        if compiled is None:
+            if pin is not None:
+                # the lowering below traces: the process-global trace
+                # flags must be this builder's, not a later builder's
+                pin()
+            lowered = jitted.lower(*args, **kwargs)
+            compiled, _ = cache.load_or_compile(lowered, name=name,
+                                                pins=pins, extra=extra)
+            holder['compiled'] = compiled
+        return compiled(*args, **kwargs)
+
+    for attr in _MIRRORED_ATTRS:
+        if hasattr(step_fn, attr):
+            setattr(wrapper, attr, getattr(step_fn, attr))
+    # overrides the mirrored jit introspection: compile activity on this
+    # step is executable builds, not jit-cache growth (the jit cache never
+    # grows — jit dispatch is never entered)
+    wrapper._cache_size = lambda: int(holder['compiled'] is not None)
+    wrapper.exe_cache = cache
+    wrapper.__wrapped__ = step_fn
+    return wrapper

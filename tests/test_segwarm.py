@@ -1,0 +1,533 @@
+"""segwarm (rtseg_tpu/warm): cache-key invalidation, serialized-executable
+bit-parity vs fresh compile (train step + serve bucket), corrupt-artifact
+fallback, concurrent bucket init, the warm-key pin-coverage lint, async
+checkpoint writes, the segscope compile events + report keys, and the
+segwarm CLI e2e.
+
+All CPU-fast: fastscnn at 32x32, num_class 5, float32; the pure
+cache/key/lint/report tests never compile anything."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from rtseg_tpu.config import SegConfig
+from rtseg_tpu.warm import (PIN_KEYS, ExeCache, cache_key,
+                            enable_compile_cache, scan_cache, warm_step)
+
+
+def _cfg(tmp, **kw):
+    base = dict(dataset='synthetic', model='fastscnn', num_class=5,
+                colormap='custom', compute_dtype='float32',
+                save_dir=str(tmp), use_tb=False)
+    base.update(kw)
+    cfg = SegConfig(**base)
+    cfg.resolve(num_devices=1)
+    return cfg
+
+
+def _tiny_lowered(scale=2.0, shape=(8, 8)):
+    """A lowered program cheap enough to compile in unit tests."""
+    import jax
+    import jax.numpy as jnp
+
+    def f(x):
+        return jnp.sin(x * scale) @ x.T
+
+    return jax.jit(f).lower(
+        jax.ShapeDtypeStruct(shape, jnp.float32))
+
+
+# ---------------------------------------------------------------- cache key
+def test_cache_key_invalidation_axes():
+    """Every axis of the key — program text, pins, versions, backend
+    topology, extra — invalidates independently; identical inputs are
+    stable."""
+    base = dict(pins={'bn_axis': None, 's2d_stem': False},
+                versions={'jax': '0.4.37', 'jaxlib': '0.4.36'},
+                backend={'platform': 'cpu', 'device_kinds': ['cpu'],
+                         'n_devices': 1, 'n_processes': 1})
+    k = cache_key('module @jit_f {}', **base)
+    assert k == cache_key('module @jit_f {}', **base)     # deterministic
+    assert k != cache_key('module @jit_g {}', **base)     # program
+    assert k != cache_key('module @jit_f {}', **{
+        **base, 'pins': {'bn_axis': ('data',), 's2d_stem': False}})
+    assert k != cache_key('module @jit_f {}', **{
+        **base, 'pins': {'bn_axis': None, 's2d_stem': True}})
+    assert k != cache_key('module @jit_f {}', **{
+        **base, 'versions': {'jax': '0.5.0', 'jaxlib': '0.5.0'}})
+    assert k != cache_key('module @jit_f {}', **{
+        **base, 'backend': {**base['backend'], 'n_devices': 8}})
+    assert k != cache_key('module @jit_f {}', **{
+        **base, 'backend': {**base['backend'], 'platform': 'tpu'}})
+    assert k != cache_key('module @jit_f {}', **base, extra='ckpt-v2')
+
+
+def test_pin_keys_cover_recompile_pins():
+    from rtseg_tpu.analysis.recompile import PIN_ATTRS
+    assert set(PIN_ATTRS) <= set(PIN_KEYS)
+
+
+def test_warm_key_lint_clean_and_seeded():
+    from rtseg_tpu.analysis import check_warm_key_coverage
+    from rtseg_tpu.analysis.core import ALL_RULES, repo_root, run_lints
+    assert 'warm-key' in ALL_RULES
+    root = repo_root()
+    assert check_warm_key_coverage(root) == []
+    # seeded violation: a pin the RecompileGuard would track but the
+    # cache key omits must produce exactly one finding naming it
+    findings = check_warm_key_coverage(
+        root, pin_attrs=PIN_KEYS + ('new_trace_pin',), pin_keys=PIN_KEYS)
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == 'warm-key'
+    assert 'new_trace_pin' in f.message
+    assert f.path.endswith('warm/exe_cache.py') and f.line > 1
+    # the full lint run over the real tree stays clean with the rule armed
+    assert [x for x in run_lints(root, rules=['warm-key'])] == []
+
+
+# ----------------------------------------------------------------- ExeCache
+def test_exe_cache_roundtrip_bit_parity(tmp_path):
+    lowered = _tiny_lowered()
+    c1 = ExeCache(str(tmp_path / 'exe'))
+    comp_cold, hit = c1.load_or_compile(lowered, name='tiny')
+    assert not hit and c1.misses == 1 and c1.bytes_written > 0
+    # a separate ExeCache instance (a second process, in effect) hits
+    c2 = ExeCache(str(tmp_path / 'exe'))
+    comp_warm, hit = c2.load_or_compile(lowered, name='tiny')
+    assert hit and c2.hits == 1 and c2.fallbacks == 0
+    x = np.arange(64, dtype=np.float32).reshape(8, 8)
+    a, b = np.asarray(comp_cold(x)), np.asarray(comp_warm(x))
+    assert a.tobytes() == b.tobytes()        # bit parity, not allclose
+    # provenance sidecar records the entry and the hit
+    s = scan_cache(str(tmp_path))
+    assert s['n_entries'] == 1 and s['hits'] == 1 and s['n_fallbacks'] == 0
+    (entry,) = s['entries']
+    assert entry['name'] == 'tiny' and entry['bytes'] > 0
+    assert entry['jax'] and entry['platform']
+
+
+def test_exe_cache_different_program_and_pins_miss(tmp_path):
+    cache = ExeCache(str(tmp_path / 'exe'))
+    lowered = _tiny_lowered()
+    cache.load_or_compile(lowered, name='a', pins={'s2d_stem': False})
+    # same program, flipped pin -> distinct entry (no stale alias)
+    _, hit = cache.load_or_compile(lowered, name='a',
+                                   pins={'s2d_stem': True})
+    assert not hit
+    # different program -> distinct entry
+    _, hit = cache.load_or_compile(_tiny_lowered(scale=3.0), name='b')
+    assert not hit
+    assert scan_cache(str(tmp_path))['n_entries'] == 3
+
+
+def test_corrupt_artifact_clean_fallback(tmp_path):
+    lowered = _tiny_lowered()
+    cache = ExeCache(str(tmp_path / 'exe'))
+    cache.load_or_compile(lowered, name='tiny')
+    # truncate every stored artifact to garbage
+    for fn in os.listdir(tmp_path / 'exe'):
+        if fn.endswith('.exe'):
+            with open(tmp_path / 'exe' / fn, 'wb') as f:
+                f.write(b'not a pickled executable')
+    fresh = ExeCache(str(tmp_path / 'exe'))
+    with pytest.warns(UserWarning, match='falling back to a fresh'):
+        compiled, hit = fresh.load_or_compile(lowered, name='tiny')
+    assert not hit and fresh.fallbacks == 1
+    x = np.arange(64, dtype=np.float32).reshape(8, 8)
+    expect = np.asarray(_tiny_lowered().compile()(x))
+    assert np.asarray(compiled(x)).tobytes() == expect.tobytes()
+    # the fallback is on the record — `segwarm.py stats --check` fails
+    s = scan_cache(str(tmp_path))
+    assert s['n_fallbacks'] == 1
+    assert s['fallbacks'][0]['name'] == 'tiny'
+
+
+# -------------------------------------------------------------- serve engine
+BUCKETS = [(32, 32), (48, 48)]
+
+
+@pytest.fixture(scope='module')
+def serve_cfg(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp('segwarm_serve')
+    return _cfg(tmp, compile_cache=True,
+                compile_cache_dir=str(tmp / 'cache'))
+
+
+@pytest.fixture(scope='module')
+def model_and_vars(serve_cfg):
+    import jax
+    import jax.numpy as jnp
+    from rtseg_tpu.models import get_model
+    model = get_model(serve_cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 32, 32, 3), jnp.float32), False)
+    return model, variables
+
+
+def test_serve_engine_warm_init_bit_parity(serve_cfg, model_and_vars):
+    from rtseg_tpu.serve import ServeEngine
+    _, variables = model_and_vars
+    cold = ServeEngine.from_config(serve_cfg, BUCKETS, 2,
+                                   variables=variables, name='cold_eng')
+    assert cold.stats()['cache_hits'] == 0
+    warm = ServeEngine.from_config(serve_cfg, BUCKETS, 2,
+                                   variables=variables, name='warm_eng')
+    # zero fresh XLA compiles on the cached path: every bucket deserialized
+    assert warm.stats()['cache_hits'] == len(BUCKETS)
+    assert warm.stats()['executables'] == len(BUCKETS)
+    x = np.random.RandomState(0).rand(2, 32, 32, 3).astype(np.float32)
+    for b in BUCKETS:
+        xb = np.zeros((2, b[0], b[1], 3), np.float32)
+        xb[:, :32, :32] = x
+        a, c = cold.run(b, xb), warm.run(b, xb)
+        assert a.tobytes() == c.tobytes()
+    # the sealed-table guard stays armed over a deserialized table
+    assert warm.stats()['retraces'] == 0
+
+
+def test_serve_engine_concurrent_init_matches_sequential(serve_cfg,
+                                                         model_and_vars):
+    from rtseg_tpu.serve import ServeEngine
+    _, variables = model_and_vars
+    seq = ServeEngine.from_config(serve_cfg, BUCKETS, 2,
+                                  variables=variables, name='seq_eng')
+    par_cfg = serve_cfg.replace(compile_workers=4)
+    par = ServeEngine.from_config(par_cfg, BUCKETS, 2,
+                                  variables=variables, name='par_eng')
+    assert par.stats()['executables'] == len(BUCKETS)
+    x = np.random.RandomState(1).rand(2, 32, 32, 3).astype(np.float32)
+    assert (par.run((32, 32), x).tobytes()
+            == seq.run((32, 32), x).tobytes())
+
+
+def test_serve_engine_different_weights_miss(serve_cfg):
+    """The inference fn bakes the weights as program constants, so two
+    weight sets can never alias one cache entry (the stale-hit hazard)."""
+    import jax
+    import jax.numpy as jnp
+    from rtseg_tpu.models import get_model
+    from rtseg_tpu.serve import ServeEngine
+    model = get_model(serve_cfg)
+    v2 = model.init(jax.random.PRNGKey(42),
+                    jnp.zeros((1, 32, 32, 3), jnp.float32), False)
+    eng = ServeEngine.from_config(serve_cfg, [(32, 32)], 2, variables=v2,
+                                  name='other_weights')
+    assert eng.stats()['cache_hits'] == 0
+
+
+# ---------------------------------------------------------------- warm step
+def _train_setup(mesh_devices=1, crop=32, bs=2):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+    from rtseg_tpu.models import get_model
+    from rtseg_tpu.parallel.mesh import DATA_AXIS
+    from rtseg_tpu.train.optim import get_optimizer
+    from rtseg_tpu.train.state import create_train_state
+    from rtseg_tpu.train.step import build_train_step
+    cfg = _cfg('/tmp/rtseg_segwarm_step', train_bs=bs, crop_size=crop,
+               use_ema=True)
+    cfg.resolve_schedule(train_num=bs * 8)
+    model = get_model(cfg)
+    opt = get_optimizer(cfg)
+    mesh = Mesh(np.array(jax.devices()[:mesh_devices]), (DATA_AXIS,))
+    state = create_train_state(model, opt, jax.random.PRNGKey(0),
+                               jnp.zeros((1, crop, crop, 3), jnp.float32))
+    rng = np.random.RandomState(0)
+    imgs = jax.device_put(rng.rand(bs, crop, crop, 3).astype(np.float32))
+    msks = jax.device_put(rng.randint(0, 5, (bs, crop, crop))
+                          .astype(np.int32))
+    step = build_train_step(cfg, model, opt, mesh)
+    return cfg, step, state, imgs, msks
+
+
+def _run2(step, state, imgs, msks):
+    import jax
+    s1, m1 = step(state, imgs, msks)
+    s2, m2 = step(s1, imgs, msks)
+    return (float(jax.device_get(m1['loss'])),
+            float(jax.device_get(m2['loss'])),
+            np.asarray(jax.tree.leaves(jax.device_get(s2.params))[0]))
+
+
+def test_warm_step_train_bit_parity_and_introspection(tmp_path):
+    import jax
+    from rtseg_tpu.analysis.recompile import guard_step, introspectable
+    cfg, step, state, imgs, msks = _train_setup()
+    # donation: each caller needs its own state replica
+    snap = jax.tree.map(lambda x: np.asarray(x), jax.device_get(state))
+
+    def fresh_state():
+        return jax.tree.map(jax.numpy.asarray, snap)
+
+    # baseline trajectory from the unwrapped (plain jit) step
+    ref = _run2(step, fresh_state(), imgs, msks)
+
+    cache = ExeCache(str(tmp_path / 'exe'))
+    warm1 = warm_step(step, cache, 'train_step')
+    assert warm1._cache_size() == 0
+    cold = _run2(warm1, fresh_state(), imgs, msks)
+    assert warm1._cache_size() == 1 and cache.misses == 1
+    assert cold[0] == ref[0] and cold[1] == ref[1]
+    assert cold[2].tobytes() == ref[2].tobytes()
+
+    # second "process": new cache instance, same dir -> deserialize hit,
+    # bit-identical trajectory; composes under the recompile guard
+    cache2 = ExeCache(str(tmp_path / 'exe'))
+    warm2 = guard_step(warm_step(step, cache2, 'train_step'), 'train_step')
+    hot = _run2(warm2, fresh_state(), imgs, msks)
+    assert cache2.hits == 1 and cache2.misses == 0
+    assert hot[0] == ref[0] and hot[2].tobytes() == ref[2].tobytes()
+    # introspection: the wrapper (not the never-called jit object) is the
+    # compile-activity source for the guard and the step collector
+    assert introspectable(warm2) is warm2
+    assert warm2._cache_size() == 1
+
+
+# ----------------------------------------------------------- async ckpt
+def test_async_ckpt_writer_orders_and_raises():
+    from rtseg_tpu.train.checkpoint import AsyncCkptWriter
+    w = AsyncCkptWriter()
+    order = []
+    w.submit(lambda: (time.sleep(0.05), order.append('first')))
+    # second submit joins the first: ordering is preserved
+    w.submit(lambda: order.append('second'))
+    w.join()
+    assert order == ['first', 'second']
+
+    def boom():
+        raise OSError('disk full')
+
+    w.submit(boom)
+    with pytest.raises(RuntimeError, match='checkpoint write failed'):
+        w.join()
+    w.join()                                  # error consumed, not sticky
+
+
+def test_snapshot_state_survives_donation(tmp_path):
+    """The writer thread reads the snapshot copy, so deleting the source
+    buffers (what step donation does) cannot corrupt the write."""
+    import jax
+    import jax.numpy as jnp
+    from rtseg_tpu.train.checkpoint import (AsyncCkptWriter, load_meta,
+                                            restore_weights,
+                                            save_best_ckpt, snapshot_state)
+    from rtseg_tpu.train.state import TrainState
+    leaf = jnp.arange(16.0).reshape(4, 4)
+    state = TrainState(step=jnp.zeros((), jnp.int32),
+                       params={'w': leaf}, batch_stats={},
+                       opt_state={}, ema_params={'w': leaf * 2},
+                       ema_batch_stats={})
+    snap = snapshot_state(state)
+    path = str(tmp_path / 'best.ckpt')
+    w = AsyncCkptWriter()
+    w.submit(lambda: save_best_ckpt(path, snap, 1, 0.5))
+    # simulate the next step's donation while the write is in flight
+    state.params['w'].delete()
+    state.ema_params['w'].delete()
+    w.join()
+    assert load_meta(path)['best_score'] == 0.5
+    p, _ = restore_weights(path, {'w': np.zeros((4, 4), np.float32)}, {})
+    assert np.asarray(p['w']).tobytes() == np.asarray(
+        np.arange(16.0, dtype=np.float32).reshape(4, 4) * 2).tobytes()
+
+
+# ------------------------------------------------------------ segscope keys
+def test_report_compile_events_and_diff(tmp_path):
+    from rtseg_tpu.obs.report import diff_table, load_events, summarize
+    ev = [
+        {'event': 'run_start', 'ts': 0.0, 'host': 0},
+        {'event': 'compile', 'name': 'train_step', 'dur_s': 10.0,
+         'cache_hit': False, 'ts': 1.0, 'host': 0},
+        {'event': 'compile', 'name': 'eval_step', 'dur_s': 0.05,
+         'cache_hit': True, 'ts': 2.0, 'host': 0},
+        {'event': 'step', 'kind': 'train', 'dur_s': 0.1,
+         'data_wait_s': 0.0, 'imgs': 4, 'ts': 3.0, 'host': 0},
+        {'event': 'run_end', 'wall_s': 5.0, 'ts': 4.0, 'host': 0},
+    ]
+    p = tmp_path / 'events-000.jsonl'
+    p.write_text('\n'.join(json.dumps(e) for e in ev) + '\n')
+    s = summarize(load_events(str(tmp_path)))
+    assert s['startup_compiles'] == 2
+    assert s['startup_cache_hits'] == 1
+    assert s['startup_cold_s'] == 10.0 and s['startup_warm_s'] == 0.05
+    assert s['startup_compile_s'] == 10.05
+    from rtseg_tpu.obs.report import format_summary
+    assert 'startup compile' in format_summary(s)
+    # warm run B: all hits -> the diff row shows the improvement
+    s2 = dict(s, startup_compile_s=0.1, startup_cold_s=0.0,
+              startup_warm_s=0.1, startup_cache_hits=2)
+    table = diff_table(s, s2)
+    assert 'startup compile (s)' in table
+    # and a warm->cold regression is flagged
+    assert 'REGRESSED' in diff_table(s2, s)
+
+
+# ------------------------------------------------------------------ trainer
+@pytest.fixture(scope='module')
+def warm_trainer_runs(tmp_path_factory):
+    """One cold + one warm tiny synthetic training run sharing a segwarm
+    cache dir (each its own save_dir), with checkpointing on — the
+    trainer-level acceptance fixture several tests read."""
+    import jax
+    from rtseg_tpu.train import SegTrainer
+    tmp = tmp_path_factory.mktemp('segwarm_trainer')
+    prior = {k: getattr(jax.config, k) for k in
+             ('jax_compilation_cache_dir',
+              'jax_persistent_cache_min_entry_size_bytes',
+              'jax_persistent_cache_min_compile_time_secs')}
+    runs = {}
+    for tag in ('cold', 'warm'):
+        cfg = SegConfig(dataset='synthetic', model='fastscnn', num_class=5,
+                        crop_size=32, train_bs=4, val_bs=4, total_epoch=1,
+                        val_interval=1, compute_dtype='float32',
+                        use_tb=False, use_ema=True, base_workers=0,
+                        log_interval=0, load_ckpt=False, save_ckpt=True,
+                        synthetic_len=64, compile_cache=True,
+                        compile_cache_dir=str(tmp / 'cache'),
+                        save_dir=str(tmp / tag))
+        cfg.resolve()
+        trainer = SegTrainer(cfg)
+        score = trainer.run()
+        events = [json.loads(line) for line in
+                  open(os.path.join(cfg.obs_dir, 'events-000.jsonl'))]
+        runs[tag] = {'cfg': cfg, 'losses': list(trainer.epoch_losses),
+                     'score': score, 'events': events,
+                     'exe_stats': trainer._exe_cache.stats()}
+    # the persistent compilation cache is process-global jax config —
+    # restore it so the rest of the suite compiles untouched
+    for k, v in prior.items():
+        jax.config.update(k, v)
+    return runs
+
+
+def test_trainer_warm_start_zero_fresh_compiles(warm_trainer_runs):
+    cold, warm = warm_trainer_runs['cold'], warm_trainer_runs['warm']
+    cc = [e for e in cold['events'] if e.get('event') == 'compile']
+    wc = [e for e in warm['events'] if e.get('event') == 'compile']
+    assert cc and all(not e['cache_hit'] for e in cc)
+    # the acceptance pin: second startup compiles NOTHING fresh
+    assert wc and all(e['cache_hit'] for e in wc)
+    assert {e['name'] for e in wc} == {'train_step', 'eval_step'}
+    warm_s = sum(e['dur_s'] for e in wc)
+    cold_s = sum(e['dur_s'] for e in cc)
+    assert warm_s < cold_s
+    assert warm['exe_stats']['hits'] == 2
+    assert warm['exe_stats']['fallbacks'] == 0
+
+
+def test_trainer_warm_start_identical_results(warm_trainer_runs):
+    cold, warm = warm_trainer_runs['cold'], warm_trainer_runs['warm']
+    assert cold['losses'] == warm['losses']
+    assert cold['score'] == warm['score']
+
+
+def test_trainer_async_ckpt_spans_and_file(warm_trainer_runs):
+    """save_ckpt enqueues (ckpt/save) and the writer thread flushes
+    (ckpt/flush); the written checkpoint is complete and restorable."""
+    from rtseg_tpu.train.checkpoint import load_meta
+    run = warm_trainer_runs['cold']
+    spans = [e for e in run['events'] if e.get('event') == 'span']
+    saves = [e for e in spans if e.get('name') == 'ckpt/save']
+    flushes = [e for e in spans if e.get('name') == 'ckpt/flush']
+    assert saves and flushes
+    meta = load_meta(os.path.join(run['cfg'].save_dir, 'last.ckpt'))
+    assert meta and meta['kind'] == 'train' and meta['cur_epoch'] == 1
+
+
+def test_segscope_report_shows_warm_run(warm_trainer_runs):
+    from rtseg_tpu.obs.report import summarize
+    s = summarize(warm_trainer_runs['warm']['events'])
+    assert s['startup_compiles'] == 2
+    assert s['startup_cache_hits'] == 2
+    assert s['startup_cold_s'] == 0.0
+
+
+# ---------------------------------------------------------------------- CLI
+@pytest.fixture()
+def _restore_jax_cache_config():
+    """cli warm calls enable_compile_cache (process-global jax config);
+    snapshot + restore so the rest of the suite compiles untouched."""
+    import jax
+    keys = ('jax_compilation_cache_dir',
+            'jax_persistent_cache_min_entry_size_bytes',
+            'jax_persistent_cache_min_compile_time_secs')
+    prior = {k: getattr(jax.config, k) for k in keys}
+    yield
+    for k, v in prior.items():
+        jax.config.update(k, v)
+
+
+def test_segwarm_cli_e2e(tmp_path, capsys, _restore_jax_cache_config):
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), 'tools'))
+    import segwarm as cli
+    cache_dir = str(tmp_path / 'cache')
+    args = ['warm', '--cache-dir', cache_dir, '--models', 'fastscnn',
+            '--num_class', '5', '--compute_dtype', 'float32',
+            '--buckets', '32x32', '--batch', '2']
+    assert cli.main(args) == 0
+    out = capsys.readouterr().out
+    assert '1 bucket executable(s)' in out and '1 compiled + stored' in out
+    # second warm: everything already cached
+    assert cli.main(args) == 0
+    assert '1 already cached' in capsys.readouterr().out
+    assert cli.main(['stats', '--cache-dir', cache_dir, '--json']) == 0
+    s = json.loads(capsys.readouterr().out)
+    assert s['n_entries'] == 1 and s['hits'] == 1 and s['n_fallbacks'] == 0
+    assert cli.main(['stats', '--cache-dir', cache_dir, '--check',
+                     '--min-entries', '1', '--min-hits', '1']) == 0
+    capsys.readouterr()
+    assert cli.main(['clear', '--cache-dir', cache_dir]) == 0
+    assert scan_cache(cache_dir)['n_entries'] == 0
+    assert scan_cache(cache_dir)['xla_entries'] == 0
+
+
+def test_segwarm_stats_check_fails_on_fallback(tmp_path, capsys):
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), 'tools'))
+    import segwarm as cli
+    cache_dir = str(tmp_path / 'cache')
+    cache = ExeCache(os.path.join(cache_dir, 'exe'))
+    lowered = _tiny_lowered()
+    cache.load_or_compile(lowered, name='tiny')
+    for fn in os.listdir(os.path.join(cache_dir, 'exe')):
+        if fn.endswith('.exe'):
+            with open(os.path.join(cache_dir, 'exe', fn), 'wb') as f:
+                f.write(b'garbage')
+    with pytest.warns(UserWarning):
+        ExeCache(os.path.join(cache_dir, 'exe')).load_or_compile(
+            lowered, name='tiny')
+    assert cli.main(['stats', '--cache-dir', cache_dir, '--check']) == 1
+    assert 'fell back' in capsys.readouterr().err
+
+
+# -------------------------------------------------------- persistent cache
+def test_enable_compile_cache_configures_jax(tmp_path):
+    import jax
+    prior = {k: getattr(jax.config, k) for k in
+             ('jax_compilation_cache_dir',
+              'jax_persistent_cache_min_entry_size_bytes',
+              'jax_persistent_cache_min_compile_time_secs')}
+    try:
+        cfg = _cfg(tmp_path, compile_cache=True,
+                   compile_cache_dir=str(tmp_path / 'cache'),
+                   compile_cache_min_entry_bytes=7,
+                   compile_cache_min_compile_secs=0.25)
+        xla_dir = enable_compile_cache(cfg)
+        assert os.path.isdir(xla_dir)
+        assert jax.config.jax_compilation_cache_dir == xla_dir
+        assert jax.config.jax_persistent_cache_min_entry_size_bytes == 7
+        assert jax.config.jax_persistent_cache_min_compile_time_secs == 0.25
+    finally:
+        # the compilation cache is process-global config: restore it so
+        # later tests compile exactly as they would have
+        for k, v in prior.items():
+            jax.config.update(k, v)

@@ -73,20 +73,37 @@ def _add_engine_args(p: argparse.ArgumentParser) -> None:
                    help='per-request queue deadline (drop when exceeded)')
     p.add_argument('--workers', type=int, default=2,
                    help='preprocess / postprocess threads each')
+    p.add_argument('--compile-cache', default=None, metavar='DIR',
+                   help='segwarm cache dir: persist compiled bucket '
+                        'executables (and the XLA compile cache) so the '
+                        'next replica starts without compiling')
+    p.add_argument('--compile-workers', type=int, default=0,
+                   help='bucket-table compile threads (0 = auto)')
 
 
 def _build_config(args) -> SegConfig:
     cfg = SegConfig(dataset='synthetic', model=args.model,
                     num_class=args.num_class, colormap=args.colormap,
                     compute_dtype=args.compute_dtype,
+                    compile_cache=bool(args.compile_cache),
+                    compile_cache_dir=args.compile_cache,
+                    compile_workers=args.compile_workers,
                     save_dir='/tmp/segserve', use_tb=False)
     cfg.resolve(num_devices=1)
+    if cfg.compile_cache:
+        from rtseg_tpu.warm import enable_compile_cache
+        enable_compile_cache(cfg)
     return cfg
 
 
 def _build_engine(args, cfg: SegConfig) -> ServeEngine:
     if args.artifact:
-        return ServeEngine.from_artifact(args.artifact, batch=args.batch)
+        exe_cache = None
+        if cfg.compile_cache:
+            from rtseg_tpu.warm import ExeCache
+            exe_cache = ExeCache.from_config(cfg)
+        return ServeEngine.from_artifact(args.artifact, batch=args.batch,
+                                         exe_cache=exe_cache)
     return ServeEngine.from_config(cfg, parse_buckets(args.buckets),
                                    args.batch, ckpt_path=args.ckpt)
 

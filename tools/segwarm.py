@@ -1,0 +1,322 @@
+#!/usr/bin/env python
+"""segwarm — compile-cache management CLI (rtseg_tpu/warm/).
+
+Prebuild, inspect, and clear the persistent compile caches that give
+trainer launches and ServeEngine inits zero-compile warm starts.
+
+Usage:
+    # pre-bake serve executables for a deploy (run on the target topology;
+    # pass the SAME --ckpt the replicas will serve — the executable embeds
+    # the weights, so a random-init prebake only warms load-gen engines)
+    python tools/segwarm.py warm --cache-dir /ssd/segwarm \
+        --models fastscnn,bisenetv2 --buckets 512x1024,256x512 --batch 8 \
+        --ckpt save/best.ckpt
+
+    # pre-bake the compiled train+eval steps for a config (or a zoo subset)
+    python tools/segwarm.py warm --cache-dir /ssd/segwarm --train \
+        --models fastscnn --train-bs 16 --crop 512
+    python tools/segwarm.py warm --cache-dir /ssd/segwarm --train \
+        --config save/run1/config.json
+
+    # hits, misses, bytes, per-entry provenance, recorded fallbacks
+    python tools/segwarm.py stats --cache-dir /ssd/segwarm [--json]
+    # CI gate: exit 1 if any load error silently degraded to a compile
+    python tools/segwarm.py stats --cache-dir /ssd/segwarm --check
+
+    python tools/segwarm.py clear --cache-dir /ssd/segwarm
+
+Caveats a prebake must respect (all are safe-by-key — a mismatch is a
+cache miss, never a stale hit): executables bind the jax/jaxlib versions,
+backend, and device topology of the machine that baked them; train-step
+entries additionally bind the config's trace-relevant fields (batch/crop
+geometry, loss heads, EMA, dtype). Configs using the segpipe raw uint8
+tail (device_norm) train through a different step signature than this
+tool bakes — their first real run warms the cache instead.
+
+Exit codes: 0 ok, 1 --check failed, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from rtseg_tpu.warm import (ExeCache, clear_cache,          # noqa: E402
+                            enable_compile_cache, scan_cache)
+
+
+def _mib(n: int) -> str:
+    return f'{n / 2**20:.1f} MiB'
+
+
+def _build_cfg(args, model: str):
+    from rtseg_tpu.config import SegConfig
+    cfg = SegConfig(dataset='synthetic', model=model,
+                    num_class=args.num_class,
+                    compute_dtype=args.compute_dtype,
+                    compile_cache=True, compile_cache_dir=args.cache_dir,
+                    compile_workers=args.compile_workers,
+                    save_dir='/tmp/segwarm_cli', use_tb=False)
+    cfg.resolve(num_devices=1)
+    return cfg
+
+
+def _warm_serve(args) -> int:
+    """One ServeEngine.from_config per model: the engine builds its own
+    ExeCache from the config's compile_cache_dir and its bucket table
+    compiles (or deserializes) straight through it."""
+    from rtseg_tpu.serve import ServeEngine, parse_buckets
+    buckets = parse_buckets(args.buckets)
+    n_built = 0
+    for model in args.model_list:
+        t0 = time.perf_counter()
+        engine = ServeEngine.from_config(_build_cfg(args, model), buckets,
+                                         args.batch, ckpt_path=args.ckpt,
+                                         name=f'warm:{model}')
+        st = engine.stats()
+        print(f'  {model}: {st["executables"]} bucket executable(s) in '
+              f'{time.perf_counter() - t0:.2f} s '
+              f'({st["cache_hits"]} already cached)', flush=True)
+        n_built += st['executables']
+    return n_built
+
+
+def _warm_train(args, cache: ExeCache) -> int:
+    """AOT-lower the compiled train and eval steps exactly as SegTrainer's
+    first call would — same mesh, same replicated/batch shardings, same
+    pins — and push them through the exe cache without executing a step."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from rtseg_tpu.config import SegConfig
+    from rtseg_tpu.models import get_model
+    from rtseg_tpu.models.registry import AUX_MODELS, DETAIL_HEAD_MODELS
+    from rtseg_tpu.parallel import (batch_sharding, make_global_array,
+                                    make_mesh, replicated)
+    from rtseg_tpu.train.optim import get_optimizer
+    from rtseg_tpu.train.state import create_train_state
+    from rtseg_tpu.train.step import build_eval_step, build_train_step
+    from rtseg_tpu.warm.prime import step_pins
+
+    configs = []
+    if args.config:
+        with open(args.config) as f:
+            cfg = SegConfig.from_dict(json.load(f))
+        cfg.compile_cache, cfg.compile_cache_dir = True, args.cache_dir
+        cfg.resolve()
+        if cfg.device_norm_resolved or cfg.device_norm:
+            # baking anyway would store f32-signature steps the real
+            # (uint8 raw-tail) run can never hit — dead entries and a
+            # false "prepaid" success; skip and say so
+            print('segwarm: skipping this config — it uses the segpipe '
+                  'raw uint8 tail (device_norm), whose step signature '
+                  'this tool does not bake; let the first real run warm '
+                  'the cache instead', flush=True)
+            return 0
+        configs.append(cfg)
+    else:
+        for model in args.model_list:
+            cfg = _build_cfg(args, model)
+            cfg.train_bs, cfg.val_bs = args.train_bs, args.train_bs
+            cfg.crop_size = cfg.crop_h = cfg.crop_w = args.crop
+            cfg.use_aux = model in AUX_MODELS
+            cfg.use_detail_head = model in DETAIL_HEAD_MODELS
+            cfg.total_epoch = args.total_epoch
+            if args.train_num:
+                cfg.train_num = args.train_num
+            configs.append(cfg)
+
+    mesh = make_mesh(spatial_partition=configs[0].spatial_partition)
+    n_dev = int(mesh.devices.size)
+    n_built = 0
+    for cfg in configs:
+        cfg.resolve(num_devices=n_dev)
+        # the LR schedule (and the EMA ramp) bake total_itrs into the
+        # train-step program, so the baked schedule must reproduce the
+        # target run's: a saved config carries its resolved train_num;
+        # zoo mode takes --train-num/--total-epoch (a mismatch is a safe
+        # cache miss, not a stale hit)
+        cfg.resolve_schedule(train_num=cfg.train_num
+                             or cfg.train_bs * n_dev)
+        t0 = time.perf_counter()
+        model = get_model(cfg)
+        optimizer = get_optimizer(cfg)
+        state = jax.device_put(
+            create_train_state(model, optimizer, jax.random.PRNGKey(
+                cfg.random_seed),
+                jnp.zeros((1, cfg.crop_h, cfg.crop_w, 3), jnp.float32)),
+            replicated(mesh))
+        bsh = batch_sharding(mesh)
+
+        def batch(per_dev_bs):
+            gb = per_dev_bs * n_dev
+            return (make_global_array(
+                np.zeros((gb, cfg.crop_h, cfg.crop_w, 3), np.float32),
+                bsh),
+                make_global_array(
+                np.zeros((gb, cfg.crop_h, cfg.crop_w), np.int32), bsh))
+
+        imgs, msks = batch(cfg.train_bs)
+        vimgs, vmsks = ((imgs, msks) if cfg.val_bs == cfg.train_bs
+                        else batch(cfg.val_bs))
+        train_step = build_train_step(cfg, model, optimizer, mesh)
+        eval_step = build_eval_step(cfg, model, mesh)
+        hits = 0
+        for step, name, a in ((train_step, 'train_step',
+                               (state, imgs, msks)),
+                              (eval_step, 'eval_step',
+                               (state, vimgs, vmsks))):
+            step.pin()
+            _, hit = cache.load_or_compile(step.jitted.lower(*a),
+                                           name=name,
+                                           pins=step_pins(step))
+            hits += int(hit)
+            n_built += 1
+        print(f'  {cfg.model}: train+eval steps '
+              f'(bs{cfg.train_bs}x{n_dev}, {cfg.crop_h}x{cfg.crop_w}) in '
+              f'{time.perf_counter() - t0:.2f} s ({hits} already cached)',
+              flush=True)
+    return n_built
+
+
+def cmd_warm(args) -> int:
+    args.model_list = [m.strip() for m in args.models.split(',')
+                       if m.strip()]
+    if not args.model_list and not args.config:
+        print('segwarm: warm needs --models or --config', file=sys.stderr)
+        return 2
+    if args.config:
+        # a saved config always means the train/eval steps — without this,
+        # --config alone would fall into serve mode's empty model loop and
+        # "succeed" having baked nothing
+        args.train = True
+    enable_compile_cache(cache_dir=args.cache_dir)
+    before = scan_cache(args.cache_dir)
+    t0 = time.perf_counter()
+    if args.train:
+        n = _warm_train(args, ExeCache.at(args.cache_dir))
+    else:
+        n = _warm_serve(args)
+    # deltas from the on-disk provenance: serve mode compiles through the
+    # engine's own cache instance, so in-process counters would undercount
+    after = scan_cache(args.cache_dir)
+    print(f'segwarm: {n} executable(s) warm under {args.cache_dir} in '
+          f'{time.perf_counter() - t0:.2f} s — '
+          f'{after["n_entries"] - before["n_entries"]} compiled + stored '
+          f'({_mib(after["bytes"] - before["bytes"])}), '
+          f'{after["hits"] - before["hits"]} already cached, '
+          f'{after["n_fallbacks"] - before["n_fallbacks"]} fallback(s)',
+          flush=True)
+    return 0
+
+
+def cmd_stats(args) -> int:
+    s = scan_cache(args.cache_dir)
+    if args.json:
+        print(json.dumps(s, indent=2, default=str))
+    else:
+        print(f'segwarm stats — {s["cache_dir"]}')
+        print(f'  exe entries : {s["n_entries"]} | {_mib(s["bytes"])} | '
+              f'{s["hits"]} recorded hit(s)')
+        print(f'  xla entries : {s["xla_entries"]} | '
+              f'{_mib(s["xla_bytes"])} (persistent XLA cache)')
+        print(f'  fallbacks   : {s["n_fallbacks"]}')
+        for e in s['entries']:
+            print(f'    {e.get("name", "?"):<24} key={e.get("key", "?")[:12]}'
+                  f'… {_mib(int(e.get("bytes", 0)))} compile '
+                  f'{e.get("compile_s", 0.0):.2f}s hits '
+                  f'{e.get("hits", 0)} (jax {e.get("jax", "?")}, '
+                  f'{e.get("platform", "?")} x{e.get("n_devices", "?")})')
+        for fb in s['fallbacks']:
+            print(f'    FALLBACK {fb.get("name", "?")} '
+                  f'key={fb.get("key", "?")[:12]}… {fb.get("error", "")}')
+    if args.check:
+        problems = []
+        if s['n_fallbacks']:
+            problems.append(f'{s["n_fallbacks"]} cached executable(s) '
+                            f'failed to load and fell back to a fresh '
+                            f'compile (see fallbacks above)')
+        if args.min_entries and s['n_entries'] < args.min_entries:
+            problems.append(f'{s["n_entries"]} entries < --min-entries '
+                            f'{args.min_entries}')
+        if args.min_hits and s['hits'] < args.min_hits:
+            problems.append(f'{s["hits"]} recorded hits < --min-hits '
+                            f'{args.min_hits}')
+        if problems:
+            print('segwarm check FAILED: ' + '; '.join(problems),
+                  file=sys.stderr)
+            return 1
+        print(f'segwarm check OK: {s["n_entries"]} entries, {s["hits"]} '
+              f'hits, 0 fallbacks')
+    return 0
+
+
+def cmd_clear(args) -> int:
+    n = clear_cache(args.cache_dir)
+    print(f'segwarm: removed {n} cached file(s) under {args.cache_dir}')
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog='segwarm', description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = ap.add_subparsers(dest='cmd', required=True)
+
+    wp = sub.add_parser('warm', help='prebuild compile caches')
+    wp.add_argument('--cache-dir', required=True)
+    wp.add_argument('--models', default='',
+                    help='comma-separated zoo subset')
+    wp.add_argument('--num_class', type=int, default=19)
+    wp.add_argument('--compute_dtype', default=None)
+    wp.add_argument('--compile-workers', type=int, default=0)
+    wp.add_argument('--buckets', default='512x1024',
+                    help='serve mode: HxW bucket list')
+    wp.add_argument('--batch', type=int, default=8,
+                    help='serve mode: per-executable batch')
+    wp.add_argument('--ckpt', default=None,
+                    help='serve mode: checkpoint the replicas will serve')
+    wp.add_argument('--train', action='store_true',
+                    help='bake compiled train+eval steps instead of serve '
+                         'buckets')
+    wp.add_argument('--config', default=None,
+                    help='--train: a saved config.json to bake exactly')
+    wp.add_argument('--train-bs', type=int, default=16,
+                    help='--train zoo mode: per-device batch')
+    wp.add_argument('--crop', type=int, default=512,
+                    help='--train zoo mode: crop size')
+    wp.add_argument('--total-epoch', type=int, default=200,
+                    help='--train zoo mode: schedule epochs (baked into '
+                         'the train-step LR schedule — must match the '
+                         'target run)')
+    wp.add_argument('--train-num', type=int, default=0,
+                    help='--train zoo mode: dataset length for the '
+                         'schedule (0 = one global batch)')
+
+    st = sub.add_parser('stats', help='cache contents and provenance')
+    st.add_argument('--cache-dir', required=True)
+    st.add_argument('--json', action='store_true')
+    st.add_argument('--check', action='store_true',
+                    help='exit 1 on any recorded fallback (plus optional '
+                         '--min-entries/--min-hits floors)')
+    st.add_argument('--min-entries', type=int, default=0)
+    st.add_argument('--min-hits', type=int, default=0)
+
+    cp = sub.add_parser('clear', help='delete all cached artifacts')
+    cp.add_argument('--cache-dir', required=True)
+
+    args = ap.parse_args(argv)
+    if args.cmd == 'warm':
+        return cmd_warm(args)
+    if args.cmd == 'stats':
+        return cmd_stats(args)
+    return cmd_clear(args)
+
+
+if __name__ == '__main__':
+    sys.exit(main())
