@@ -10,38 +10,83 @@ the bench harness — never in train/step.py or ops/ kernels.
 
 Scope and reachability are shared with trace-purity (lint_trace.py): the
 rule walks every function reachable from a jit entry point under the same
-TARGET_PREFIXES and flags calls that resolve to the rtseg_tpu.obs module —
-through a module alias (`from rtseg_tpu import obs`, `import
-rtseg_tpu.obs as obs`), a member import (`from ..obs import span`), or a
-fully qualified `rtseg_tpu.obs.*` path.
+TARGET_PREFIXES and flags calls that resolve to the rtseg_tpu.obs module
+or any of its submodules — the live-metrics registry (obs/metrics.py) and
+trace-id minting (obs/tracing.py) included, since a counter bumped or a
+trace id minted inside traced code would fire once at trace time and
+never again. Bindings covered: a module alias (`from rtseg_tpu import
+obs`, `import rtseg_tpu.obs as obs`, `import rtseg_tpu.obs.metrics as m`,
+`from rtseg_tpu.obs import metrics`, `from ..obs import tracing`), a
+member import (`from ..obs import span`, `from ..obs.metrics import
+MetricsRegistry`), or a fully qualified `rtseg_tpu.obs.*` path.
 """
 
 from __future__ import annotations
 
 import ast
+import os
 from typing import Dict, List, Set, Tuple
 
 from .core import Finding, RULE_OBS, SourceFile
 from .lint_trace import _dotted, jit_reachable, target_files
 
+def _obs_submodules() -> frozenset:
+    """rtseg_tpu/obs submodule names, derived from the package directory
+    so a future obs module is covered without editing this list.
+    `from rtseg_tpu.obs import metrics` binds a *module* (calls through
+    it are obs calls), not a plain member."""
+    obs_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        'obs')
+    try:
+        names = frozenset(f[:-3] for f in os.listdir(obs_dir)
+                          if f.endswith('.py') and f != '__init__.py')
+        if names:
+            return names
+    except OSError:
+        pass
+    # fallback (lint run from an environment without the source tree)
+    return frozenset({'core', 'collector', 'watchdog', 'report',
+                      'metrics', 'tracing', 'live'})
+
+
+_OBS_SUBMODULES = _obs_submodules()
+
+
+def _is_obs_module(mod: str, level: int) -> bool:
+    """True when an ImportFrom module path names rtseg_tpu.obs or one of
+    its submodules (absolute or relative spelling)."""
+    parts = mod.split('.') if mod else []
+    if level == 0:
+        return (len(parts) >= 2 and parts[0] == 'rtseg_tpu'
+                and parts[1] == 'obs'
+                and all(p in _OBS_SUBMODULES for p in parts[2:3]))
+    # relative: from ..obs import X / from ..obs.metrics import X
+    return bool(parts) and 'obs' in parts
+
 
 def _obs_bindings(sf: SourceFile) -> Tuple[Set[str], Set[str]]:
-    """(module aliases bound to rtseg_tpu.obs, member names imported from
-    it) for one file."""
+    """(module aliases bound to rtseg_tpu.obs or a submodule, member
+    names imported from them) for one file."""
     aliases: Set[str] = set()
     members: Set[str] = set()
     for node in ast.walk(sf.tree):
         if isinstance(node, ast.Import):
             for a in node.names:
-                if a.name == 'rtseg_tpu.obs' and a.asname:
+                if a.asname and (a.name == 'rtseg_tpu.obs'
+                                 or a.name.startswith('rtseg_tpu.obs.')):
                     aliases.add(a.asname)
         elif isinstance(node, ast.ImportFrom):
             mod = node.module or ''
-            is_obs = (mod == 'rtseg_tpu.obs'
-                      or (node.level > 0
-                          and (mod == 'obs' or mod.endswith('.obs'))))
-            if is_obs:
-                members |= {a.asname or a.name for a in node.names}
+            if _is_obs_module(mod, node.level):
+                is_pkg = (mod == 'rtseg_tpu.obs' or mod == 'obs'
+                          or mod.endswith('.obs'))
+                for a in node.names:
+                    if is_pkg and a.name in _OBS_SUBMODULES:
+                        # submodule import: calls go through its name
+                        aliases.add(a.asname or a.name)
+                    else:
+                        members.add(a.asname or a.name)
             elif mod == 'rtseg_tpu' or (node.level > 0 and not mod):
                 for a in node.names:
                     if a.name == 'obs':

@@ -20,9 +20,16 @@ from .core import (EventSink, emit_memory, get_sink, init_run, set_sink,
 from .collector import StepCollector
 from .watchdog import StallWatchdog, dump_all_stacks
 from .report import (diff_table, format_summary, load_events, summarize)
+from .metrics import (MetricsRegistry, get_registry, render_prometheus,
+                      set_registry)
+from .tracing import (TRACE_HEADER, TRACE_KEY, ensure_trace, new_trace_id,
+                      valid_trace_id)
 
 __all__ = [
     'EventSink', 'emit_memory', 'get_sink', 'init_run', 'set_sink', 'span',
     'StepCollector', 'StallWatchdog', 'dump_all_stacks',
     'diff_table', 'format_summary', 'load_events', 'summarize',
+    'MetricsRegistry', 'get_registry', 'set_registry', 'render_prometheus',
+    'TRACE_HEADER', 'TRACE_KEY', 'ensure_trace', 'new_trace_id',
+    'valid_trace_id',
 ]

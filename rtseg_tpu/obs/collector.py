@@ -19,6 +19,13 @@ step durations so the stall deadline adapts to the workload.
 ``interval_stats`` serves the trainer's progress line (imgs/sec and
 data-wait fraction since the previous log point) from pure host timing —
 it never reads a device value, so the progress line stays sync-free.
+
+With a ``registry`` (obs/metrics.py), every ``end_step`` also feeds the
+live metrics plane: a step-duration histogram (non-compile steps only,
+matching the report's percentile definition), steps/images/compile-step
+counters and data-wait/goodput gauges — all labeled by loop kind — so
+step time, data wait and goodput are queryable *mid-run* instead of only
+from the closed JSONL after the fact.
 """
 
 from __future__ import annotations
@@ -28,12 +35,14 @@ from typing import Any, Iterable, Iterator, Optional, Tuple
 
 from ..analysis.recompile import _cache_size
 from .core import EventSink
+from .metrics import MetricsRegistry
 
 
 class StepCollector:
     def __init__(self, sink: Optional[EventSink], kind: str,
                  imgs_per_step: int, jitted: Any = None,
-                 watchdog: Any = None, epoch: Optional[int] = None):
+                 watchdog: Any = None, epoch: Optional[int] = None,
+                 registry: Optional[MetricsRegistry] = None):
         self.sink = sink
         self.kind = kind
         self.imgs_per_step = int(imgs_per_step)
@@ -54,6 +63,30 @@ class StepCollector:
         self._int_t0 = time.perf_counter()
         self._int_wait = 0.0
         self._int_imgs = 0
+        # live metrics plane (None -> sink-only, zero extra work)
+        self.registry = registry
+        self._t_created = time.perf_counter()
+        if registry is not None:
+            self._h_step = registry.histogram(
+                'train_step_ms',
+                help='non-compile step duration (ms)', kind=kind)
+            self._c_steps = registry.counter(
+                'train_steps_total', help='loop iterations', kind=kind)
+            self._c_compile = registry.counter(
+                'train_compile_steps_total',
+                help='steps whose jit cache grew (trace+XLA compile)',
+                kind=kind)
+            self._c_imgs = registry.counter(
+                'train_imgs_total', help='images consumed', kind=kind)
+            self._g_wait = registry.gauge(
+                'train_data_wait_frac',
+                help='fraction of loop wall blocked on the loader',
+                kind=kind)
+            self._g_goodput = registry.gauge(
+                'train_goodput',
+                help='productive non-compile step time / loop wall so '
+                     'far (live approximation of the report goodput)',
+                kind=kind)
 
     @property
     def n_steps(self) -> int:
@@ -99,6 +132,19 @@ class StepCollector:
         self.total_wait += self._data_wait
         self._int_wait += self._data_wait
         self._int_imgs += self.imgs_per_step
+        if self.registry is not None:
+            self._c_steps.inc()
+            self._c_imgs.inc(self.imgs_per_step)
+            if compiled:
+                self._c_compile.inc()
+            else:
+                self._h_step.observe(dur * 1e3)
+            wall = now - self._t_created
+            if wall > 0:
+                busy = self.total_dur + self.total_wait
+                self._g_wait.set(self.total_wait / busy if busy else 0.0)
+                self._g_goodput.set(
+                    (self.total_dur - self.compile_s) / wall)
         if self.watchdog is not None:
             # compile steps don't feed the adaptive deadline: one multi-
             # second XLA compile would slacken it by watchdog_factor x

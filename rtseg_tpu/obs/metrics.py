@@ -1,0 +1,371 @@
+"""segtrace metrics: a thread-safe in-process registry of live metrics.
+
+Where the JSONL event sink (core.py) is the *post-hoc* record — closed at
+run end, re-parsed by ``tools/segscope.py report`` — this registry is the
+*live* plane: monotonic counters, gauges and fixed-bucket histograms that
+a router, autoscaler or the ``GET /metrics`` endpoint can read at any
+moment while the run is still going. The serving front-end exposes it as
+Prometheus text (``render_prometheus``), ``/stats`` and the in-process
+``stats()`` methods read the very same objects, so HTTP-visible and
+in-process numbers can never disagree.
+
+Hot-path contract: ``Counter.inc`` / ``Gauge.set`` / ``Histogram.observe``
+allocate nothing per call — a lock, an integer add, and (for histograms)
+a ``bisect`` into precomputed bounds plus a write into a preallocated
+ring slot. Percentiles are computed lazily at *read* time from a sliding
+window of the last ``window`` observations (ring buffer), so online
+p50/p95/p99 cost nothing until somebody scrapes.
+
+Consistency contract: each metric guards its state with one lock, and
+snapshots copy under that lock — a scraper can never observe a histogram
+whose ``count`` differs from the sum of its bucket counts (no torn
+reads), and counter totals are exact under any number of writer threads.
+
+Everything here is host-side by design (locks, wall clocks at read time);
+the ``obs-purity`` lint (analysis/lint_obs.py) keeps registry calls out
+of jit-reachable code. This module is pure stdlib — no jax, no numpy
+(the obs *package* still pulls numpy via report.py, the same stdlib+numpy
+bar tools/segscope.py has always had).
+"""
+
+from __future__ import annotations
+
+import threading
+from bisect import bisect_left
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+#: default millisecond-scale histogram bounds (serving latencies, step
+#: times in ms). Last implicit bucket is +Inf.
+DEFAULT_MS_BOUNDS: Tuple[float, ...] = (
+    1.0, 2.0, 5.0, 10.0, 20.0, 50.0, 100.0, 200.0, 500.0,
+    1000.0, 2000.0, 5000.0, 10000.0, 30000.0)
+
+#: quantiles rendered for every histogram's sliding window
+WINDOW_QUANTILES: Tuple[float, ...] = (0.5, 0.95, 0.99)
+
+LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _label_key(labels: Dict[str, str]) -> LabelKey:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+def _label_str(key: LabelKey) -> str:
+    if not key:
+        return ''
+    return '{' + ','.join(f'{k}="{v}"' for k, v in key) + '}'
+
+
+class Counter:
+    """Monotonic counter. ``inc`` is exact under concurrent writers."""
+
+    __slots__ = ('name', 'labels', '_lock', '_v')
+
+    def __init__(self, name: str, labels: LabelKey = ()):
+        self.name = name
+        self.labels = labels
+        self._lock = threading.Lock()
+        self._v = 0
+
+    def inc(self, n: int = 1) -> None:
+        with self._lock:
+            self._v += n
+
+    @property
+    def value(self) -> int:
+        with self._lock:
+            return self._v
+
+
+class Gauge:
+    """Last-write-wins instantaneous value."""
+
+    __slots__ = ('name', 'labels', '_lock', '_v')
+
+    def __init__(self, name: str, labels: LabelKey = ()):
+        self.name = name
+        self.labels = labels
+        self._lock = threading.Lock()
+        self._v = 0.0
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._v = float(v)
+
+    def add(self, v: float) -> None:
+        with self._lock:
+            self._v += float(v)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._v
+
+
+class Histogram:
+    """Fixed-bucket histogram + ring window for online percentiles.
+
+    ``observe`` increments exactly one bucket and the total count under
+    the metric lock, so ``count == sum(bucket_counts)`` holds for every
+    snapshot a concurrent reader can take. The ring window (preallocated,
+    no per-observation allocation) keeps the last ``window`` raw values;
+    ``quantile`` sorts a copy at read time.
+    """
+
+    __slots__ = ('name', 'labels', 'bounds', '_lock', '_counts', '_sum',
+                 '_count', '_ring', '_rpos', '_rfill')
+
+    def __init__(self, name: str, labels: LabelKey = (),
+                 bounds: Tuple[float, ...] = DEFAULT_MS_BOUNDS,
+                 window: int = 2048):
+        self.name = name
+        self.labels = labels
+        self.bounds = tuple(sorted(float(b) for b in bounds))
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(self.bounds) + 1)   # last = +Inf
+        self._sum = 0.0
+        self._count = 0
+        self._ring = [0.0] * max(int(window), 1)
+        self._rpos = 0
+        self._rfill = 0
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        # bisect_left: Prometheus `le` is an inclusive upper bound, so an
+        # observation equal to a bound belongs to that bound's bucket
+        i = bisect_left(self.bounds, v)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+            self._ring[self._rpos] = v
+            self._rpos = (self._rpos + 1) % len(self._ring)
+            if self._rfill < len(self._ring):
+                self._rfill += 1
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Consistent copy: count always equals sum(bucket counts)."""
+        with self._lock:
+            window = (self._ring[:self._rfill]
+                      if self._rfill < len(self._ring) else list(self._ring))
+            return {'bounds': self.bounds,
+                    'counts': list(self._counts),
+                    'sum': self._sum, 'count': self._count,
+                    'window': window}
+
+    def quantiles(self, qs: Iterable[float] = WINDOW_QUANTILES
+                  ) -> Dict[float, Optional[float]]:
+        """Sliding-window percentiles (nearest-rank on a sorted copy)."""
+        with self._lock:
+            vals = sorted(self._ring[:self._rfill]
+                          if self._rfill < len(self._ring)
+                          else self._ring)
+        out: Dict[float, Optional[float]] = {}
+        for q in qs:
+            if not vals:
+                out[q] = None
+            else:
+                idx = min(len(vals) - 1, max(0, round(q * (len(vals) - 1))))
+                out[q] = vals[idx]
+        return out
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+
+class _Null:
+    """Shared no-op metric for a disabled registry: every write is a
+    branchless pass, every read is zero/None."""
+
+    name = 'null'
+    labels: LabelKey = ()
+    bounds: Tuple[float, ...] = ()
+    value = 0
+    count = 0
+
+    def inc(self, n: int = 1) -> None:
+        pass
+
+    def set(self, v: float) -> None:
+        pass
+
+    def add(self, v: float) -> None:
+        pass
+
+    def observe(self, v: float) -> None:
+        pass
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {'bounds': (), 'counts': [], 'sum': 0.0, 'count': 0,
+                'window': []}
+
+    def quantiles(self, qs: Iterable[float] = WINDOW_QUANTILES
+                  ) -> Dict[float, Optional[float]]:
+        return {q: None for q in qs}
+
+
+_NULL = _Null()
+
+
+class MetricsRegistry:
+    """Named families of counters/gauges/histograms.
+
+    ``counter``/``gauge``/``histogram`` are get-or-create: the same
+    (name, labels) always returns the same object, so independent call
+    sites accumulate into one metric. Callers on hot paths hold the
+    returned handle — the registry lock is only taken at creation and at
+    scrape time. Construct with ``enabled=False`` for a registry whose
+    metrics are shared no-ops (the metrics-off side of the overhead A/B,
+    BENCHMARKS.md "Live metrics overhead methodology").
+    """
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        self._metrics: Dict[Tuple[str, LabelKey], Any] = {}
+        self._types: Dict[str, str] = {}      # family name -> kind
+        self._help: Dict[str, str] = {}
+
+    def _get(self, kind: str, name: str, labels: Dict[str, str],
+             factory) -> Any:
+        if not self.enabled:
+            return _NULL
+        key = (name, _label_key(labels))
+        with self._lock:
+            m = self._metrics.get(key)
+            if m is None:
+                prev = self._types.get(name)
+                if prev is not None and prev != kind:
+                    raise ValueError(
+                        f'metric {name!r} already registered as {prev}, '
+                        f'cannot re-register as {kind}')
+                self._types[name] = kind
+                m = factory(name, key[1])
+                self._metrics[key] = m
+            return m
+
+    def counter(self, name: str, help: str = '',
+                **labels: str) -> Counter:
+        if help and self.enabled:
+            self._help.setdefault(name, help)
+        return self._get('counter', name, labels, Counter)
+
+    def gauge(self, name: str, help: str = '', **labels: str) -> Gauge:
+        if help and self.enabled:
+            self._help.setdefault(name, help)
+        return self._get('gauge', name, labels, Gauge)
+
+    def histogram(self, name: str, help: str = '',
+                  bounds: Tuple[float, ...] = DEFAULT_MS_BOUNDS,
+                  window: int = 2048, **labels: str) -> Histogram:
+        if help and self.enabled:
+            self._help.setdefault(name, help)
+        return self._get(
+            'histogram', name, labels,
+            lambda n, lk: Histogram(n, lk, bounds=bounds, window=window))
+
+    # ------------------------------------------------------------- scraping
+    def collect(self) -> List[Any]:
+        with self._lock:
+            return list(self._metrics.values())
+
+    def kind(self, name: str) -> Optional[str]:
+        with self._lock:
+            return self._types.get(name)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-able view: counters/gauges flat, histograms with bucket
+        counts plus window quantiles (the `/stats` shape)."""
+        out: Dict[str, Any] = {}
+        for m in self.collect():
+            key = m.name + _label_str(m.labels)
+            if isinstance(m, Histogram):
+                snap = m.snapshot()
+                qs = m.quantiles()
+                out[key] = {
+                    'count': snap['count'],
+                    'sum': round(snap['sum'], 3),
+                    'p50': qs.get(0.5), 'p95': qs.get(0.95),
+                    'p99': qs.get(0.99),
+                }
+            else:
+                out[key] = m.value
+        return out
+
+
+def render_prometheus(reg: MetricsRegistry) -> str:
+    """Prometheus text exposition (v0.0.4) of every metric in ``reg``.
+
+    Histograms render the standard cumulative ``_bucket``/``_sum``/
+    ``_count`` series plus a ``<name>_window`` summary carrying the
+    sliding-window p50/p95/p99, so a scraper (or ``segscope live``) gets
+    online percentiles without bucket interpolation.
+    """
+    by_family: Dict[str, List[Any]] = {}
+    for m in reg.collect():
+        by_family.setdefault(m.name, []).append(m)
+    lines: List[str] = []
+    for name in sorted(by_family):
+        fam = by_family[name]
+        kind = reg.kind(name) or 'untyped'
+        help_text = reg._help.get(name, '')
+        if help_text:
+            lines.append(f'# HELP {name} {help_text}')
+        lines.append(f'# TYPE {name} {kind}')
+        if kind == 'histogram':
+            window_lines: List[str] = []
+            for m in fam:
+                snap = m.snapshot()
+                cum = 0
+                for bound, c in zip(snap['bounds'], snap['counts']):
+                    cum += c
+                    lk = dict(m.labels)
+                    lk['le'] = f'{bound:g}'
+                    lines.append(f'{name}_bucket'
+                                 f'{_label_str(_label_key(lk))} {cum}')
+                cum += snap['counts'][-1] if snap['counts'] else 0
+                lk = dict(m.labels)
+                lk['le'] = '+Inf'
+                lines.append(f'{name}_bucket'
+                             f'{_label_str(_label_key(lk))} {cum}')
+                lines.append(f'{name}_sum{_label_str(m.labels)} '
+                             f'{snap["sum"]:g}')
+                lines.append(f'{name}_count{_label_str(m.labels)} '
+                             f'{snap["count"]}')
+                for q, v in m.quantiles().items():
+                    if v is None:
+                        continue
+                    lk = dict(m.labels)
+                    lk['quantile'] = f'{q:g}'
+                    window_lines.append(
+                        f'{name}_window'
+                        f'{_label_str(_label_key(lk))} {v:g}')
+            if window_lines:
+                lines.append(f'# TYPE {name}_window summary')
+                lines.extend(window_lines)
+        else:
+            for m in fam:
+                v = m.value
+                lines.append(f'{name}{_label_str(m.labels)} {v:g}')
+    return '\n'.join(lines) + '\n'
+
+
+# Process-default registry: ambient access for code that has no natural
+# owner to receive one (the trainer and each ServePipeline own their own
+# registry so per-run/per-pipeline totals stay exact; they may *also* be
+# installed here for discovery by in-process consumers).
+_REGISTRY = MetricsRegistry()
+_REG_LOCK = threading.Lock()
+
+
+def get_registry() -> MetricsRegistry:
+    return _REGISTRY
+
+
+def set_registry(reg: MetricsRegistry) -> MetricsRegistry:
+    global _REGISTRY
+    with _REG_LOCK:
+        prev, _REGISTRY = _REGISTRY, reg
+    return prev

@@ -1,0 +1,61 @@
+"""segtrace tracing: end-to-end request trace ids.
+
+A trace id is minted exactly once per request — at HTTP ingress
+(serve/server.py, honoring an inbound ``X-Trace-Id`` so callers can
+propagate their own ids through the fleet) or at load-gen submit
+(serve/loadgen.py) — and then rides the request's ``meta`` dict through
+every stage: preprocess -> batcher queue (``ingress`` event) -> batch
+assembly (``batch`` event, one id per slot) -> dispatch -> readback ->
+postprocess (``request`` event) -> the ``X-Trace-Id`` / ``X-Serve-Timing``
+response headers. One grep over the segscope JSONL sink for a trace id
+yields the request's whole life; the response header hands the same
+handle to the client.
+
+Ids are 16 lowercase hex chars: an 8-hex per-process random prefix (so
+ids from different replicas never collide) plus an 8-hex atomic sequence
+number (``itertools.count`` — its ``next`` is atomic in CPython, so
+minting is thread-safe and allocation-light). No uuid machinery on the
+hot path.
+
+Host-side only; the ``obs-purity`` lint keeps trace minting out of
+jit-reachable code. Pure stdlib.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+from typing import Any, Dict, Optional
+
+#: meta / event / header-JSON key a trace id travels under
+TRACE_KEY = 'trace_id'
+
+#: HTTP header carrying the trace id in both directions
+TRACE_HEADER = 'X-Trace-Id'
+
+_PREFIX = os.urandom(4).hex()
+_SEQ = itertools.count(1)
+
+_HEX = set('0123456789abcdef')
+
+
+def new_trace_id() -> str:
+    """Mint a fresh 16-hex trace id (process prefix + atomic sequence)."""
+    return f'{_PREFIX}{next(_SEQ) & 0xffffffff:08x}'
+
+
+def valid_trace_id(tid: Any) -> bool:
+    """Accept only well-formed ids from the wire (16-64 hex chars), so a
+    hostile or buggy client can't inject arbitrary strings into events."""
+    return (isinstance(tid, str) and 16 <= len(tid) <= 64
+            and set(tid) <= _HEX)
+
+
+def ensure_trace(meta: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """Return ``meta`` (or a new dict) guaranteed to carry a trace id.
+    An existing well-formed id is preserved — minting happens once, at
+    the first ingress point that sees the request."""
+    m = meta if meta is not None else {}
+    if not valid_trace_id(m.get(TRACE_KEY)):
+        m[TRACE_KEY] = new_trace_id()
+    return m

@@ -9,7 +9,8 @@ Layers (each its own module, composable and separately testable):
   * :mod:`pipeline` — ServePipeline: preprocess/postprocess thread pools
     double-buffered against device compute;
   * :mod:`server`   — stdlib ThreadingHTTPServer front-end
-    (POST image -> mask; /healthz, /stats);
+    (POST image -> mask; /healthz, /stats, Prometheus-text /metrics;
+    X-Trace-Id minted/echoed per request);
   * :mod:`loadgen`  — open-loop Poisson load generator + SLO gate
     (tools/segserve.py bench).
 

@@ -16,11 +16,16 @@ Three failure modes of naive serving queues are handled structurally:
     a burst of large-shape traffic cannot starve small-shape requests of
     their latency budget indefinitely.
 
-Every formed batch emits a ``batch`` event and every terminal request
-outcome a ``request`` event into the process-global segscope sink
-(rtseg_tpu/obs), which is how ``tools/segscope.py report`` grows a serving
-section for free. All host-side code — the obs-purity lint keeps it (and
-everything else in serve/) out of jit-reachable paths.
+Every admission emits an ``ingress`` event, every formed batch a
+``batch`` event and every terminal request outcome a ``request`` event
+into the process-global segscope sink (rtseg_tpu/obs) — all three carry
+the request's trace id (obs/tracing.py), minted here when the caller
+didn't already mint one at HTTP ingress / load-gen submit. The admission
+counters live in a segtrace MetricsRegistry (obs/metrics.py) shared with
+the owning pipeline, so ``stats()``, ``/stats`` and ``GET /metrics`` all
+read the same objects and can never disagree. All host-side code — the
+obs-purity lint keeps it (and everything else in serve/) out of
+jit-reachable paths.
 """
 
 from __future__ import annotations
@@ -35,6 +40,8 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..obs import get_sink
+from ..obs.metrics import MetricsRegistry
+from ..obs.tracing import TRACE_KEY, ensure_trace
 from .engine import Bucket, UnknownBucket, select_bucket
 
 
@@ -73,23 +80,50 @@ class MicroBatcher:
 
     def __init__(self, buckets: Sequence[Bucket], max_batch: int,
                  max_wait_ms: float = 5.0, max_queue: int = 128,
-                 deadline_ms: Optional[float] = None):
+                 deadline_ms: Optional[float] = None,
+                 registry: Optional[MetricsRegistry] = None,
+                 trace: bool = True):
         self.buckets = sorted({tuple(b) for b in buckets})
         self.max_batch = int(max_batch)
         self.max_wait_ms = float(max_wait_ms)
         self.max_queue = int(max_queue)
         self.deadline_ms = deadline_ms
+        self.trace = trace
         self._queues: Dict[Bucket, deque] = {b: deque()
                                              for b in self.buckets}
         self._cond = threading.Condition()
         self._closed = False
-        # counters (all under the condition's lock)
-        self.submitted = 0
-        self.rejected = 0
-        self.dropped = 0
-        self.batches = 0
-        self.batched_requests = 0
-        self.padded_slots = 0
+        # registry-backed counters: one source of truth for stats(),
+        # /stats and /metrics. A private registry per batcher unless the
+        # owning pipeline shares its own.
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
+        reg = self.registry
+        self._c_submitted = reg.counter(
+            'serve_admitted_total',
+            help='requests admitted into the queue (resolve later as a '
+                 'terminal serve_requests_total status)')
+        self._c_rejected = reg.counter(
+            'serve_requests_total',
+            help='terminal request outcomes by status', status='rejected')
+        self._c_dropped = reg.counter('serve_requests_total',
+                                      status='dropped')
+        self._c_error = reg.counter('serve_requests_total',
+                                    status='error')
+        self._c_batches = reg.counter(
+            'serve_batches_total', help='coalesced batches dispatched')
+        self._c_batched = reg.counter(
+            'serve_batched_requests_total',
+            help='requests that occupied a real batch slot')
+        self._c_padded = reg.counter(
+            'serve_padded_slots_total',
+            help='batch slots shipped as padding (1 - occupancy)')
+        self._g_depth = reg.gauge(
+            'serve_queue_depth', help='requests currently queued across '
+            'all buckets')
+        self._h_queue = reg.histogram(
+            'serve_stage_ms', help='per-stage request latency (ms)',
+            stage='queue')
 
     # ------------------------------------------------------------ producer
     def submit(self, image: np.ndarray,
@@ -106,28 +140,40 @@ class MicroBatcher:
                 + ','.join(_bucket_str(b) for b in self.buckets))
         now = time.perf_counter()
         dl_ms = self.deadline_ms if deadline_ms is None else deadline_ms
+        m = dict(meta or {})
+        if self.trace:
+            # trace id: minted here unless HTTP ingress / the load-gen
+            # already did — one id per request, whatever the entry point
+            ensure_trace(m)
         req = Request(
             image=image, hw=(h, w), bucket=bucket, future=Future(),
             t_submit=now,
             deadline=(now + dl_ms / 1e3) if dl_ms is not None else None,
-            meta=dict(meta or {}))
+            meta=m)
         with self._cond:
             if self._closed:
                 raise ServeReject('batcher is closed')
             depth = sum(len(q) for q in self._queues.values())
-            if depth >= self.max_queue:
-                self.rejected += 1
-            else:
-                depth = -1
-                self.submitted += 1
+            if depth < self.max_queue:
                 self._queues[bucket].append(req)
+                # gauge write stays INSIDE the lock: it is order-
+                # sensitive (a stale post-lock write would overwrite the
+                # consumer's pop) and lock-cheap, unlike the event I/O
+                self._g_depth.set(depth + 1)
                 self._cond.notify_all()
-        # event emission (file write + flush) stays off the lock: every
-        # admitting thread would otherwise serialize on disk latency
-        if depth >= 0:
+        # counter updates + event emission (file write + flush) stay off
+        # the condition lock: every admitting thread would otherwise
+        # serialize on disk latency
+        if depth >= self.max_queue:
+            self._c_rejected.inc()
             self._emit_request(req, 'rejected', now)
             raise ServeReject(
                 f'queue full ({depth}/{self.max_queue}); retry later')
+        self._c_submitted.inc()
+        if self.trace:
+            # the ingress event exists to anchor the trace timeline; with
+            # tracing off there is no id to anchor, so no event either
+            self._emit_ingress(req)
         return req.future
 
     # ------------------------------------------------------------ consumer
@@ -144,6 +190,8 @@ class MicroBatcher:
         while True:
             dropped, batch, done = self._poll_locked(overall)
             now = time.perf_counter()
+            if dropped:
+                self._c_dropped.inc(len(dropped))
             for r in dropped:
                 self._emit_request(r, 'dropped', now)
                 r.future.set_exception(ServeDrop(
@@ -151,6 +199,11 @@ class MicroBatcher:
                     f'{(now - r.t_submit) * 1e3:.1f} ms in queue'))
             if batch is not None:
                 bucket, reqs, head_age_ms = batch
+                self._c_batches.inc()
+                self._c_batched.inc(len(reqs))
+                self._c_padded.inc(self.max_batch - len(reqs))
+                for r in reqs:
+                    self._h_queue.observe((r.t_popped - r.t_submit) * 1e3)
                 self._emit_batch(bucket, reqs, head_age_ms)
                 return bucket, reqs
             if done:
@@ -167,7 +220,9 @@ class MicroBatcher:
                 while q and q[0].deadline is not None \
                         and now > q[0].deadline:
                     dropped.append(q.popleft())
-            self.dropped += len(dropped)
+            if dropped:
+                self._g_depth.set(sum(len(q)
+                                      for q in self._queues.values()))
             bucket = self._oldest_bucket_locked()
             if bucket is None:
                 if dropped:
@@ -187,9 +242,8 @@ class MicroBatcher:
                         for _ in range(min(self.max_batch, len(q)))]
                 for r in reqs:
                     r.t_popped = now
-                self.batches += 1
-                self.batched_requests += len(reqs)
-                self.padded_slots += self.max_batch - len(reqs)
+                self._g_depth.set(sum(len(qq)
+                                      for qq in self._queues.values()))
                 return dropped, (bucket, reqs, head_age_ms), False
             # sleep until the head ages out, a notify, or the timeout
             wait_s = (self.max_wait_ms - head_age_ms) / 1e3
@@ -205,12 +259,18 @@ class MicroBatcher:
             self._cond.notify_all()
 
     def fail_all(self, exc: BaseException) -> None:
-        """Resolve every queued request with ``exc`` (engine teardown)."""
+        """Resolve every queued request with ``exc`` (engine teardown).
+        The requests reach their terminal ``error`` status in the
+        registry, so admitted-vs-terminal accounting stays exact even
+        through a teardown."""
         with self._cond:
             pending = [r for q in self._queues.values() for r in q]
             for q in self._queues.values():
                 q.clear()
+            self._g_depth.set(0)
             self._cond.notify_all()
+        if pending:
+            self._c_error.inc(len(pending))
         for r in pending:
             r.future.set_exception(exc)
 
@@ -222,32 +282,76 @@ class MicroBatcher:
                 best, best_t = b, q[0].t_submit
         return best
 
+    def _emit_ingress(self, req: Request) -> None:
+        sink = get_sink()
+        if sink is not None:
+            ev = {'event': 'ingress', 'bucket': _bucket_str(req.bucket)}
+            if TRACE_KEY in req.meta:
+                ev[TRACE_KEY] = req.meta[TRACE_KEY]
+            sink.emit(ev)
+
     def _emit_request(self, req: Request, status: str, now: float) -> None:
         sink = get_sink()
         if sink is not None:
-            sink.emit({'event': 'request', 'status': status,
-                       'bucket': _bucket_str(req.bucket),
-                       'queue_ms': round((now - req.t_submit) * 1e3, 3)})
+            ev = {'event': 'request', 'status': status,
+                  'bucket': _bucket_str(req.bucket),
+                  'queue_ms': round((now - req.t_submit) * 1e3, 3)}
+            if TRACE_KEY in req.meta:
+                ev[TRACE_KEY] = req.meta[TRACE_KEY]
+            sink.emit(ev)
 
     def _emit_batch(self, bucket: Bucket, reqs: List[Request],
                     head_age_ms: float) -> None:
         sink = get_sink()
         if sink is not None:
-            sink.emit({'event': 'batch', 'bucket': _bucket_str(bucket),
-                       'size': len(reqs), 'cap': self.max_batch,
-                       'wait_ms': round(head_age_ms, 3)})
+            ev = {'event': 'batch', 'bucket': _bucket_str(bucket),
+                  'size': len(reqs), 'cap': self.max_batch,
+                  'wait_ms': round(head_age_ms, 3)}
+            traces = [r.meta[TRACE_KEY] for r in reqs
+                      if TRACE_KEY in r.meta]
+            if traces:
+                ev['traces'] = traces
+            sink.emit(ev)
+
+    # registry-backed counters exposed under their historical names, so
+    # stats() callers and the in-process API read the exact objects
+    # /metrics renders
+    @property
+    def submitted(self) -> int:
+        return self._c_submitted.value
+
+    @property
+    def rejected(self) -> int:
+        return self._c_rejected.value
+
+    @property
+    def dropped(self) -> int:
+        return self._c_dropped.value
+
+    @property
+    def batches(self) -> int:
+        return self._c_batches.value
+
+    @property
+    def batched_requests(self) -> int:
+        return self._c_batched.value
+
+    @property
+    def padded_slots(self) -> int:
+        return self._c_padded.value
 
     def stats(self) -> dict:
         with self._cond:
-            return {
-                'submitted': self.submitted,
-                'rejected': self.rejected,
-                'dropped': self.dropped,
-                'batches': self.batches,
-                'batched_requests': self.batched_requests,
-                'padded_slots': self.padded_slots,
-                'depth': sum(len(q) for q in self._queues.values()),
-                'max_queue': self.max_queue,
-                'max_batch': self.max_batch,
-                'max_wait_ms': self.max_wait_ms,
-            }
+            depth = sum(len(q) for q in self._queues.values())
+        return {
+            'submitted': self.submitted,
+            'rejected': self.rejected,
+            'dropped': self.dropped,
+            'batches': self.batches,
+            'batched_requests': self.batched_requests,
+            'padded_slots': self.padded_slots,
+            'depth': depth,
+            'max_queue': self.max_queue,
+            'max_batch': self.max_batch,
+            'max_wait_ms': self.max_wait_ms,
+        }

@@ -23,6 +23,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..obs.tracing import TRACE_HEADER, TRACE_KEY, new_trace_id
 from .batcher import ServeDrop, ServeReject
 from .engine import Bucket, ServeEngine, assemble_batch, select_bucket
 from .pipeline import ServePipeline
@@ -100,8 +101,12 @@ def bench_pipeline(pipeline: ServePipeline, images: Sequence[np.ndarray],
     for i in range(requests):
         _sleep_until(t0 + arrivals[i])
         try:
-            futures.append(pipeline.submit(images[int(order[i])],
-                                           deadline_ms=deadline_ms))
+            # load-gen submit is this mode's ingress: mint the trace id
+            # here so the in-process path exercises the same end-to-end
+            # propagation the HTTP path gets from X-Trace-Id
+            futures.append(pipeline.submit(
+                images[int(order[i])], deadline_ms=deadline_ms,
+                meta={TRACE_KEY: new_trace_id()}))
         except ServeReject:
             rejected += 1
             futures.append(None)
@@ -148,7 +153,9 @@ def bench_http(url: str, payloads: Sequence[bytes], requests: int,
 
     def one(i: int, t_sched: float) -> dict:
         body = payloads[int(order[i])]
-        req = urlreq.Request(url, data=body, method='POST')
+        tid = new_trace_id()
+        req = urlreq.Request(url, data=body, method='POST',
+                             headers={TRACE_HEADER: tid})
         try:
             with urlreq.urlopen(req, timeout=timeout_s) as resp:
                 resp.read()
@@ -161,11 +168,14 @@ def bench_http(url: str, payloads: Sequence[bytes], requests: int,
                 # the client)
                 return {'status': 'ok',
                         'e2e_ms': (time.perf_counter() - t_sched) * 1e3,
-                        'timing': timing}
+                        'timing': timing,
+                        'trace_ok': (resp.headers.get(TRACE_HEADER) == tid
+                                     and timing.get(TRACE_KEY) == tid)}
         except error.HTTPError as e:
             e.read()
             return {'status': {503: 'rejected', 504: 'dropped'}.get(
-                e.code, 'error')}
+                e.code, 'error'),
+                'trace_ok': e.headers.get(TRACE_HEADER) == tid}
         except Exception:   # noqa: BLE001 — connection-level failure
             return {'status': 'error'}
 
@@ -188,7 +198,11 @@ def bench_http(url: str, payloads: Sequence[bytes], requests: int,
     counts = {s: sum(1 for r in results if r['status'] == s)
               for s in ('ok', 'dropped', 'rejected', 'error')}
     report = {'mode': 'http', 'url': url, 'requests': requests,
-              'rps_target': rps}
+              'rps_target': rps,
+              # every response must echo the trace id the client minted
+              # (in X-Trace-Id; for 200s also inside X-Serve-Timing)
+              'trace_mismatch': sum(
+                  1 for r in results if r.get('trace_ok') is False)}
     return _finalize(report, e2e, stages, counts['ok'], counts['dropped'],
                      counts['rejected'], counts['error'], wall)
 
@@ -223,6 +237,9 @@ def check_report(report: dict, p95_ms: float,
                         f"(want 0)")
     if report.get('errors', 0):
         problems.append(f"{report['errors']} request errors (want 0)")
+    if report.get('trace_mismatch', 0):
+        problems.append(f"{report['trace_mismatch']} responses did not "
+                        f"echo the client trace id (want 0)")
     if report.get('ok', 0) != report.get('requests', 0):
         problems.append(f"only {report.get('ok', 0)}/"
                         f"{report.get('requests', 0)} requests completed")

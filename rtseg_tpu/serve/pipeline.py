@@ -18,8 +18,13 @@ Four stages, each its own thread(s), with the device stage double-buffered:
     PNG encode for the HTTP front-end).
 
 Per-request timing is decomposed into queue / assemble / device / post and
-emitted as one ``request`` event; ``tools/segscope.py report`` renders the
-serving section from these plus the batcher's ``batch`` events.
+emitted as one ``request`` event (carrying the request's trace id);
+``tools/segscope.py report`` renders the serving section from these plus
+the batcher's ``batch`` events. The same timings feed the pipeline's live
+MetricsRegistry (obs/metrics.py) — ok/error counters and per-stage
+latency histograms — which the HTTP front-end exposes as ``GET /metrics``
+and ``stats()``/``/stats`` read directly, so the live plane and the
+post-hoc JSONL can never disagree about totals.
 """
 
 from __future__ import annotations
@@ -34,6 +39,8 @@ from typing import Any, Callable, Dict, Optional
 import numpy as np
 
 from ..obs import get_sink, span
+from ..obs.metrics import MetricsRegistry
+from ..obs.tracing import TRACE_KEY
 from .batcher import MicroBatcher, Request, _bucket_str
 from .engine import ServeEngine, assemble_batch
 
@@ -59,22 +66,40 @@ class ServePipeline:
                  postprocess: Optional[Callable[[np.ndarray, Request],
                                                 Any]] = None,
                  pre_workers: int = 2, post_workers: int = 2,
-                 inflight: int = 2):
+                 inflight: int = 2,
+                 registry: Optional[MetricsRegistry] = None,
+                 trace: bool = True):
         self.engine = engine
         self.preprocess = preprocess
         self.postprocess = postprocess
+        # one registry per pipeline (unless the caller shares one): the
+        # batcher's admission counters and the per-stage histograms below
+        # land in the same object, which is what GET /metrics renders
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
+        reg = self.registry
+        self._c_ok = reg.counter('serve_requests_total', status='ok')
+        self._c_error = reg.counter('serve_requests_total',
+                                    status='error')
+        self._h_e2e = reg.histogram(
+            'serve_request_e2e_ms',
+            help='end-to-end request latency, ingress to response (ms)')
+        self._h_stage = {
+            stage: reg.histogram('serve_stage_ms', stage=stage)
+            for stage in ('assemble', 'device', 'post', 'decode')}
+        self._g_inflight = reg.gauge(
+            'serve_inflight_batches',
+            help='batches dispatched to device, not yet read back')
         self.batcher = MicroBatcher(engine.buckets, engine.batch,
                                     max_wait_ms=max_wait_ms,
                                     max_queue=max_queue,
-                                    deadline_ms=deadline_ms)
+                                    deadline_ms=deadline_ms,
+                                    registry=reg, trace=trace)
         self._pre = ThreadPoolExecutor(max_workers=max(1, pre_workers),
                                        thread_name_prefix='segserve-pre')
         self._post = ThreadPoolExecutor(max_workers=max(1, post_workers),
                                         thread_name_prefix='segserve-post')
         self._inflight: queue.Queue = queue.Queue(maxsize=max(1, inflight))
-        self._lock = threading.Lock()
-        self._ok = 0
-        self._errors = 0
         self._closing = False
         self._closed = False
         self.error: Optional[BaseException] = None
@@ -150,12 +175,17 @@ class ServePipeline:
                 t_d1 = time.perf_counter()
             except BaseException as e:   # noqa: BLE001 — engine is dead
                 self.error = e
+                # every admitted request must reach a terminal
+                # serve_requests_total status — this batch errors here,
+                # the still-queued ones inside fail_all
+                self._c_error.inc(len(reqs))
                 for r in reqs:
                     r.future.set_exception(e)
                 self.batcher.close()
                 self.batcher.fail_all(e)
                 break
             self._inflight.put((bucket, reqs, t_d0, t_d1, dev))
+            self._g_inflight.set(self._inflight.qsize())
         self._inflight.put(_DONE)
 
     def _readback_loop(self) -> None:
@@ -163,6 +193,7 @@ class ServePipeline:
             item = self._inflight.get()
             if item is _DONE:
                 break
+            self._g_inflight.set(self._inflight.qsize())
             bucket, reqs, t_d0, t_d1, dev = item
             try:
                 with span('serve/readback', record=False):
@@ -172,8 +203,7 @@ class ServePipeline:
                 # the first block on the result, i.e. HERE, not at the
                 # dispatch call — resolve this batch's futures instead of
                 # letting the thread die and wedge the whole pipeline
-                with self._lock:
-                    self._errors += len(reqs)
+                self._c_error.inc(len(reqs))
                 for r in reqs:
                     r.future.set_exception(e)
                 continue
@@ -191,8 +221,7 @@ class ServePipeline:
                 with span('serve/post', record=False):
                     payload = self.postprocess(mask, r)
         except BaseException as e:   # noqa: BLE001 — per-request failure
-            with self._lock:
-                self._errors += 1
+            self._c_error.inc()
             r.future.set_exception(e)
             return
         t_end = time.perf_counter()
@@ -206,13 +235,20 @@ class ServePipeline:
         }
         if 'decode_ms' in r.meta:
             timings['decode_ms'] = r.meta['decode_ms']
-        with self._lock:
-            self._ok += 1
+        self._c_ok.inc()
+        self._h_e2e.observe(timings['e2e_ms'])
+        for stage, h in self._h_stage.items():
+            key = stage + '_ms'
+            if key in timings:
+                h.observe(timings[key])
         sink = get_sink()
         if sink is not None:
-            sink.emit({'event': 'request', 'status': 'ok',
-                       'bucket': _bucket_str(r.bucket),
-                       **{k: round(v, 3) for k, v in timings.items()}})
+            ev = {'event': 'request', 'status': 'ok',
+                  'bucket': _bucket_str(r.bucket),
+                  **{k: round(v, 3) for k, v in timings.items()}}
+            if TRACE_KEY in r.meta:
+                ev[TRACE_KEY] = r.meta[TRACE_KEY]
+            sink.emit(ev)
         r.future.set_result(ServeResult(mask=mask, timings=timings,
                                         meta=r.meta))
 
@@ -237,11 +273,16 @@ class ServePipeline:
         self.close()
 
     def stats(self) -> dict:
-        with self._lock:
-            ok, errors = self._ok, self._errors
+        """Live counters, read straight from the metrics registry — the
+        same objects ``GET /metrics`` renders, so the JSON and Prometheus
+        views of this pipeline cannot disagree."""
+        qs = self._h_e2e.quantiles()
         return {
-            'ok': ok,
-            'errors': errors,
+            'ok': self._c_ok.value,
+            'errors': self._c_error.value,
+            'request_ms': {'count': self._h_e2e.count,
+                           'p50': qs.get(0.5), 'p95': qs.get(0.95),
+                           'p99': qs.get(0.99)},
             'batcher': self.batcher.stats(),
             'engine': self.engine.stats(),
             'inflight': self._inflight.qsize(),

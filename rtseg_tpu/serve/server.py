@@ -9,11 +9,21 @@ API:
   * ``POST /predict`` (or ``/``) — body is an encoded image (anything PIL
     decodes). Response 200 is the colormapped PNG mask (``?raw=1``: the
     int8 class-id array as bytes + ``X-Mask-Shape``). The per-stage
-    latency decomposition rides in the ``X-Serve-Timing`` header as JSON.
-    503 = admission rejected (queue full: back off), 504 = deadline
-    dropped, 413 = no bucket fits the decoded image.
+    latency decomposition rides in the ``X-Serve-Timing`` header as JSON
+    (trace id included). 503 = admission rejected (queue full: back off),
+    504 = deadline dropped, 413 = no bucket fits the decoded image.
   * ``GET /healthz`` — liveness (200 once the engine is compiled).
-  * ``GET /stats`` — engine/batcher/pipeline counters as JSON.
+  * ``GET /stats`` — live JSON straight off the pipeline's metrics
+    registry (counters + online request percentiles + engine state).
+  * ``GET /metrics`` — the same registry as Prometheus text exposition
+    (counters, gauges, histograms with sliding-window p50/p95/p99).
+
+Tracing: every request gets a trace id at ingress — an inbound
+``X-Trace-Id`` header is honored (well-formed hex only) so upstream
+callers can stitch their own traces through, otherwise one is minted
+here. The id rides the request through every pipeline stage and segscope
+event and comes back in the ``X-Trace-Id`` response header on every
+response, including rejects/drops/errors.
 """
 
 from __future__ import annotations
@@ -27,6 +37,9 @@ from typing import Optional
 
 import numpy as np
 
+from ..obs.metrics import render_prometheus
+from ..obs.tracing import (TRACE_HEADER, TRACE_KEY, new_trace_id,
+                           valid_trace_id)
 from .batcher import ServeDrop, ServeReject
 from .engine import UnknownBucket
 from .pipeline import ServePipeline
@@ -41,7 +54,19 @@ class ServeHTTPServer(ThreadingHTTPServer):
         self.pipeline = pipeline
         self.colormap = colormap
         self.request_timeout_s = request_timeout_s
+        self._http_counters: dict = {}
         super().__init__(addr, _Handler)
+
+    def count_response(self, code: int) -> None:
+        c = self._http_counters.get(code)
+        if c is None:
+            # get-or-create is idempotent: a racing first response for the
+            # same code resolves to the same registry counter
+            c = self.pipeline.registry.counter(
+                'serve_http_responses_total',
+                help='HTTP responses by status code', code=str(code))
+            self._http_counters[code] = c
+        c.inc()
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -53,6 +78,7 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _send(self, code: int, body: bytes, ctype: str,
               extra: Optional[dict] = None) -> None:
+        self.server.count_response(code)
         self.send_response(code)
         self.send_header('Content-Type', ctype)
         self.send_header('Content-Length', str(len(body)))
@@ -61,8 +87,10 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
-    def _send_json(self, code: int, obj) -> None:
-        self._send(code, json.dumps(obj).encode(), 'application/json')
+    def _send_json(self, code: int, obj,
+                   extra: Optional[dict] = None) -> None:
+        self._send(code, json.dumps(obj).encode(), 'application/json',
+                   extra)
 
     def do_GET(self) -> None:   # noqa: N802 — http.server API
         path = self.path.split('?', 1)[0]
@@ -70,6 +98,10 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(200, {'ok': True})
         elif path == '/stats':
             self._send_json(200, self.server.pipeline.stats())
+        elif path == '/metrics':
+            text = render_prometheus(self.server.pipeline.registry)
+            self._send(200, text.encode(),
+                       'text/plain; version=0.0.4; charset=utf-8')
         else:
             self._send_json(404, {'error': f'no route {path}'})
 
@@ -80,34 +112,45 @@ class _Handler(BaseHTTPRequestHandler):
         length = int(self.headers.get('Content-Length', 0))
         data = self.rfile.read(length) if length > 0 else b''
         path = self.path.split('?', 1)[0]
+        # HTTP ingress is where the trace id is born: honor a well-formed
+        # inbound X-Trace-Id (upstream caller stitching its own trace),
+        # mint otherwise. Every response — success or error — echoes it.
+        inbound = self.headers.get(TRACE_HEADER)
+        tid = inbound if valid_trace_id(inbound) else new_trace_id()
+        trace_hdr = {TRACE_HEADER: tid}
         if path not in ('/', '/predict'):
-            self._send_json(404, {'error': f'no route {path}'})
+            self._send_json(404, {'error': f'no route {path}'},
+                            trace_hdr)
             return
         if not data:
-            self._send_json(400, {'error': 'empty body'})
+            self._send_json(400, {'error': 'empty body'}, trace_hdr)
             return
         try:
-            fut = self.server.pipeline.submit_bytes(data)
+            fut = self.server.pipeline.submit_bytes(
+                data, meta={TRACE_KEY: tid})
             res = fut.result(timeout=self.server.request_timeout_s)
         except ServeReject as e:
-            self._send_json(503, {'error': str(e)})
+            self._send_json(503, {'error': str(e)}, trace_hdr)
             return
         except ServeDrop as e:
-            self._send_json(504, {'error': str(e)})
+            self._send_json(504, {'error': str(e)}, trace_hdr)
             return
         except UnknownBucket as e:
-            self._send_json(413, {'error': str(e)})
+            self._send_json(413, {'error': str(e)}, trace_hdr)
             return
         except (TimeoutError, concurrent.futures.TimeoutError):
             # both spellings: futures.TimeoutError only aliases the
             # builtin from Python 3.11
-            self._send_json(504, {'error': 'server-side wait timed out'})
+            self._send_json(504, {'error': 'server-side wait timed out'},
+                            trace_hdr)
             return
         except Exception as e:   # noqa: BLE001 — surface, don't hang
-            self._send_json(500, {'error': f'{type(e).__name__}: {e}'})
+            self._send_json(500, {'error': f'{type(e).__name__}: {e}'},
+                            trace_hdr)
             return
-        timing = json.dumps({k: round(v, 3)
-                             for k, v in res.timings.items()})
+        timing = json.dumps({TRACE_KEY: tid,
+                             **{k: round(v, 3)
+                                for k, v in res.timings.items()}})
         query = urllib.parse.parse_qs(
             urllib.parse.urlsplit(self.path).query)
         if query.get('raw', ['0'])[0] not in ('0', '', 'false'):
@@ -115,18 +158,18 @@ class _Handler(BaseHTTPRequestHandler):
             self._send(200, np.ascontiguousarray(res.mask).tobytes(),
                        'application/octet-stream',
                        {'X-Mask-Shape': f'{h},{w}', 'X-Mask-Dtype': 'int8',
-                        'X-Serve-Timing': timing})
+                        'X-Serve-Timing': timing, **trace_hdr})
             return
         cmap = self.server.colormap
         if cmap is None:
             self._send_json(500, {'error': 'server has no colormap; '
-                                           'use ?raw=1'})
+                                           'use ?raw=1'}, trace_hdr)
             return
         from PIL import Image
         buf = io.BytesIO()
         Image.fromarray(cmap[res.mask]).save(buf, format='PNG')
         self._send(200, buf.getvalue(), 'image/png',
-                   {'X-Serve-Timing': timing})
+                   {'X-Serve-Timing': timing, **trace_hdr})
 
 
 def make_server(pipeline: ServePipeline, host: str = '127.0.0.1',

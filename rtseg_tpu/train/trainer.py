@@ -31,7 +31,8 @@ from ..config import SegConfig
 from ..data import get_loader, get_test_loader
 from ..models import get_model, get_teacher_model
 from .. import obs
-from ..obs import StallWatchdog, StepCollector, emit_memory, span
+from ..obs import (MetricsRegistry, StallWatchdog, StepCollector,
+                   emit_memory, span)
 from ..parallel import (batch_sharding, data_sharding, init_multihost,
                         main_rank, make_global_array, make_mesh, replicated)
 from ..utils import (TBWriter, get_colormap, get_logger, iou_from_cm,
@@ -72,6 +73,13 @@ class SegTrainer:
         self.epoch_losses = []             # mean loss per trained epoch
         self._obs_sink = None              # segscope sink (training only)
         self._watchdog = None              # stall watchdog (run() scope)
+        # live metrics plane (segtrace): the step collectors feed this
+        # registry so step time / data-wait / goodput are queryable
+        # mid-run by any in-process consumer (obs.metrics.get_registry()
+        # hands out the process default; the trainer installs its own so
+        # a fresh trainer starts from zeroed counters)
+        self.metrics = MetricsRegistry()
+        obs.set_registry(self.metrics)
 
         if config.is_testing:
             self.test_set = get_test_loader(config)
@@ -382,7 +390,8 @@ class SegTrainer:
         col = StepCollector(self._obs_sink, 'train',
                             imgs_per_step=cfg.train_bs * cfg.gpu_num,
                             jitted=introspectable(self.train_step),
-                            watchdog=self._watchdog, epoch=self.cur_epoch)
+                            watchdog=self._watchdog, epoch=self.cur_epoch,
+                            registry=self.metrics)
         # event/TB step ids are derived host-side from one sync per epoch
         # (the compiled step advances state.step by exactly 1), so the loop
         # never pays a per-step int(state.step) readback
@@ -485,7 +494,8 @@ class SegTrainer:
         col = StepCollector(self._obs_sink, 'val',
                             imgs_per_step=cfg.val_bs * cfg.gpu_num,
                             jitted=introspectable(self.eval_step),
-                            watchdog=self._watchdog, epoch=self.cur_epoch)
+                            watchdog=self._watchdog, epoch=self.cur_epoch,
+                            registry=self.metrics)
         batches = self._batches(self.val_loader)
         try:
             for imgs, msks in col.wrap(batches):
@@ -637,7 +647,8 @@ class SegTrainer:
         window = max(2 * batch, 8)
         pending = deque()                 # (raw, name, future)
         with ServePipeline(engine, max_wait_ms=1.0,
-                           max_queue=window + batch) as pipe:
+                           max_queue=window + batch,
+                           registry=self.metrics) as pipe:
             for i in range(n):
                 if len(pending) >= window:
                     raw0, name0, fut = pending.popleft()

@@ -74,10 +74,14 @@ def test_export_logits_and_poly_batch(cfg, tmp_path):
                               # branch, ddrnet.py:55-58)
     ('segnet', {'segnet_pack': True}),   # S2D packed layout (round 3)
 ])
+@pytest.mark.slow
 def test_export_hard_op_families(name, flags, tmp_path):
     """jax.export round trip for the op families most at risk under
     StableHLO serialization with a symbolic batch dimension. Small
-    resolutions; logits head; exactness bar same as the fastscnn pin."""
+    resolutions; logits head; exactness bar same as the fastscnn pin.
+
+    slow: six export round trips (~130s total on 1-core CI); the
+    fastscnn argmax round trip above stays tier-1."""
     c = SegConfig(dataset='synthetic', model=name, num_class=7,
                   compute_dtype='float32',
                   save_dir=str(tmp_path / 'cfg'), **flags)

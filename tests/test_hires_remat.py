@@ -39,6 +39,10 @@ def _tree_paths(tree):
             for kp, _ in jax.tree_util.tree_flatten_with_path(tree)[0]]
 
 
+# slow: every param pays a fullres fwd+bwd pair (~20-50s each on 1-core
+# CI); remat equivalence is an optimization-parity sweep, not a
+# correctness smoke — run under -m slow
+@pytest.mark.slow
 @pytest.mark.parametrize('name,kw', [
     ('stdc', {'use_aux': True}),
     ('ddrnet', {'use_aux': True}),

@@ -30,6 +30,7 @@ def test_smp_model_via_registry():
     assert m.apply(v, x, False).shape == (1, 32, 64, 7)
 
 
+@pytest.mark.slow          # teacher+student train-step compile (~45s)
 def test_kd_training_step(mesh8, tmp_path):
     # 1) make a teacher ckpt (random weights are fine for the math)
     teacher = build_smp_model('mobilenet_v2', 'fpn', 6)

@@ -51,6 +51,10 @@ def test_mit_param_parity(arch):
     assert n == want, f'{arch}: {n} != {want}'
 
 
+# slow: six HF-reference forward parities (~100s total on 1-core CI);
+# the eval_shape param parity above keeps every variant's architecture
+# pinned in tier-1
+@pytest.mark.slow
 @pytest.mark.parametrize('arch', sorted(MIT_SETTINGS))
 def test_mit_logit_parity(arch):
     # all six variants (VERDICT round-2 missing #4): b0 headline, b2/b3
@@ -115,6 +119,7 @@ def test_mit_smp_surface():
         assert out.shape == (1, 64, 64, 19), dec
 
 
+@pytest.mark.slow          # b1 train step with drop-path rng (~15s)
 def test_mit_drop_path_trains():
     """Stochastic depth needs only the dropout rng; batch-stats-free model
     trains without mutable collections."""

@@ -330,6 +330,7 @@ def test_segnet_pack_fullres_equivalence():
                                atol=2e-5, rtol=1e-5)
 
 
+@pytest.mark.slow          # fullres fwd+bwd x2 at 1024^2 (~70s on 1-core)
 def test_bisenetv2_detail_remat_equivalence():
     """detail_remat (nn.remat on the DetailBranch, models/bisenetv2.py) is
     math-identical: same param tree, same train-mode outputs (all heads,

@@ -296,9 +296,15 @@ def test_device_flip_norm_bit_parity():
             np.testing.assert_array_equal(xn[j], want_i)
 
 
+@pytest.mark.slow
 def test_train_step_raw_tail_parity(custom_root, tmp_path):
     """One compiled fastscnn step, host-normalized f32 batch vs uint8 +
-    flags batch with the on-device stage: identical loss and weights."""
+    flags batch with the on-device stage: identical loss and weights.
+
+    slow: compiles two real train steps (~30s on a 1-core container);
+    the device-LUT bit-parity stays tier-1 via
+    test_device_flip_norm_bit_parity, and the CI segpipe job runs the
+    full raw-tail trainer on every push."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import Mesh
@@ -489,10 +495,14 @@ def test_benchmark_all_data_mode(tmp_path, monkeypatch, capsys):
     assert all(e['imgs_per_sec'] > 0 for e in data_rows)
 
 
+@pytest.mark.slow
 def test_trainer_segpipe_e2e(custom_root, tmp_path):
     """SegTrainer with the whole pipeline on (cache + mp workers + uint8
     prefetch + on-device normalize): runs, hits the cache 100%, emits h2d
-    spans, and the raw-tail step signature round-trips through train+val."""
+    spans, and the raw-tail step signature round-trips through train+val.
+
+    slow: full trainer e2e; the CI segpipe job runs the same
+    configuration (plus the data-wait gate) on every push."""
     from rtseg_tpu.train import SegTrainer
     from rtseg_tpu.obs.report import load_events, summarize
     cfg = _cfg(custom_root, tmp_path, model='fastscnn', train_bs=1,

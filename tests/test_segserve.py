@@ -371,10 +371,15 @@ def test_report_serving_section_and_diff_regression():
 
 
 # ---------------------------------------------------------- trainer predict
+@pytest.mark.slow
 def test_trainer_predict_via_engine_byte_identical(tmp_path):
     """Folder prediction through the serve batcher writes the exact same
     PNG bytes the one-image-per-step path would: exact-shape buckets plus
-    batch-dim-only padding keep per-image masks bit-identical."""
+    batch-dim-only padding keep per-image masks bit-identical.
+
+    slow: constructs a full SegTrainer; engine/batcher padding
+    determinism stays tier-1 via
+    test_engine_parity_and_batch_padding_determinism."""
     from PIL import Image
     from rtseg_tpu.train import SegTrainer
     from rtseg_tpu.utils import get_colormap

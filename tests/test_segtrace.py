@@ -1,0 +1,522 @@
+"""segtrace (rtseg_tpu/obs/metrics.py, tracing.py, live.py): the live
+metrics registry under concurrency, Prometheus rendering, end-to-end
+trace-id propagation through the serving pipeline and HTTP front-end,
+the /metrics + /stats unification, the `segscope live` CLI in both sink
+and URL modes, and the obs-purity lint's coverage of the new submodules.
+
+All CPU-fast: fastscnn at 32x32, num_class 5, float32; most tests touch
+no jax at all."""
+
+import io
+import json
+import os
+import textwrap
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from rtseg_tpu import obs
+from rtseg_tpu.config import SegConfig
+from rtseg_tpu.obs.live import (MetricsPoller, SinkTailer, check_frame,
+                                format_frame, parse_prometheus)
+from rtseg_tpu.obs.metrics import (MetricsRegistry, render_prometheus)
+from rtseg_tpu.obs.tracing import (TRACE_HEADER, TRACE_KEY, ensure_trace,
+                                   new_trace_id, valid_trace_id)
+
+BUCKETS = [(32, 32)]
+BATCH = 4
+
+
+@pytest.fixture(scope='module')
+def cfg():
+    c = SegConfig(dataset='synthetic', model='fastscnn', num_class=5,
+                  colormap='custom', compute_dtype='float32',
+                  save_dir='/tmp/rtseg_segtrace_test', use_tb=False)
+    c.resolve(num_devices=1)
+    return c
+
+
+@pytest.fixture(scope='module')
+def engine(cfg):
+    import jax
+    import jax.numpy as jnp
+    from rtseg_tpu.models import get_model
+    from rtseg_tpu.serve import ServeEngine
+    model = get_model(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 32, 32, 3), jnp.float32), False)
+    return ServeEngine.from_config(cfg, BUCKETS, BATCH,
+                                   variables=variables)
+
+
+# ----------------------------------------------------------------- registry
+def test_registry_basics_and_identity():
+    reg = MetricsRegistry()
+    c = reg.counter('reqs_total', status='ok')
+    assert reg.counter('reqs_total', status='ok') is c
+    c2 = reg.counter('reqs_total', status='error')
+    assert c2 is not c
+    c.inc()
+    c.inc(2)
+    assert c.value == 3 and c2.value == 0
+    g = reg.gauge('depth')
+    g.set(7)
+    assert g.value == 7.0
+    h = reg.histogram('lat_ms', bounds=(1.0, 10.0, 100.0), window=8)
+    for v in (0.5, 5.0, 50.0, 500.0):
+        h.observe(v)
+    snap = h.snapshot()
+    assert snap['counts'] == [1, 1, 1, 1]        # one per bucket + +Inf
+    assert snap['count'] == sum(snap['counts']) == 4
+    assert snap['sum'] == pytest.approx(555.5)
+    qs = h.quantiles((0.5,))
+    assert qs[0.5] in (5.0, 50.0)                # nearest-rank on window
+    # Prometheus le is inclusive: a value ON a bound lands in its bucket
+    h2 = reg.histogram('edge_ms', bounds=(10.0, 100.0))
+    h2.observe(10.0)
+    assert h2.snapshot()['counts'] == [1, 0, 0]
+    # same family name with a different kind is a hard error
+    with pytest.raises(ValueError):
+        reg.gauge('reqs_total')
+
+
+def test_registry_concurrency_exact_totals_no_torn_reads():
+    """N writer threads hammer a shared counter + histogram while a
+    scraper reads: totals come out exact and every scraped histogram
+    snapshot satisfies count == sum(bucket counts)."""
+    reg = MetricsRegistry()
+    c = reg.counter('hammer_total')
+    h = reg.histogram('hammer_ms', bounds=(1.0, 5.0, 25.0), window=64)
+    writers, per = 8, 2000
+    stop = threading.Event()
+    torn = []
+
+    def scrape():
+        while not stop.is_set():
+            snap = h.snapshot()
+            if snap['count'] != sum(snap['counts']):
+                torn.append(snap)
+            render_prometheus(reg)       # full scrape must never crash
+
+    def write(seed):
+        for i in range(per):
+            c.inc()
+            h.observe(float((seed * per + i) % 30))
+
+    scraper = threading.Thread(target=scrape)
+    scraper.start()
+    threads = [threading.Thread(target=write, args=(s,))
+               for s in range(writers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    scraper.join()
+    assert torn == []
+    assert c.value == writers * per
+    snap = h.snapshot()
+    assert snap['count'] == writers * per
+    assert sum(snap['counts']) == writers * per
+    assert len(snap['window']) == 64             # ring stays bounded
+
+
+def test_render_prometheus_parse_roundtrip():
+    reg = MetricsRegistry()
+    reg.counter('a_total', help='a help', status='ok').inc(5)
+    reg.gauge('b_depth').set(3.5)
+    h = reg.histogram('c_ms', bounds=(10.0, 100.0))
+    for v in (5.0, 50.0, 500.0):
+        h.observe(v)
+    text = render_prometheus(reg)
+    assert '# HELP a_total a help' in text
+    assert '# TYPE c_ms histogram' in text
+    parsed = parse_prometheus(text)
+    assert parsed['a_total'] == [({'status': 'ok'}, 5.0)]
+    assert parsed['b_depth'] == [({}, 3.5)]
+    buckets = {lab['le']: v for lab, v in parsed['c_ms_bucket']}
+    assert buckets == {'10': 1.0, '100': 2.0, '+Inf': 3.0}  # cumulative
+    assert parsed['c_ms_count'] == [({}, 3.0)]
+    qs = {lab['quantile']: v for lab, v in parsed['c_ms_window']}
+    assert set(qs) == {'0.5', '0.95', '0.99'} and qs['0.5'] == 50.0
+
+
+def test_disabled_registry_is_noop():
+    reg = MetricsRegistry(enabled=False)
+    c = reg.counter('x_total')
+    c.inc(100)
+    assert c.value == 0
+    h = reg.histogram('y_ms')
+    h.observe(5.0)
+    assert h.count == 0 and h.quantiles()[0.5] is None
+    assert reg.collect() == [] and reg.snapshot() == {}
+
+
+# ------------------------------------------------------------------ tracing
+def test_trace_ids_unique_valid_and_preserved():
+    ids = set()
+
+    def mint():
+        for _ in range(500):
+            ids.add(new_trace_id())
+
+    threads = [threading.Thread(target=mint) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(ids) == 2000                       # atomic: no collisions
+    tid = next(iter(ids))
+    assert valid_trace_id(tid) and len(tid) == 16
+    for bad in (None, '', 'short', 'Z' * 16, 'x' * 70, 42):
+        assert not valid_trace_id(bad)
+    meta = {TRACE_KEY: tid}
+    assert ensure_trace(meta)[TRACE_KEY] == tid   # existing id preserved
+    fresh = ensure_trace({})
+    assert valid_trace_id(fresh[TRACE_KEY])
+
+
+# -------------------------------------------------- pipeline + trace events
+def test_pipeline_trace_propagation_and_registry(engine, tmp_path):
+    from rtseg_tpu.serve import ServePipeline
+    sink = obs.EventSink(str(tmp_path / 'events-000.jsonl'))
+    obs.set_sink(sink)
+    try:
+        rng = np.random.RandomState(0)
+        with ServePipeline(engine, max_wait_ms=5, max_queue=32) as pipe:
+            tid = new_trace_id()
+            fut = pipe.submit(rng.randn(32, 32, 3).astype(np.float32),
+                              meta={TRACE_KEY: tid})
+            res = fut.result(timeout=60)
+            # a second request with no caller id gets one minted
+            fut2 = pipe.submit(rng.randn(32, 32, 3).astype(np.float32))
+            res2 = fut2.result(timeout=60)
+            stats = pipe.stats()
+            # /stats counters ARE the registry: they cannot disagree
+            snap = pipe.registry.snapshot()
+        assert res.meta[TRACE_KEY] == tid
+        assert valid_trace_id(res2.meta[TRACE_KEY])
+        assert res2.meta[TRACE_KEY] != tid
+        assert stats['ok'] == 2
+        assert snap['serve_requests_total{status="ok"}'] == 2
+        assert snap['serve_admitted_total'] == 2
+        assert stats['request_ms']['count'] == 2
+        assert stats['request_ms']['p95'] >= stats['request_ms']['p50']
+    finally:
+        obs.set_sink(None)
+        sink.close()
+    events = [json.loads(line)
+              for line in open(str(tmp_path / 'events-000.jsonl'))]
+    # the SAME id appears in the ingress event, the batch event and the
+    # terminal request event
+    ingress = [e for e in events if e['event'] == 'ingress']
+    batches = [e for e in events if e['event'] == 'batch']
+    requests = [e for e in events if e['event'] == 'request']
+    assert tid in {e.get(TRACE_KEY) for e in ingress}
+    assert any(tid in e.get('traces', []) for e in batches)
+    assert tid in {e.get(TRACE_KEY) for e in requests}
+    assert all(valid_trace_id(e.get(TRACE_KEY)) for e in ingress)
+
+
+def test_loadgen_mints_traces_in_process(engine, tmp_path):
+    from rtseg_tpu.serve import ServePipeline, bench_pipeline, synth_images
+    sink = obs.EventSink(str(tmp_path / 'events-000.jsonl'))
+    obs.set_sink(sink)
+    try:
+        imgs = synth_images(BUCKETS, seed=0)
+        with ServePipeline(engine, max_wait_ms=5, max_queue=64) as pipe:
+            report = bench_pipeline(pipe, imgs, requests=8, rps=500.0,
+                                    seed=0)
+        assert report['ok'] == 8
+    finally:
+        obs.set_sink(None)
+        sink.close()
+    events = [json.loads(line)
+              for line in open(str(tmp_path / 'events-000.jsonl'))]
+    req_ids = [e[TRACE_KEY] for e in events if e['event'] == 'request']
+    assert len(req_ids) == 8 and len(set(req_ids)) == 8
+
+
+def test_batcher_teardown_reaches_terminal_error_status():
+    """Every admitted request must land on a terminal
+    serve_requests_total status, even through an engine teardown:
+    admitted == ok + dropped + rejected-complement + error."""
+    from rtseg_tpu.serve import MicroBatcher
+    b = MicroBatcher([(32, 32)], max_batch=4, max_wait_ms=5000,
+                     max_queue=8)
+    futs = [b.submit(np.zeros((32, 32, 3), np.float32))
+            for _ in range(3)]
+    b.close()
+    b.fail_all(RuntimeError('engine died'))
+    snap = b.registry.snapshot()
+    assert snap['serve_admitted_total'] == 3
+    assert snap['serve_requests_total{status="error"}'] == 3
+    assert snap['serve_queue_depth'] == 0
+    for f in futs:
+        with pytest.raises(RuntimeError):
+            f.result(timeout=1)
+
+
+# ------------------------------------------------------- http live plane
+def test_http_metrics_endpoint_trace_header_and_stats(cfg, engine):
+    from PIL import Image
+    from rtseg_tpu.serve import ServePipeline, make_preprocess, make_server
+    from rtseg_tpu.utils import get_colormap
+    pipe = ServePipeline(engine, max_wait_ms=5, max_queue=32,
+                         preprocess=make_preprocess(cfg))
+    server = make_server(pipe, port=0, colormap=get_colormap(cfg))
+    port = server.server_address[1]
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    base = f'http://127.0.0.1:{port}'
+    try:
+        rng = np.random.RandomState(3)
+        buf = io.BytesIO()
+        Image.fromarray((rng.rand(32, 32, 3) * 255).astype(
+            np.uint8)).save(buf, format='PNG')
+        body = buf.getvalue()
+        tid = 'feedc0de' + '0' * 8
+        req = urllib.request.Request(
+            f'{base}/predict', data=body, method='POST',
+            headers={TRACE_HEADER: tid})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            # inbound id honored, echoed in the header AND the timing JSON
+            assert r.headers[TRACE_HEADER] == tid
+            timing = json.loads(r.headers['X-Serve-Timing'])
+            assert timing[TRACE_KEY] == tid
+        # a request with no inbound id gets a minted one back
+        req = urllib.request.Request(f'{base}/predict', data=body,
+                                     method='POST')
+        with urllib.request.urlopen(req, timeout=60) as r:
+            assert valid_trace_id(r.headers[TRACE_HEADER])
+        # error responses carry the trace header too
+        req = urllib.request.Request(f'{base}/predict', data=b'',
+                                     method='POST',
+                                     headers={TRACE_HEADER: tid})
+        try:
+            urllib.request.urlopen(req, timeout=60)
+            raise AssertionError('empty body must 400')
+        except urllib.error.HTTPError as e:
+            assert e.code == 400 and e.headers[TRACE_HEADER] == tid
+            e.read()
+        # /metrics: Prometheus text whose totals match /stats exactly
+        with urllib.request.urlopen(f'{base}/metrics', timeout=30) as r:
+            assert r.headers['Content-Type'].startswith('text/plain')
+            parsed = parse_prometheus(r.read().decode())
+        with urllib.request.urlopen(f'{base}/stats', timeout=30) as r:
+            stats = json.loads(r.read())
+        ok_metric = next(v for lab, v in parsed['serve_requests_total']
+                         if lab.get('status') == 'ok')
+        assert int(ok_metric) == stats['ok'] == 2
+        assert int(parsed['serve_request_e2e_ms_count'][0][1]) == 2
+        assert stats['request_ms']['count'] == 2
+        codes = {lab['code']: v for lab, v in
+                 parsed['serve_http_responses_total']}
+        assert codes['200'] >= 2 and codes['400'] == 1
+    finally:
+        server.shutdown()
+        pipe.close()
+
+
+# ---------------------------------------------------------------- collector
+class _FakeJit:
+    def __init__(self):
+        self.size = 0
+
+    def _cache_size(self):
+        return self.size
+
+
+def test_collector_feeds_registry():
+    reg = MetricsRegistry()
+    jit = _FakeJit()
+    from rtseg_tpu.obs import StepCollector
+    col = StepCollector(None, 'train', imgs_per_step=4, jitted=jit,
+                        registry=reg)
+    for i, _ in enumerate(col.wrap(range(4))):
+        if i == 0:
+            jit.size = 1                  # first step compiles
+        time.sleep(0.002)
+        col.end_step(step=i + 1)
+    snap = reg.snapshot()
+    assert snap['train_steps_total{kind="train"}'] == 4
+    assert snap['train_compile_steps_total{kind="train"}'] == 1
+    assert snap['train_imgs_total{kind="train"}'] == 16
+    # the step histogram only sees non-compile steps (report semantics)
+    assert snap['train_step_ms{kind="train"}']['count'] == 3
+    assert snap['train_step_ms{kind="train"}']['p50'] > 0
+    assert 0 <= snap['train_goodput{kind="train"}'] <= 1
+    text = render_prometheus(reg)
+    assert 'train_step_ms_window{kind="train",quantile="0.5"}' in text
+
+
+# ------------------------------------------------------------- segscope live
+def _evt(**kw):
+    kw.setdefault('ts', time.time())
+    kw.setdefault('host', 0)
+    return json.dumps(kw) + '\n'
+
+
+def test_live_sink_tailer_incremental_and_check(tmp_path):
+    d = str(tmp_path / 'segscope')
+    os.makedirs(d)
+    p = os.path.join(d, 'events-000.jsonl')
+    with open(p, 'w') as f:
+        f.write(_evt(event='run_start', model='fastscnn'))
+        for i in range(10):
+            f.write(_evt(event='ingress', trace_id=f'{i:016x}'))
+            f.write(_evt(event='request', status='ok',
+                         e2e_ms=10.0 + i, bucket='32x32'))
+        f.write(_evt(event='request', status='rejected', queue_ms=0.1))
+    tail = SinkTailer(d, window_s=600)
+    frame = tail.poll()
+    sv = frame['serving']
+    assert sv['ok'] == 10 and sv['rejected'] == 1
+    assert sv['p50_ms'] == pytest.approx(14.5, abs=1.1)
+    assert check_frame(frame) == []
+    assert 'requests' in format_frame(frame)
+    # incremental: appended events (plus a torn tail) show on next poll
+    with open(p, 'a') as f:
+        f.write(_evt(event='request', status='ok', e2e_ms=50.0))
+        f.write('{"event": "request", "status":')      # torn tail line
+    frame = tail.poll()
+    assert frame['serving']['ok'] == 11
+    # a stall fails the check
+    with open(p, 'a') as f:
+        f.write('\n')    # the torn line never completes; start clean
+        f.write(_evt(event='stall', reason='seeded'))
+    frame = tail.poll()
+    assert frame['stalls'] == 1
+    assert any('stall' in pr for pr in check_frame(frame))
+    # p99 threshold gates
+    assert any('p99' in pr
+               for pr in check_frame(frame, p99_ms=0.001))
+
+
+def test_live_metrics_poller_rates_and_check():
+    reg = MetricsRegistry()
+    ok = reg.counter('serve_requests_total', status='ok')
+    err = reg.counter('serve_requests_total', status='error')
+    h = reg.histogram('serve_request_e2e_ms')
+    for _ in range(20):
+        ok.inc()
+        h.observe(100.0)
+    from http.server import BaseHTTPRequestHandler, HTTPServer
+
+    class _H(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            body = render_prometheus(reg).encode()
+            self.send_response(200)
+            self.send_header('Content-Length', str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = HTTPServer(('127.0.0.1', 0), _H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        poller = MetricsPoller(f'http://127.0.0.1:{srv.server_address[1]}')
+        frame = poller.poll()
+        sv = frame['serving']
+        assert sv['ok'] == 20 and sv['rps'] is None   # no delta yet
+        assert sv['p99_ms'] == pytest.approx(100.0)
+        assert check_frame(frame) == []
+        assert any('p99' in p
+                   for p in check_frame(frame, p99_ms=50.0))
+        ok.inc(10)
+        time.sleep(0.05)
+        frame = poller.poll()
+        assert frame['serving']['ok'] == 30
+        assert frame['serving']['rps'] > 0            # delta-derived
+        # an error counter > 0 fails the gate
+        err.inc()
+        assert any('error' in p for p in check_frame(poller.poll()))
+    finally:
+        srv.shutdown()
+
+
+def test_live_cli_once_check_and_exit_codes(tmp_path, capsys):
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), 'tools'))
+    try:
+        import segscope
+    finally:
+        sys.path.pop(0)
+    d = str(tmp_path / 'segscope')
+    os.makedirs(d)
+    with open(os.path.join(d, 'events-000.jsonl'), 'w') as f:
+        f.write(_evt(event='run_start'))
+        f.write(_evt(event='request', status='ok', e2e_ms=12.0))
+    assert segscope.main(['live', d, '--once', '--check']) == 0
+    out = capsys.readouterr().out
+    assert 'segscope live' in out and 'check OK' in out
+    # empty target: no activity -> check fails
+    d2 = str(tmp_path / 'empty')
+    os.makedirs(d2)
+    with open(os.path.join(d2, 'events-000.jsonl'), 'w') as f:
+        f.write(_evt(event='run_start'))
+    assert segscope.main(['live', d2, '--once', '--check']) == 1
+    # missing target -> usage error
+    assert segscope.main(['live', str(tmp_path / 'nope'),
+                          '--once']) == 2
+
+
+# --------------------------------------------------------------------- lint
+def _write(root, relpath, text):
+    path = os.path.join(str(root), relpath)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, 'w') as f:
+        f.write(textwrap.dedent(text))
+
+
+def test_obs_purity_covers_metrics_and_tracing_submodules(tmp_path):
+    """Registry/tracing calls reachable from jit'd code are findings, in
+    every import spelling the new submodules allow."""
+    from rtseg_tpu.analysis.lint_obs import check_obs_purity
+    _write(tmp_path, 'rtseg_tpu/serve/bad.py', '''
+        import jax
+        from rtseg_tpu.obs import metrics
+        from rtseg_tpu.obs.tracing import new_trace_id
+        import rtseg_tpu.obs.metrics as reg_mod
+
+        @jax.jit
+        def traced_a(x):
+            metrics.get_registry().counter('oops').inc()
+            return x
+
+        @jax.jit
+        def traced_b(x):
+            tid = new_trace_id()
+            return x
+
+        @jax.jit
+        def traced_c(x):
+            reg_mod.get_registry()
+            return x
+        ''')
+    found = check_obs_purity(str(tmp_path))
+    msgs = {f.message.split('(')[0] for f in found}
+    assert any('metrics.get_registry' in m for m in msgs)
+    assert any('new_trace_id' in m for m in msgs)
+    assert any('reg_mod.get_registry' in m for m in msgs)
+    # host-side use of the same imports stays clean
+    _write(tmp_path, 'rtseg_tpu/serve/bad.py', '''
+        from rtseg_tpu.obs import metrics
+        from rtseg_tpu.obs.tracing import new_trace_id
+
+        def host_loop():
+            metrics.get_registry().counter('fine').inc()
+            return new_trace_id()
+        ''')
+    assert check_obs_purity(str(tmp_path)) == []
+
+
+def test_obs_purity_real_tree_still_clean():
+    from rtseg_tpu.analysis.lint_obs import check_obs_purity
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    assert check_obs_purity(root) == []

@@ -111,6 +111,10 @@ def assert_smp_parity(decoder, encoder='resnet18', h=64, w=64, atol=1e-4):
         err_msg=f'smp_{decoder}: eval logits diverge')
 
 
+# slow: one smp-reference forward parity per decoder (~60s total on
+# 1-core CI); param counts stay pinned tier-1 above, and the KD teacher
+# parity below keeps one full logit comparison in tier-1
+@pytest.mark.slow
 @pytest.mark.parametrize('decoder', sorted(PUBLISHED_PARAMS_M))
 def test_smp_logit_parity(decoder):
     h, w = SIZES.get(decoder, (64, 64))
@@ -137,6 +141,7 @@ def test_kd_teacher_logit_parity():
         smp_stub.make_encoder = orig
 
 
+@pytest.mark.slow          # timm-reference encoder forward (~20s)
 def test_mobilenet_encoder_parity():
     """mnv2 encoder incl. the smp 1280-channel head conv."""
     assert_smp_parity('fpn', 'mobilenet_v2', 64, 64)

@@ -101,6 +101,8 @@ def test_import_reference_cli(tmp_path):
                                yt.numpy(), atol=1e-4, rtol=1e-4)
 
 
+@pytest.mark.slow          # subprocess import + forward check (~30s);
+                           # the non-smp import CLI test stays tier-1
 def test_import_reference_cli_smp(tmp_path):
     """smp-family migration (VERDICT round-2 missing #1): a reference-style
     smp .pth (the KD-teacher load format, reference

@@ -60,6 +60,7 @@ def test_train_step_runs_and_updates(mesh8):
     assert max(diff) == 0
 
 
+@pytest.mark.slow          # compiles two full train steps (~40s on 1-core)
 def test_train_step_remat_matches(mesh8):
     """config.remat rematerializes activations in backward (jax.checkpoint)
     — must change memory, never math: losses and updated params agree with
@@ -113,6 +114,7 @@ def test_sync_bn_stats_identical_across_replicas(mesh8):
         assert np.isfinite(np.asarray(leaf)).all()
 
 
+@pytest.mark.slow          # aux-head train-step compile (~30s on 1-core)
 def test_train_step_aux_bisenetv2(mesh8):
     cfg = _cfg()
     cfg.model = 'bisenetv2'
@@ -128,6 +130,7 @@ def test_train_step_aux_bisenetv2(mesh8):
     assert np.isfinite(float(metrics['loss']))
 
 
+@pytest.mark.slow          # detail-head train-step compile (~18s on 1-core)
 def test_train_step_detail_stdc(mesh8):
     cfg = _cfg()
     cfg.model = 'stdc'
@@ -143,6 +146,7 @@ def test_train_step_detail_stdc(mesh8):
     assert np.isfinite(float(metrics['loss_detail']))
 
 
+@pytest.mark.slow          # two spatial-mesh step compiles (~35s on 1-core)
 def test_gspmd_spatial_matches_single_device():
     """The ('data','spatial') GSPMD step is the SAME program as unsharded
     execution — XLA inserts halo exchange, so sharded loss must equal the
@@ -190,6 +194,10 @@ def _spatial_meshes():
             Mesh(np.array(devs[:1]), (DATA_AXIS,)))
 
 
+# slow: each param compiles two full train steps (~60s/40s on 1-core CI);
+# the eval-side hard-op sweep below stays tier-1 (same halo semantics,
+# dropout-free, exact confusion-matrix equality)
+@pytest.mark.slow
 @pytest.mark.parametrize('model_name', ['dabnet', 'cgnet'])
 def test_gspmd_spatial_hard_ops_train(model_name):
     """Dilated-conv families, full train step (fwd+bwd halos). Loss scalar

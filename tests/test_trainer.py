@@ -39,6 +39,7 @@ def test_trainer_runs_and_checkpoints(save_dir):
     assert int(trainer.state.step) == cfg.total_itrs
 
 
+@pytest.mark.slow          # two full trainer runs (~60s on 1-core CI)
 def test_trainer_resume(save_dir):
     cfg = _cfg(save_dir, total_epoch=1)
     t1 = SegTrainer(cfg)
@@ -54,6 +55,7 @@ def test_trainer_resume(save_dir):
     assert int(t2.state.step) == 2 * step_after_1
 
 
+@pytest.mark.slow          # multi-epoch convergence run (~120s on 1-core)
 def test_training_converges(save_dir):
     """Loss falls and mIoU rises on the learnable synthetic task — catches
     silent training-math regressions (LR schedule, grad sync, EMA, metrics)
@@ -69,6 +71,7 @@ def test_training_converges(save_dir):
         f'loss did not decrease: first={losses[0]:.4f} last={losses[-1]:.4f}')
 
 
+@pytest.mark.slow          # full SegTrainer predict e2e (~30s on 1-core)
 def test_predict_writes_masks_and_blends(save_dir, tmp_path):
     """Reference predict path (core/seg_trainer.py:154-191): colormapped PNG
     masks + alpha blends from a folder of images, weights from best.ckpt."""
@@ -99,6 +102,7 @@ def test_predict_writes_masks_and_blends(save_dir, tmp_path):
         assert np.asarray(Image.open(blend)).shape == (40, 56, 3)
 
 
+@pytest.mark.slow          # full trainer run with profiler (~30s on 1-core)
 def test_profiler_trace_hook(save_dir, tmp_path):
     """config.profile_dir dumps a jax.profiler trace of early train steps
     (TPU-native upgrade over the reference's wall-clock-only FPS harness)."""

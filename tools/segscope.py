@@ -21,9 +21,19 @@ Usage:
     python tools/segscope.py report save/segscope --all-runs
     python tools/segscope.py diff runA/segscope runB/segscope
 
+    # live plane (segtrace): follow a RUNNING system — tail a run's sink
+    # dir, or poll a serve replica's /metrics endpoint — and render a
+    # refreshing SLO summary
+    python tools/segscope.py live save/segscope
+    python tools/segscope.py live http://127.0.0.1:8080 --interval 2
+    python tools/segscope.py live http://host:8080 --once --check \
+        --p99-ms 500                                    # CI gate
+
 Metric definitions live in rtseg_tpu/obs/report.py and BENCHMARKS.md
 ("Goodput"). `report` summarizes the segment after the last run_start
 marker (resumes append to the same files); `--all-runs` keeps everything.
+`live --check` fails on any stall, any request error, p99 over the
+--p99-ms threshold, or a target with no observed activity.
 
 Exit codes: 0 ok, 1 --check failed / regression, 2 usage or missing run.
 """
@@ -34,11 +44,49 @@ import argparse
 import json
 import os
 import sys
+import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from rtseg_tpu.obs.live import (MetricsPoller, SinkTailer,    # noqa: E402
+                                check_frame, format_frame)
 from rtseg_tpu.obs.report import (diff_table, format_summary,  # noqa: E402
                                   load_events, summarize)
+
+
+def _run_live(args) -> int:
+    if args.target.startswith(('http://', 'https://')):
+        source = MetricsPoller(args.target)
+    else:
+        source = SinkTailer(args.target, window_s=args.window)
+    first = True
+    while True:
+        try:
+            frame = source.poll()
+        except OSError as e:
+            print(f'segscope live: {args.target}: {e}', file=sys.stderr)
+            return 2
+        out = format_frame(frame)
+        if args.once:
+            print(out)
+        else:
+            # full-frame repaint: clear + home, like watch(1)
+            print('\x1b[2J\x1b[H' + out, flush=True)
+        if args.check:
+            problems = check_frame(frame, p99_ms=args.p99_ms)
+            if problems:
+                # a transient empty first frame is not a failure while
+                # following; only --once treats it as terminal
+                if args.once:
+                    print('segscope live check FAILED: '
+                          + '; '.join(problems), file=sys.stderr)
+                    return 1
+                print('  CHECK: ' + '; '.join(problems), flush=True)
+            elif args.once:
+                print('segscope live check OK')
+        if args.once:
+            return 0
+        time.sleep(args.interval)
 
 
 def main(argv=None) -> int:
@@ -61,9 +109,30 @@ def main(argv=None) -> int:
     dp.add_argument('a')
     dp.add_argument('b')
     dp.add_argument('--json', action='store_true')
+
+    lp = sub.add_parser('live', help='follow a running system (sink dir '
+                                     'or /metrics URL)')
+    lp.add_argument('target', help='obs dir / events file to tail, or an '
+                                   'http(s) URL whose /metrics to poll')
+    lp.add_argument('--interval', type=float, default=2.0,
+                    help='seconds between frames')
+    lp.add_argument('--once', action='store_true',
+                    help='render one frame and exit (CI)')
+    lp.add_argument('--window', type=float, default=30.0,
+                    help='sliding window for sink-mode percentiles/rates')
+    lp.add_argument('--check', action='store_true',
+                    help='gate: stalls == 0, request errors == 0, some '
+                         'activity observed, p99 under --p99-ms')
+    lp.add_argument('--p99-ms', type=float, default=None,
+                    help='--check request p99 threshold (ms)')
     args = ap.parse_args(argv)
 
     try:
+        if args.cmd == 'live':
+            try:
+                return _run_live(args)
+            except KeyboardInterrupt:
+                return 0
         if args.cmd == 'report':
             events = load_events(args.path, last_run=not args.all_runs)
             s = summarize(events)

@@ -79,6 +79,11 @@ def _add_engine_args(p: argparse.ArgumentParser) -> None:
                         'next replica starts without compiling')
     p.add_argument('--compile-workers', type=int, default=0,
                    help='bucket-table compile threads (0 = auto)')
+    p.add_argument('--no-metrics', action='store_true',
+                   help='disable the live metrics registry and request '
+                        'tracing (the metrics-off side of the overhead '
+                        'A/B, BENCHMARKS.md "Live metrics overhead '
+                        'methodology")')
 
 
 def _build_config(args) -> SegConfig:
@@ -110,15 +115,27 @@ def _build_engine(args, cfg: SegConfig) -> ServeEngine:
 
 def _build_pipeline(args, cfg: SegConfig,
                     engine: ServeEngine) -> ServePipeline:
+    from rtseg_tpu.obs.metrics import MetricsRegistry
     return ServePipeline(engine, max_wait_ms=args.max_wait_ms,
                          max_queue=args.max_queue,
                          deadline_ms=args.deadline_ms,
                          preprocess=make_preprocess(cfg),
                          pre_workers=args.workers,
-                         post_workers=args.workers)
+                         post_workers=args.workers,
+                         registry=MetricsRegistry(
+                             enabled=not args.no_metrics),
+                         trace=not args.no_metrics)
 
 
 def cmd_serve(args) -> int:
+    sink = None
+    if args.obs_dir:
+        # a serving replica can stream its request/batch/ingress events
+        # live: `tools/segscope.py live <obs-dir>` tails this sink
+        sink = obs.init_run(args.obs_dir, meta={
+            'serve': True, 'model': args.model, 'buckets': args.buckets,
+            'batch': args.batch})
+        obs.set_sink(sink)
     cfg = _build_config(args)
     engine = _build_engine(args, cfg)
     pipeline = _build_pipeline(args, cfg, engine)
@@ -127,7 +144,7 @@ def cmd_serve(args) -> int:
     host, port = server.server_address[:2]
     print(f'segserve: {cfg.model} on http://{host}:{port} | buckets '
           f'{args.buckets} x batch {engine.batch} | POST /predict, '
-          f'GET /healthz /stats', flush=True)
+          f'GET /healthz /stats /metrics', flush=True)
     try:
         server.serve_forever()
     except KeyboardInterrupt:
@@ -135,6 +152,11 @@ def cmd_serve(args) -> int:
     finally:
         server.shutdown()
         pipeline.close()
+        if sink is not None:
+            sink.emit({'event': 'run_end'})
+            sink.close()
+            if obs.get_sink() is sink:
+                obs.set_sink(None)
     return 0
 
 
@@ -154,6 +176,9 @@ def cmd_bench(args) -> int:
         report = bench_http(args.http, payloads, args.requests, args.rps,
                             seed=args.seed)
         try:
+            if args.report_json:
+                with open(args.report_json, 'w') as f:
+                    json.dump(report, f, indent=2)
             print(json.dumps(report, indent=2) if args.json
                   else format_report(report), flush=True)
             if args.check:
@@ -203,6 +228,9 @@ def cmd_bench(args) -> int:
             report['baseline'] = bench_sequential(
                 base_engine, images, min(args.requests,
                                          args.baseline_requests))
+        if args.report_json:
+            with open(args.report_json, 'w') as f:
+                json.dump(report, f, indent=2)
         print(json.dumps(report, indent=2) if args.json
               else format_report(report), flush=True)
         if args.check:
@@ -235,6 +263,9 @@ def main(argv=None) -> int:
     _add_engine_args(sp)
     sp.add_argument('--host', default='0.0.0.0')
     sp.add_argument('--port', type=int, default=8080)
+    sp.add_argument('--obs-dir', default=None,
+                    help='stream segscope ingress/request/batch events '
+                         'here (tail with `segscope.py live`)')
 
     bp = sub.add_parser('bench', help='open-loop Poisson load test')
     _add_engine_args(bp)
@@ -254,6 +285,9 @@ def main(argv=None) -> int:
     bp.add_argument('--obs-dir', default=None,
                     help='write segscope request/batch events here')
     bp.add_argument('--json', action='store_true')
+    bp.add_argument('--report-json', default=None, metavar='PATH',
+                    help='also write the report dict to this file '
+                         '(CI reconciliation against a /metrics scrape)')
     bp.add_argument('--check', action='store_true',
                     help='CI gate (see module docstring)')
     bp.add_argument('--p95-ms', type=float, default=1000.0,
