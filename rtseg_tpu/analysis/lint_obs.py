@@ -47,7 +47,7 @@ def _obs_submodules() -> frozenset:
         pass
     # fallback (lint run from an environment without the source tree)
     return frozenset({'core', 'collector', 'watchdog', 'report',
-                      'metrics', 'tracing', 'live'})
+                      'metrics', 'tracing', 'live', 'profile'})
 
 
 _OBS_SUBMODULES = _obs_submodules()

@@ -119,6 +119,18 @@ class SegConfig:
     watchdog_min_s: float = 120.0
     watchdog_factor: float = 20.0
     obs_stall_trace: bool = True
+    # sampled on-device profiling (segprof, obs/profile.py): every
+    # profile_every train steps, fence the device, trace
+    # profile_capture_iters iterations with jax.profiler, parse the
+    # trace into per-category/per-module device time + busy fraction,
+    # and emit ONE structured 'profile' event into the segscope sink
+    # (binary trace deleted after parsing). 0 = off. Non-capture steps
+    # pay an integer compare (BENCHMARKS.md "Sampled profiling overhead
+    # methodology", segprof_cpu.log). Guard-armed: a capture whose step
+    # retraced mid-window is flagged `retraced` and excluded from
+    # attribution downstream.
+    profile_every: int = 0
+    profile_capture_iters: int = 2
 
     # ----- Input pipeline (segpipe, rtseg_tpu/data/segpipe/) -----
     # packed sample cache: one-time pass that decodes + pre-resizes the

@@ -102,6 +102,9 @@ def get_parser() -> argparse.ArgumentParser:
     p.add_argument('--watchdog_min_s', type=float)
     p.add_argument('--watchdog_factor', type=float)
     p.add_argument('--obs_stall_trace', type=_bool)
+    # Device profiling (segprof)
+    p.add_argument('--profile_every', type=int)
+    p.add_argument('--profile_capture_iters', type=int)
     # Training setting
     # tri-state: absent -> None (defer to compute_dtype), true -> bf16,
     # false -> force fp32 (reachable from the CLI, unlike store_const)

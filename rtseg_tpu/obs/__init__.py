@@ -15,8 +15,8 @@ All APIs here are host-side; the ``obs-purity`` lint
 (analysis/lint_obs.py) keeps them out of jit-reachable code.
 """
 
-from .core import (EventSink, emit_memory, get_sink, init_run, set_sink,
-                   span)
+from .core import (EventSink, emit_memory, get_sink, init_run,
+                   read_memory_stats, set_sink, span, update_memory_gauges)
 from .collector import StepCollector
 from .watchdog import StallWatchdog, dump_all_stacks
 from .report import (diff_table, format_summary, load_events, summarize)
@@ -24,12 +24,17 @@ from .metrics import (MetricsRegistry, get_registry, render_prometheus,
                       set_registry)
 from .tracing import (TRACE_HEADER, TRACE_KEY, ensure_trace, new_trace_id,
                       valid_trace_id)
+from .profile import (CaptureBusy, DeviceProfile, SampledProfiler,
+                      capture_window, parse_trace)
 
 __all__ = [
-    'EventSink', 'emit_memory', 'get_sink', 'init_run', 'set_sink', 'span',
+    'EventSink', 'emit_memory', 'get_sink', 'init_run',
+    'read_memory_stats', 'set_sink', 'span', 'update_memory_gauges',
     'StepCollector', 'StallWatchdog', 'dump_all_stacks',
     'diff_table', 'format_summary', 'load_events', 'summarize',
     'MetricsRegistry', 'get_registry', 'set_registry', 'render_prometheus',
     'TRACE_HEADER', 'TRACE_KEY', 'ensure_trace', 'new_trace_id',
     'valid_trace_id',
+    'CaptureBusy', 'DeviceProfile', 'SampledProfiler', 'capture_window',
+    'parse_trace',
 ]

@@ -163,6 +163,17 @@ _MEMORY_KEYS = ('bytes_in_use', 'peak_bytes_in_use', 'bytes_limit',
                 'largest_alloc_size')
 
 
+def read_memory_stats() -> Dict[str, int]:
+    """Device 0's memory_stats(), filtered to the watermark keys; empty
+    on backends without the probe (CPU usually reports nothing)."""
+    try:
+        import jax
+        stats = jax.local_devices()[0].memory_stats() or {}
+    except Exception:   # noqa: BLE001 — no jax / backend without stats
+        return {}
+    return {k: int(v) for k, v in stats.items() if k in _MEMORY_KEYS}
+
+
 def emit_memory(sink: Optional[EventSink]) -> None:
     """Best-effort ``memory`` event from device 0's memory_stats()."""
     if sink is None:
@@ -171,8 +182,26 @@ def emit_memory(sink: Optional[EventSink]) -> None:
         import jax
         dev = jax.local_devices()[0]
         stats = dev.memory_stats() or {}
-    except Exception:   # noqa: BLE001 — backend without memory_stats
+    except Exception:   # noqa: BLE001 — no jax / backend without stats
         return
     keep = {k: int(v) for k, v in stats.items() if k in _MEMORY_KEYS}
     if keep:
         sink.emit({'event': 'memory', 'device': str(dev), **keep})
+
+
+def update_memory_gauges(registry: Any,
+                         stats: Optional[Dict[str, int]] = None) -> bool:
+    """Feed the device memory watermarks into ``device_memory_bytes
+    {kind=...}`` gauges on a MetricsRegistry — peak HBM shows up at
+    ``GET /metrics`` and in ``segscope live`` while the process runs.
+    ``stats`` overrides the probe (tests; backends without memory_stats
+    leave the gauges unregistered). Returns True when anything was set."""
+    if registry is None:
+        return False
+    stats = read_memory_stats() if stats is None else {
+        k: int(v) for k, v in stats.items() if k in _MEMORY_KEYS}
+    for kind, v in stats.items():
+        registry.gauge('device_memory_bytes',
+                       help='device memory watermarks (memory_stats)',
+                       kind=kind).set(v)
+    return bool(stats)

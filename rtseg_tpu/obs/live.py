@@ -118,7 +118,21 @@ class MetricsPoller:
         frame: Dict[str, Any] = {
             'source': self.url, 'mode': 'metrics',
             'serving': None, 'train': None, 'stalls': None,
+            'device': None,
         }
+        # segprof gauges: busy fraction of the last profile capture and
+        # the device memory watermarks (refreshed by the server at scrape
+        # time; absent on backends without memory_stats)
+        busy = _family_value(parsed, 'device_busy_frac')
+        peak = _family_value(parsed, 'device_memory_bytes',
+                             kind='peak_bytes_in_use')
+        captures = _family_value(parsed, 'profile_captures_total')
+        if busy is not None or peak is not None:
+            frame['device'] = {
+                'busy_frac': busy,
+                'peak_hbm_bytes': peak,
+                'captures': int(captures) if captures is not None else 0,
+            }
         if 'serve_requests_total' in parsed \
                 or 'serve_request_e2e_ms_count' in parsed:
             frame['serving'] = {
@@ -193,8 +207,11 @@ class SinkTailer:
         self._recent: List[dict] = []     # request/step events, windowed
         self.totals = {'ok': 0, 'rejected': 0, 'dropped': 0,
                        'ingress': 0, 'stalls': 0, 'steps': 0,
-                       'compile_steps': 0}
+                       'compile_steps': 0, 'captures': 0}
         self.run_meta: Dict[str, Any] = {}
+        # segprof: last non-retraced profile capture + peak HBM seen
+        self._busy_frac: Optional[float] = None
+        self._peak_hbm: Optional[float] = None
 
     def _paths(self) -> List[str]:
         if self.files is not None:
@@ -247,6 +264,16 @@ class SinkTailer:
                 if e.get('compile'):
                     self.totals['compile_steps'] += 1
                 self._recent.append(e)
+            elif kind == 'profile':
+                self.totals['captures'] += 1
+                if not e.get('retraced') \
+                        and e.get('busy_frac') is not None:
+                    self._busy_frac = float(e['busy_frac'])
+            elif kind == 'memory':
+                peak = e.get('peak_bytes_in_use')
+                if isinstance(peak, (int, float)):
+                    self._peak_hbm = max(self._peak_hbm or 0.0,
+                                         float(peak))
         cutoff = now_ts - self.window_s
         self._recent = [e for e in self._recent
                         if e.get('ts', now_ts) >= cutoff]
@@ -268,8 +295,14 @@ class SinkTailer:
         frame: Dict[str, Any] = {
             'source': self.dir or self.files[0], 'mode': 'sink',
             'run': self.run_meta, 'stalls': self.totals['stalls'],
-            'serving': None, 'train': None,
+            'serving': None, 'train': None, 'device': None,
         }
+        if self._busy_frac is not None or self._peak_hbm is not None:
+            frame['device'] = {
+                'busy_frac': self._busy_frac,
+                'peak_hbm_bytes': self._peak_hbm,
+                'captures': self.totals['captures'],
+            }
         if self.totals['ingress'] or self.totals['ok'] \
                 or self.totals['rejected'] or self.totals['dropped']:
             frame['serving'] = {
@@ -337,6 +370,14 @@ def format_frame(frame: Dict[str, Any]) -> str:
         if tr.get('goodput') is not None:
             lines.append(f'  goodput        : '
                          f'{100 * tr["goodput"]:.1f}%')
+    dv = frame.get('device')
+    if dv:
+        busy = (f'{100 * dv["busy_frac"]:.1f}%'
+                if dv.get('busy_frac') is not None else '—')
+        peak = (f'{dv["peak_hbm_bytes"] / 2**20:.0f} MiB'
+                if dv.get('peak_hbm_bytes') is not None else '—')
+        lines.append(f'  device         : busy {busy} | peak HBM {peak}'
+                     f' | {dv.get("captures", 0)} capture(s)')
     if frame.get('stalls') is not None:
         lines.append(f'  stalls         : {frame["stalls"]}')
     if not sv and not tr:
@@ -345,7 +386,8 @@ def format_frame(frame: Dict[str, Any]) -> str:
 
 
 def check_frame(frame: Dict[str, Any],
-                p99_ms: Optional[float] = None) -> List[str]:
+                p99_ms: Optional[float] = None,
+                max_hbm_bytes: Optional[float] = None) -> List[str]:
     """CI gate: list of violated conditions (empty == pass)."""
     problems: List[str] = []
     sv = frame.get('serving')
@@ -361,6 +403,13 @@ def check_frame(frame: Dict[str, Any],
             if p99 is None or p99 > p99_ms:
                 problems.append(
                     f'request p99 {_fmt(p99)} ms > threshold {p99_ms} ms')
+    if max_hbm_bytes is not None:
+        dv = frame.get('device') or {}
+        peak = dv.get('peak_hbm_bytes')
+        if peak is not None and peak > max_hbm_bytes:
+            problems.append(
+                f'peak HBM {peak / 2**20:.0f} MiB > threshold '
+                f'{max_hbm_bytes / 2**20:.0f} MiB')
     if frame.get('stalls'):
         problems.append(f"{frame['stalls']} stalls (want 0)")
     return problems

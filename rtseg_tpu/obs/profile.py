@@ -1,0 +1,484 @@
+"""segprof — device-time attribution from XLA profiler traces.
+
+segscope answers *how long* a step took on the host and segtrace makes
+those numbers live; this module answers *where the milliseconds go
+on-chip*. One parser (:func:`parse_trace`) turns the trace-viewer JSON
+jax.profiler writes (``*.trace.json.gz``) into a :class:`DeviceProfile`:
+
+  * per-op-**category** device time — conv / matmul / collective / copy /
+    fusion / infeed, everything else under its named HLO opcode (never a
+    silent "unknown" bucket; ``attributed_frac`` tracks the residue of
+    events whose name cannot even be parsed),
+  * per-model-**module** device time, from the source-path metadata XLA
+    records in each op's ``long_name``/``tf_op`` args (TPU/GPU traces;
+    CPU traces carry no module paths and fall back to categories),
+  * device **busy fraction** and idle-gap accounting over the capture
+    window, plus the top ops by duration (what the stall watchdog pins
+    onto its ``stall`` events).
+
+Three capture surfaces share the parser and one process-wide capture
+lock (the XLA profiler is a singleton — two concurrent ``start_trace``
+calls would corrupt each other):
+
+  * :class:`SampledProfiler` — continuous sampled profiling inside the
+    trainer loop (``config.profile_every``): every N steps it fences the
+    device, traces K iterations, parses, emits one ``profile`` event and
+    deletes the binary trace. Non-capture steps pay an integer compare
+    (overhead A/B in BENCHMARKS.md "Sampled profiling overhead
+    methodology").
+  * :func:`capture_window` — a bounded wall-clock window under live
+    traffic; the serve front-end's ``POST /debug/profile`` endpoint.
+    Raises :class:`CaptureBusy` instead of queueing (the HTTP layer maps
+    it to 409).
+  * the stall watchdog's post-stall trace, auto-parsed into
+    ``top_device_ops`` (obs/watchdog.py).
+
+Like the rest of the obs package this module imports without jax —
+``tools/segscope.py`` parses synced trace dirs on machines with no
+accelerator stack; jax is only touched when a capture is requested.
+"""
+
+from __future__ import annotations
+
+import collections
+import glob
+import gzip
+import json
+import os
+import re
+import shutil
+import tempfile
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..analysis.recompile import _cache_size
+from .core import EventSink
+
+#: the fixed attribution categories (everything else is attributed under
+#: its named HLO opcode; see categorize())
+CATEGORIES = ('conv', 'matmul', 'collective', 'copy', 'fusion', 'infeed')
+
+#: trace-viewer args keys that may carry the jax source-path metadata
+#: (HLO op_name); varies across jax/profiler versions
+_ARGS_KEYS = ('long_name', 'tf_op', 'hlo_op', 'name')
+
+#: args keys whose mere presence marks an event as an XLA op event — the
+#: CPU backend has no device process track, but its op events carry these
+_HLO_ARG_KEYS = ('hlo_op', 'hlo_module', 'long_name', 'tf_op')
+
+_NAME_RE = re.compile(r'[A-Za-z][A-Za-z0-9_\-]*')
+
+_COLLECTIVE_PREFIXES = ('all-reduce', 'all-gather', 'reduce-scatter',
+                        'collective', 'all-to-all')
+
+
+def categorize(name: str) -> str:
+    """HLO op/event name -> attribution category.
+
+    The six canonical categories cover the op families the ROADMAP's
+    autoscaling/quantization consumers care about; anything else is
+    attributed under its own opcode base (``tanh.3`` -> ``tanh``) so
+    every parseable op lands in a *named* bucket. Only an event whose
+    name yields no opcode at all becomes ``unattributed``.
+    """
+    m = _NAME_RE.search(name or '')
+    if not m:
+        return 'unattributed'
+    base = m.group(0).lower()
+    # 'convert' (dtype cast) must NOT land in conv: bf16 traces are full
+    # of convert.N ops and misfiling them would inflate the conv share
+    # the quantization/autoscaling consumers trust
+    if base.startswith('conv') and not base.startswith('convert'):
+        return 'conv'
+    if base in ('dot', 'dot-general') or 'gemm' in base or 'matmul' in base:
+        return 'matmul'
+    if base.startswith(_COLLECTIVE_PREFIXES):
+        return 'collective'
+    if base.startswith('copy'):
+        return 'copy'
+    if 'fusion' in base:
+        return 'fusion'
+    if base.startswith(('infeed', 'outfeed')):
+        return 'infeed'
+    return base
+
+
+# jax records the originating module path in the HLO metadata op_name,
+# which the trace viewer surfaces per event (args key varies by version)
+def module_of(event: dict, depth: int = 1) -> Optional[str]:
+    """Model-module prefix (to ``depth`` path components) of one trace
+    event, from its source-path metadata; None when the event carries no
+    module path (CPU traces, runtime-internal ops)."""
+    args = event.get('args', {}) or {}
+    meta = ''
+    for k in _ARGS_KEYS:
+        v = args.get(k, '')
+        if isinstance(v, str) and '/' in v:
+            meta = v
+            break
+    if not meta:
+        return None
+    parts = [p for p in meta.split('/') if p and '=' not in p]
+    # drop transpose/jit wrappers so fwd and bwd of one module aggregate
+    parts = [p for p in parts if not p.startswith(('jit(', 'transpose('))]
+    if not parts:
+        return None
+    return '/'.join(parts[:depth])
+
+
+def load_trace_events(trace_dir: str) -> Tuple[List[dict],
+                                               Dict[Any, str]]:
+    """All complete ('X') events from the newest ``*.trace.json.gz``
+    under ``trace_dir``, plus the pid -> process-name map so device
+    tracks are findable."""
+    files = sorted(glob.glob(os.path.join(
+        trace_dir, '**', '*.trace.json.gz'), recursive=True),
+        key=os.path.getmtime)
+    if not files:
+        raise FileNotFoundError(f'no *.trace.json.gz under {trace_dir}')
+    with gzip.open(files[-1], 'rt') as f:
+        data = json.load(f)
+    events = data['traceEvents'] if isinstance(data, dict) else data
+    pid_names = {e.get('pid'): e.get('args', {}).get('name', '')
+                 for e in events
+                 if e.get('ph') == 'M' and e.get('name') == 'process_name'}
+    xevents = [e for e in events if e.get('ph') == 'X']
+    return xevents, pid_names
+
+
+def select_device_events(xevents: List[dict],
+                         pid_names: Dict[Any, str]
+                         ) -> Tuple[List[dict], bool]:
+    """The per-op device event line: (events, device_track_found).
+
+    TPU/GPU traces carry a device process track whose busiest thread
+    line is the per-HLO-op stream (the other lines are whole-step
+    container events — summing them would double-count every cycle).
+    The CPU backend has no device track; its op events are the ones
+    carrying HLO metadata args, spread over the client's executor
+    threads (all kept: with intra-op parallelism ops land on several
+    lines and none is a container).
+    """
+    device_pids = {pid for pid, name in pid_names.items()
+                   if 'TPU' in name or 'GPU' in name or '/device' in name}
+    if device_pids:
+        dev = [e for e in xevents if e.get('pid') in device_pids
+               and float(e.get('dur', 0)) > 0]
+        per_line = collections.Counter(
+            (e.get('pid'), e.get('tid')) for e in dev)
+        if per_line:
+            op_line = per_line.most_common(1)[0][0]
+            dev = [e for e in dev
+                   if (e.get('pid'), e.get('tid')) == op_line]
+        return dev, True
+    ops = [e for e in xevents
+           if float(e.get('dur', 0)) > 0
+           and any(k in (e.get('args') or {}) for k in _HLO_ARG_KEYS)]
+    return ops, False
+
+
+@dataclass
+class DeviceProfile:
+    """Parsed device-time attribution for one capture window.
+
+    Durations are microseconds (trace-viewer native); ``to_event`` and
+    the HTTP surfaces convert to ms.
+    """
+    window_us: float = 0.0                 # first op start -> last op end
+    busy_us: float = 0.0                   # summed op durations
+    n_ops: int = 0
+    device_track: bool = False             # real device track vs CPU ops
+    categories: Dict[str, float] = field(default_factory=dict)   # us
+    modules: Dict[str, float] = field(default_factory=dict)      # us
+    top_ops: List[Tuple[str, float]] = field(default_factory=list)
+    source: str = ''
+
+    @property
+    def busy_frac(self) -> float:
+        """Device busy time / capture window, clamped to 1.0 (CPU traces
+        with intra-op parallelism can sum ops past wall time)."""
+        if self.window_us <= 0:
+            return 0.0
+        return min(1.0, self.busy_us / self.window_us)
+
+    @property
+    def idle_us(self) -> float:
+        return max(0.0, self.window_us - self.busy_us)
+
+    @property
+    def attributed_frac(self) -> float:
+        """Share of busy time in a *named* bucket (category or opcode);
+        the complement is events whose name could not be parsed."""
+        if self.busy_us <= 0:
+            return 1.0
+        return 1.0 - self.categories.get('unattributed', 0.0) / self.busy_us
+
+    def to_event(self, **extra: Any) -> Dict[str, Any]:
+        """The structured ``profile`` event (segscope JSONL schema; also
+        the ``POST /debug/profile`` response body)."""
+        ev: Dict[str, Any] = {
+            'event': 'profile',
+            'window_ms': round(self.window_us / 1e3, 3),
+            'device_busy_ms': round(self.busy_us / 1e3, 3),
+            'idle_ms': round(self.idle_us / 1e3, 3),
+            'busy_frac': round(self.busy_frac, 4),
+            'attributed_frac': round(self.attributed_frac, 4),
+            'n_ops': self.n_ops,
+            'device_track': self.device_track,
+            'categories': {k: round(v / 1e3, 3)
+                           for k, v in sorted(self.categories.items(),
+                                              key=lambda kv: -kv[1])},
+            'modules': {k: round(v / 1e3, 3)
+                        for k, v in sorted(self.modules.items(),
+                                           key=lambda kv: -kv[1])[:12]},
+            'top_ops': [[n, round(us / 1e3, 3)]
+                        for n, us in self.top_ops[:5]],
+        }
+        ev.update(extra)
+        return ev
+
+
+def parse_trace(trace_dir: str, depth: int = 2) -> DeviceProfile:
+    """Parse the newest trace under ``trace_dir`` into a DeviceProfile.
+
+    ``depth`` is the module-path depth modules aggregate at (depth 1:
+    top-level scopes like ``backbone``; depth 2: ``backbone/conv2d_1``).
+    """
+    xevents, pid_names = load_trace_events(trace_dir)
+    ops, device_track = select_device_events(xevents, pid_names)
+    categories: collections.Counter = collections.Counter()
+    modules: collections.Counter = collections.Counter()
+    busy = 0.0
+    t0, t1 = float('inf'), float('-inf')
+    per_op: collections.Counter = collections.Counter()
+    for e in ops:
+        dur = float(e.get('dur', 0.0))
+        ts = float(e.get('ts', 0.0))
+        busy += dur
+        t0 = min(t0, ts)
+        t1 = max(t1, ts + dur)
+        name = e.get('name', '')
+        categories[categorize(name)] += dur
+        per_op[name or '(unnamed)'] += dur
+        mod = module_of(e, depth)
+        if mod is not None:
+            modules[mod] += dur
+    return DeviceProfile(
+        window_us=(t1 - t0) if ops else 0.0,
+        busy_us=busy, n_ops=len(ops), device_track=device_track,
+        categories=dict(categories), modules=dict(modules),
+        top_ops=per_op.most_common(8), source=trace_dir)
+
+
+# ---------------------------------------------------------------- capture
+class CaptureBusy(RuntimeError):
+    """A profiler capture is already in progress (the XLA profiler is a
+    process singleton; concurrent captures are serialized, not queued)."""
+
+
+#: one capture at a time, process-wide: shared by SampledProfiler and
+#: capture_window so the trainer's sampled captures and an operator's
+#: /debug/profile can never race each other's start/stop_trace
+_CAPTURE_LOCK = threading.Lock()
+
+
+def capture_window(duration_s: float, depth: int = 2,
+                   trace_dir: Optional[str] = None) -> DeviceProfile:
+    """Trace a bounded wall-clock window and parse it.
+
+    The calling thread sleeps for ``duration_s`` while other threads
+    keep dispatching device work (the live-traffic capture behind
+    ``POST /debug/profile``). The binary trace is deleted after parsing
+    unless the caller supplied ``trace_dir``. Raises :class:`CaptureBusy`
+    when another capture (sampled or on-demand) holds the profiler.
+    """
+    import jax
+    if not _CAPTURE_LOCK.acquire(blocking=False):
+        raise CaptureBusy('a profiler capture is already in progress')
+    tmp = trace_dir is None
+    target = trace_dir or tempfile.mkdtemp(prefix='segprof_')
+    try:
+        try:
+            os.makedirs(target, exist_ok=True)
+            jax.profiler.start_trace(target)
+            try:
+                time.sleep(max(0.0, float(duration_s)))
+            finally:
+                jax.profiler.stop_trace()
+        finally:
+            # the profiler is free once stop_trace ran — parsing (gunzip
+            # + full event walk, up to a 5s trace) happens outside the
+            # lock so a sampled-capture boundary, a stall-watchdog trace
+            # or a second /debug/profile isn't locked out meanwhile
+            _CAPTURE_LOCK.release()
+        return parse_trace(target, depth=depth)
+    finally:
+        if tmp:
+            shutil.rmtree(target, ignore_errors=True)
+
+
+class SampledProfiler:
+    """Continuous sampled on-device profiling for the trainer loop.
+
+    Every ``every`` completed steps the next ``iters`` iterations are
+    captured: the device is fenced (block_until_ready on the carried
+    state) so the window opens idle, the XLA profiler traces the
+    iterations, the device is fenced again, and the parsed breakdown is
+    emitted as ONE structured ``profile`` event into the segscope sink
+    (plus ``device_busy_frac`` / capture-counter updates on the live
+    MetricsRegistry). The binary trace is deleted after parsing — the
+    JSONL event *is* the artifact.
+
+    Guard-armed: the step's jit cache size is recorded when the window
+    opens; a capture during which the cache grew (a retrace paid its XLA
+    compile inside the window) is emitted flagged ``retraced: true`` and
+    consumers (report, CI gates) exclude it from attribution — compile
+    time must never masquerade as model-module device time.
+
+    Non-capture steps pay one integer compare per hook; a capture that
+    cannot start (profiler busy — e.g. config.profile_dir's one-off
+    trace is active — or jax absent) is skipped silently, never raised:
+    telemetry must not break the run.
+    """
+
+    def __init__(self, sink: Optional[EventSink], every: int,
+                 iters: int = 2, jitted: Any = None,
+                 registry: Any = None, depth: int = 2,
+                 logger: Any = None):
+        self.sink = sink
+        self.every = max(1, int(every))
+        self.iters = max(1, int(iters))
+        self.jitted = jitted
+        self.depth = depth
+        self.logger = logger
+        self.captures = 0
+        self._seq = 0                      # completed steps seen
+        self._active: Optional[dict] = None
+        self._disabled = False
+        self._g_busy = self._c_caps = None
+        if registry is not None:
+            self._g_busy = registry.gauge(
+                'device_busy_frac',
+                help='device busy fraction of the last profile capture')
+            self._c_caps = registry.counter(
+                'profile_captures_total',
+                help='sampled/on-demand profile captures completed')
+
+    def abort(self) -> None:
+        """Tear down a half-open capture window (a step raised between
+        the hooks): stop the trace, release the capture lock, delete the
+        partial trace. Safe to call when no window is open."""
+        a, self._active = self._active, None
+        if a is None:
+            return
+        try:
+            import jax
+            jax.profiler.stop_trace()
+        except Exception:   # noqa: BLE001 — best-effort teardown
+            pass
+        _CAPTURE_LOCK.release()
+        shutil.rmtree(a['dir'], ignore_errors=True)
+
+    # ------------------------------------------------------------- hooks
+    def before_step(self, state: Any) -> None:
+        """Call before dispatching a step; opens a capture window on the
+        cadence boundary (fence + start_trace)."""
+        if (self._active is not None or self._disabled or self._seq == 0
+                or self._seq % self.every):
+            return
+        if not _CAPTURE_LOCK.acquire(blocking=False):
+            return                         # /debug/profile capture running
+        trace_dir = None
+        try:
+            import jax
+            jax.block_until_ready(state)   # fence: window opens idle
+            trace_dir = tempfile.mkdtemp(prefix='segprof_train_')
+            jax.profiler.start_trace(trace_dir)
+        except Exception:   # noqa: BLE001 — another trace active / no jax
+            _CAPTURE_LOCK.release()
+            if trace_dir is not None:
+                shutil.rmtree(trace_dir, ignore_errors=True)
+            return
+        self._active = {'dir': trace_dir, 'remaining': self.iters,
+                        'cache0': _cache_size(self.jitted)
+                        if self.jitted is not None else None,
+                        't0': time.perf_counter(), 'step0': self._seq}
+
+    def after_step(self, state: Any, step: Optional[int] = None) -> None:
+        """Call after each completed step; closes the window once
+        ``iters`` captured iterations have run (fence + stop_trace +
+        parse + emit)."""
+        self._seq += 1
+        a = self._active
+        if a is None:
+            return
+        a['remaining'] -= 1
+        if a['remaining'] > 0:
+            return
+        self._close(state, step=step, captured=self.iters)
+
+    def finish(self, state: Any, step: Optional[int] = None) -> None:
+        """Close a window left open at the end of a loop (the cadence
+        boundary fell on the epoch's last steps). Emitted with the
+        actual captured iteration count — leaving the window open would
+        let validation/checkpoint work pollute the trace and hold the
+        capture lock across the whole val phase. Pass ``step`` so the
+        event keeps the step+iters window reconstruction intact (the
+        overhead-A/B protocol rebuilds capture membership from it)."""
+        a = self._active
+        if a is None:
+            return
+        captured = self.iters - a['remaining']
+        if captured <= 0:
+            self.abort()
+            return
+        self._close(state, step=step, captured=captured)
+
+    def _close(self, state: Any, step: Optional[int],
+               captured: int) -> None:
+        a, self._active = self._active, None
+        prof = None
+        try:
+            import jax
+            try:
+                jax.block_until_ready(state)   # fence: all windowed work
+            finally:                           # lands inside the trace
+                jax.profiler.stop_trace()
+        except Exception:   # noqa: BLE001 — never raise into the run
+            _CAPTURE_LOCK.release()
+            shutil.rmtree(a['dir'], ignore_errors=True)
+            if self.logger is not None:
+                self.logger.warning(
+                    'segprof: sampled capture failed to stop cleanly; '
+                    'sampled profiling disabled for this run')
+            self._disabled = True
+            return
+        _CAPTURE_LOCK.release()
+        try:
+            prof = parse_trace(a['dir'], depth=self.depth)
+        except Exception:   # noqa: BLE001 — unparseable trace
+            prof = None
+        finally:
+            shutil.rmtree(a['dir'], ignore_errors=True)
+        if prof is None:
+            return
+        self.captures += 1
+        retraced = False
+        if a['cache0'] is not None:
+            size = _cache_size(self.jitted)
+            retraced = size is not None and size > a['cache0']
+        wall_ms = (time.perf_counter() - a['t0']) * 1e3
+        if self._c_caps is not None:
+            self._c_caps.inc()
+            if not retraced:
+                self._g_busy.set(prof.busy_frac)
+        if self.sink is not None:
+            ev = prof.to_event(
+                source='sampled', iters=captured, retraced=retraced,
+                wall_ms=round(wall_ms, 3),
+                ms_per_iter=round(prof.busy_us / 1e3 / captured, 3))
+            if step is not None:
+                ev['step'] = step
+            self.sink.emit(ev)

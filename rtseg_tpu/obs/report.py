@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import glob
 import json
+import math
 import os
 from typing import Any, Dict, List, Optional
 
@@ -70,7 +71,111 @@ def load_events(path: str, last_run: bool = True) -> List[dict]:
     return sorted(events, key=lambda e: e.get('ts', 0.0))
 
 
-def summarize(events: List[dict]) -> Dict[str, Any]:
+#: the fixed segprof attribution categories surfaced as report/diff rows
+#: (other opcodes fold into the device section but don't get their own
+#: regression row); imported so a category added in profile.py can't
+#: silently miss its diff row (profile.py is jax-free, same as this file)
+from .profile import CATEGORIES as _DEVICE_CATEGORIES  # noqa: E402
+
+
+def load_roofline(path: str) -> Dict[str, Dict[str, float]]:
+    """Parse ``tools/roofline.py --json`` output (one JSON object per
+    line) into {model: row}; rows with an ``error`` key are dropped."""
+    out: Dict[str, Dict[str, float]] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if isinstance(row, dict) and 'model' in row \
+                    and 'error' not in row:
+                out[row['model']] = row
+    return out
+
+
+def _summarize_device(profs: List[dict], memory: Optional[dict],
+                      roofline: Optional[Dict[str, Dict[str, float]]],
+                      model: Optional[str]) -> Optional[Dict[str, Any]]:
+    """Aggregate segprof ``profile`` events (sampled + on-demand) into
+    the report's device section. Retraced captures are excluded upstream
+    — compile time must not read as model device time."""
+    if not profs:
+        return None
+    cat_ms: Dict[str, float] = {}
+    mod_ms: Dict[str, float] = {}
+    busy_ms = 0.0
+    window_ms = 0.0
+    iters = 0
+    it_busy_ms = 0.0                   # per-iter numerators: only from
+    it_cat_ms: Dict[str, float] = {}   # captures that carry `iters`
+    for e in profs:
+        busy_ms += float(e.get('device_busy_ms', 0.0))
+        window_ms += float(e.get('window_ms', 0.0))
+        n = int(e.get('iters', 0))
+        iters += n
+        if n:
+            it_busy_ms += float(e.get('device_busy_ms', 0.0))
+        for k, v in (e.get('categories') or {}).items():
+            cat_ms[k] = cat_ms.get(k, 0.0) + float(v)
+            if n:
+                it_cat_ms[k] = it_cat_ms.get(k, 0.0) + float(v)
+        for k, v in (e.get('modules') or {}).items():
+            mod_ms[k] = mod_ms.get(k, 0.0) + float(v)
+    busy_frac = min(1.0, busy_ms / window_ms) if window_ms > 0 else 0.0
+    unattr = cat_ms.get('unattributed', 0.0)
+    device: Dict[str, Any] = {
+        'captures': len(profs),
+        'busy_frac': busy_frac,
+        'device_busy_ms': round(busy_ms, 3),
+        'window_ms': round(window_ms, 3),
+        'attributed_frac': (1.0 - unattr / busy_ms) if busy_ms > 0
+        else 1.0,
+        'category_ms': {k: round(v, 3)
+                        for k, v in sorted(cat_ms.items(),
+                                           key=lambda kv: -kv[1])},
+        'category_shares': {k: round(v / busy_ms, 4)
+                            for k, v in sorted(cat_ms.items(),
+                                               key=lambda kv: -kv[1])
+                            if busy_ms > 0},
+        'top_modules': {k: round(v, 3)
+                        for k, v in sorted(mod_ms.items(),
+                                           key=lambda kv: -kv[1])[:8]},
+        # captured iterations (sampled captures carry `iters`; on-demand
+        # /debug/profile windows don't — they contribute to the totals
+        # above but must stay out of every per-iteration number, whose
+        # denominator only counts sampled iterations)
+        'iters': iters,
+        'ms_per_iter': round(it_busy_ms / iters, 3) if iters else None,
+        'category_ms_per_iter': (
+            {k: round(v / iters, 4)
+             for k, v in sorted(it_cat_ms.items(),
+                                key=lambda kv: -kv[1])}
+            if iters else None),
+    }
+    if memory and isinstance(memory.get('peak_bytes_in_use'),
+                             (int, float)):
+        device['peak_hbm_bytes'] = int(memory['peak_bytes_in_use'])
+    # measured MFU = device busy fraction x the analytical roofline
+    # ceiling for this model (tools/roofline.py --json): the busy
+    # fraction is what the chip actually ran, the ceiling is the best
+    # MFU those ops could reach — their product is the honest measured
+    # utilization of peak FLOPs (BENCHMARKS.md "Roofline analysis")
+    row = (roofline or {}).get(model or '')
+    if row:
+        ceiling = row.get('lane_adj_ceiling_mfu', row.get('ceiling_mfu'))
+        if ceiling is not None:
+            device['ceiling_mfu'] = float(ceiling)
+            device['measured_mfu'] = round(busy_frac * float(ceiling), 4)
+    return device
+
+
+def summarize(events: List[dict],
+              roofline: Optional[Dict[str, Dict[str, float]]] = None
+              ) -> Dict[str, Any]:
     hosts = sorted({e.get('host', 0) for e in events})
     h0 = hosts[0] if hosts else 0
 
@@ -187,6 +292,27 @@ def summarize(events: List[dict]) -> Dict[str, Any]:
     cache_hit_rate = hits / (hits + misses) if (hits + misses) else None
     memory = next((e for e in reversed(events)
                    if e.get('event') == 'memory' and mine(e)), None)
+    # segprof: sampled/on-demand device-time attribution. Retraced
+    # captures (jit cache grew mid-window) are excluded — their windows
+    # contain XLA compile time masquerading as op time.
+    profs = [e for e in events if e.get('event') == 'profile'
+             and mine(e) and not e.get('retraced')]
+    device = _summarize_device(profs, memory, roofline,
+                               (start or {}).get('model'))
+
+    # flat per-category rows for diff_table (ms per captured iteration —
+    # comparable across runs with different capture counts)
+    dev_flat: Dict[str, Optional[float]] = {
+        f'dev_{cat}_ms': None for cat in _DEVICE_CATEGORIES}
+    dev_flat['device_busy_frac'] = None
+    dev_flat['peak_hbm_bytes'] = None
+    if device is not None:
+        dev_flat['device_busy_frac'] = device['busy_frac']
+        dev_flat['peak_hbm_bytes'] = device.get('peak_hbm_bytes')
+        per_iter = device.get('category_ms_per_iter')
+        if per_iter is not None:
+            for cat in _DEVICE_CATEGORIES:
+                dev_flat[f'dev_{cat}_ms'] = per_iter.get(cat, 0.0)
 
     return {
         'run': {k: v for k, v in (start or {}).items()
@@ -221,6 +347,9 @@ def summarize(events: List[dict]) -> Dict[str, Any]:
         # flattened for diff_table's flat-key rows
         'serve_p99_ms': serving['e2e_p99_ms'] if serving else None,
         'serve_rps': serving['rps'] if serving else None,
+        'device': device,
+        'profile_captures': len(profs),
+        **dev_flat,
         'spans': spans,
         'memory': ({k: v for k, v in memory.items()
                     if k not in ('event', 'ts', 'host')}
@@ -293,6 +422,31 @@ def format_summary(s: Dict[str, Any], path: str = '') -> str:
                 f'  batching       : {sv["batches"]} batches | mean size '
                 f'{sv["mean_batch"]:.1f} | occupancy '
                 f'{100 * sv["occupancy"]:.0f}%')
+    if s.get('device'):
+        dv = s['device']
+        per_iter = (f' | {dv["ms_per_iter"]:.1f} device-ms/iter'
+                    if dv.get('ms_per_iter') is not None else '')
+        lines.append(
+            f'  device         : busy {100 * dv["busy_frac"]:.1f}% over '
+            f'{dv["captures"]} capture(s) | attributed '
+            f'{100 * dv["attributed_frac"]:.1f}%{per_iter}')
+        shares = dv.get('category_shares') or {}
+        if shares:
+            lines.append('  device categories: ' + ' | '.join(
+                f'{k} {100 * v:.1f}%'
+                for k, v in list(shares.items())[:7]))
+        mods = dv.get('top_modules') or {}
+        if mods:
+            lines.append('  top modules    : ' + '; '.join(
+                f'{k} {v:.1f}ms' for k, v in list(mods.items())[:5]))
+        if dv.get('measured_mfu') is not None:
+            lines.append(
+                f'  measured MFU   : {100 * dv["measured_mfu"]:.1f}% '
+                f'(busy {100 * dv["busy_frac"]:.1f}% x roofline ceiling '
+                f'{100 * dv["ceiling_mfu"]:.1f}%)')
+        if dv.get('peak_hbm_bytes') is not None:
+            lines.append(f'  peak HBM       : '
+                         f'{dv["peak_hbm_bytes"] / 2**20:.0f} MiB')
     if s.get('memory'):
         mem = s['memory']
         parts = [f'{k}={v / 2**20:.0f}MiB' for k, v in mem.items()
@@ -323,29 +477,74 @@ _DIFF_ROWS = (
     # serving rows (None — rendered as '—' — for training-only runs)
     ('serve_p99_ms', 'serve p99 (ms)', 1.0, False),
     ('serve_rps', 'serve RPS', 1.0, True),
+    # segprof device-attribution rows: busy fraction (higher = the chip
+    # is actually working) and per-category device ms per captured
+    # iteration (a collective/copy share creeping up shows here — the
+    # quantization/autoscaling consumers in ROADMAP items 1-2)
+    ('device_busy_frac', 'device busy (%)', 100.0, True),
+    # one row per profile.CATEGORIES entry — derived, so a category
+    # added there gets its regression row (and --check gate) for free
+    *((f'dev_{cat}_ms', f'dev {cat} (ms/iter)', 1.0, False)
+      for cat in _DEVICE_CATEGORIES),
+    ('peak_hbm_bytes', 'peak HBM (MiB)', 1.0 / 2**20, False),
 )
 
 #: relative change beyond which a worse metric is labeled a regression
 _REGRESSION_THRESHOLD = 0.05
 
+#: absolute floor (in row units, post-scale) under which a device-ms row
+#: can't regress: +5% of 0.02 ms is profiler noise, not a regression
+_DEVICE_MS_FLOOR = 0.5
 
-def diff_table(a: Dict[str, Any], b: Dict[str, Any]) -> str:
-    """Markdown regression table comparing run A (baseline) to run B."""
-    lines = ['| metric | A | B | delta |', '|---|---|---|---|']
+
+def diff_rows(a: Dict[str, Any], b: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Per-metric comparison rows for A (baseline) vs B: ``{key, label,
+    a, b, delta, regressed}``; values are None when either run lacks the
+    metric. The machine-readable half of :func:`diff_table` — ``segscope
+    diff --check`` gates on any ``regressed`` row."""
+    rows: List[Dict[str, Any]] = []
     for key, label, scale, higher_better in _DIFF_ROWS:
         va, vb = a.get(key), b.get(key)
         if va is None or vb is None:
-            lines.append(f'| {label} | — | — | — |')
+            rows.append({'key': key, 'label': label, 'a': None, 'b': None,
+                         'delta': None, 'regressed': False})
             continue
         va, vb = scale * va, scale * vb
         if va:
             rel = (vb - va) / abs(va)
-            delta = f'{100 * rel:+.1f}%'
         else:
             rel = 0.0 if vb == 0 else float('inf')
-            delta = '+inf' if rel else '0%'
         worse = rel > _REGRESSION_THRESHOLD if not higher_better \
             else rel < -_REGRESSION_THRESHOLD
-        mark = ' REGRESSED' if worse else ''
-        lines.append(f'| {label} | {va:.2f} | {vb:.2f} | {delta}{mark} |')
+        if worse and key.startswith('dev_') \
+                and max(abs(va), abs(vb)) < _DEVICE_MS_FLOOR:
+            worse = False          # sub-floor category: profiler noise
+        rows.append({'key': key, 'label': label,
+                     'a': round(va, 4), 'b': round(vb, 4),
+                     # json.dumps renders float('inf') as the non-RFC
+                     # token `Infinity`, so a 0 -> nonzero jump carries
+                     # the same string diff_table prints
+                     'delta': rel if math.isfinite(rel) else '+inf',
+                     'regressed': worse})
+    return rows
+
+
+def diff_table(a: Dict[str, Any], b: Dict[str, Any],
+               rows: Optional[List[Dict[str, Any]]] = None) -> str:
+    """Markdown regression table comparing run A (baseline) to run B.
+    Pass precomputed ``rows`` (from :func:`diff_rows`) so the table and
+    a ``--check`` verdict derive from the same comparison."""
+    lines = ['| metric | A | B | delta |', '|---|---|---|---|']
+    for row in (diff_rows(a, b) if rows is None else rows):
+        if row['a'] is None or row['b'] is None:
+            lines.append(f'| {row["label"]} | — | — | — |')
+            continue
+        rel = row['delta']
+        if isinstance(rel, str):           # '+inf' from diff_rows
+            delta = rel
+        else:
+            delta = f'{100 * rel:+.1f}%'
+        mark = ' REGRESSED' if row['regressed'] else ''
+        lines.append(f'| {row["label"]} | {row["a"]:.2f} | '
+                     f'{row["b"]:.2f} | {delta}{mark} |')
     return '\n'.join(lines)

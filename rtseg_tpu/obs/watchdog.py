@@ -23,7 +23,6 @@ worse than the hangs it reports.
 from __future__ import annotations
 
 import collections
-import os
 import statistics
 import sys
 import threading
@@ -126,12 +125,35 @@ class StallWatchdog:
               step: Optional[int]) -> None:
         self.stall_count += 1
         stacks = dump_all_stacks()
-        trace_dir = self._try_trace()
+        # segprof: a short trace of the stalled window, auto-parsed so
+        # the stall event itself names what the device was doing (a
+        # stalled collective reads as `all-reduce.N` right in the event
+        # instead of a raw trace dir needing TensorBoard archaeology).
+        # capture_window owns the whole capture discipline — the shared
+        # non-blocking lock (CaptureBusy while a sampled/on-demand
+        # capture runs: stacks still land, trace skipped), start/stop
+        # pairing (start_trace failing against e.g. the trainer's own
+        # profile_dir trace never stops a trace we didn't start), and
+        # release-before-parse. Best-effort: any failure keeps the run
+        # alive with a trace-less event.
+        trace_dir = None
+        top_ops = None
+        if self.trace_dir:
+            try:
+                from .profile import capture_window
+                prof = capture_window(self.trace_len_s,
+                                      trace_dir=self.trace_dir)
+                trace_dir = self.trace_dir
+                top_ops = [{'name': n, 'ms': round(us / 1e3, 3)}
+                           for n, us in prof.top_ops[:5]]
+            except Exception:   # noqa: BLE001 — best-effort enrichment
+                pass
         if self.sink is not None:
             self.sink.emit({'event': 'stall', 'step': step,
                             'elapsed_s': round(elapsed, 3),
                             'deadline_s': round(deadline, 3),
-                            'stacks': stacks, 'trace_dir': trace_dir})
+                            'stacks': stacks, 'trace_dir': trace_dir,
+                            'top_device_ops': top_ops})
         if self.logger is not None:
             self.logger.error(
                 f'segscope: no step heartbeat for {elapsed:.1f}s '
@@ -139,30 +161,3 @@ class StallWatchdog:
                 f'event written'
                 + (f', profiler trace in {trace_dir}' if trace_dir else ''))
 
-    def _try_trace(self) -> Optional[str]:
-        """Short profiler trace of the stalled window; None on any failure
-        (no jax, a user trace already active, backend wedged solid).
-
-        stop_trace is only ever called for a trace THIS method started: if
-        start_trace raises (e.g. the trainer's own config.profile_dir
-        trace is active), bailing out without a stop keeps the user's
-        trace alive — stopping it here would make the trainer's later
-        stop_trace raise into the run."""
-        if not self.trace_dir:
-            return None
-        try:
-            import jax
-            os.makedirs(self.trace_dir, exist_ok=True)
-            jax.profiler.start_trace(self.trace_dir)
-        except Exception:   # noqa: BLE001 — not our trace to stop
-            return None
-        try:
-            time.sleep(self.trace_len_s)
-            jax.profiler.stop_trace()
-            return self.trace_dir
-        except Exception:   # noqa: BLE001
-            try:
-                jax.profiler.stop_trace()
-            except Exception:   # noqa: BLE001
-                pass
-            return None

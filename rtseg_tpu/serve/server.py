@@ -16,7 +16,16 @@ API:
   * ``GET /stats`` — live JSON straight off the pipeline's metrics
     registry (counters + online request percentiles + engine state).
   * ``GET /metrics`` — the same registry as Prometheus text exposition
-    (counters, gauges, histograms with sliding-window p50/p95/p99).
+    (counters, gauges, histograms with sliding-window p50/p95/p99;
+    device memory watermarks are refreshed per scrape on backends that
+    report them).
+  * ``POST /debug/profile?ms=N`` — segprof on-demand capture: traces the
+    device for N ms (clamped to [10, 5000]) *under live traffic* and
+    returns the parsed breakdown as JSON (per-category/per-module device
+    time, busy fraction, idle, top ops — obs/profile.py). Captures are
+    serialized: one at a time process-wide, 409 while another capture
+    (on-demand or a trainer's sampled window) is in flight. The capture
+    is passive — requests keep flowing; it never drops or rejects.
 
 Tracing: every request gets a trace id at ingress — an inbound
 ``X-Trace-Id`` header is honored (well-formed hex only) so upstream
@@ -31,13 +40,17 @@ from __future__ import annotations
 import concurrent.futures
 import io
 import json
+import math
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 import numpy as np
 
+from ..obs import get_sink
+from ..obs.core import update_memory_gauges
 from ..obs.metrics import render_prometheus
+from ..obs.profile import CaptureBusy, capture_window
 from ..obs.tracing import (TRACE_HEADER, TRACE_KEY, new_trace_id,
                            valid_trace_id)
 from .batcher import ServeDrop, ServeReject
@@ -97,8 +110,12 @@ class _Handler(BaseHTTPRequestHandler):
         if path == '/healthz':
             self._send_json(200, {'ok': True})
         elif path == '/stats':
+            update_memory_gauges(self.server.pipeline.registry)
             self._send_json(200, self.server.pipeline.stats())
         elif path == '/metrics':
+            # refresh the device memory watermarks at scrape time so
+            # peak HBM is current, not an epoch/capture stale-read
+            update_memory_gauges(self.server.pipeline.registry)
             text = render_prometheus(self.server.pipeline.registry)
             self._send(200, text.encode(),
                        'text/plain; version=0.0.4; charset=utf-8')
@@ -118,6 +135,9 @@ class _Handler(BaseHTTPRequestHandler):
         inbound = self.headers.get(TRACE_HEADER)
         tid = inbound if valid_trace_id(inbound) else new_trace_id()
         trace_hdr = {TRACE_HEADER: tid}
+        if path == '/debug/profile':
+            self._debug_profile(trace_hdr)
+            return
         if path not in ('/', '/predict'):
             self._send_json(404, {'error': f'no route {path}'},
                             trace_hdr)
@@ -170,6 +190,49 @@ class _Handler(BaseHTTPRequestHandler):
         Image.fromarray(cmap[res.mask]).save(buf, format='PNG')
         self._send(200, buf.getvalue(), 'image/png',
                    {'X-Serve-Timing': timing, **trace_hdr})
+
+    def _debug_profile(self, trace_hdr: dict) -> None:
+        """segprof on-demand capture under live traffic (obs/profile.py
+        capture_window): trace for ?ms= wall-clock, return the parsed
+        JSON breakdown. One capture at a time (409 when busy), duration
+        bounded so a fat-fingered request can't trace for minutes."""
+        query = urllib.parse.parse_qs(
+            urllib.parse.urlsplit(self.path).query)
+        try:
+            ms = float(query.get('ms', ['100'])[0])
+        except ValueError:
+            ms = float('nan')
+        if not math.isfinite(ms):
+            # NaN slips through min/max clamping (comparisons are False)
+            # and would serialize as invalid JSON in the response
+            self._send_json(400, {'error': 'ms must be a finite number'},
+                            trace_hdr)
+            return
+        ms = min(max(ms, 10.0), 5000.0)
+        reg = self.server.pipeline.registry
+        try:
+            prof = capture_window(ms / 1e3)
+        except CaptureBusy as e:
+            self._send_json(409, {'error': str(e)}, trace_hdr)
+            return
+        except Exception as e:   # noqa: BLE001 — surface, don't hang
+            self._send_json(500, {'error': f'{type(e).__name__}: {e}'},
+                            trace_hdr)
+            return
+        # the same live-plane metrics the sampled profiler feeds, so a
+        # /metrics scrape reconciles against this response's busy_frac
+        reg.counter('profile_captures_total',
+                    help='sampled/on-demand profile captures '
+                         'completed').inc()
+        reg.gauge('device_busy_frac',
+                  help='device busy fraction of the last profile '
+                       'capture').set(prof.busy_frac)
+        update_memory_gauges(reg)
+        ev = prof.to_event(source='debug', requested_ms=ms)
+        sink = get_sink()
+        if sink is not None:
+            sink.emit(ev)
+        self._send_json(200, ev, trace_hdr)
 
 
 def make_server(pipeline: ServePipeline, host: str = '127.0.0.1',

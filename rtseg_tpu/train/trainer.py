@@ -31,8 +31,8 @@ from ..config import SegConfig
 from ..data import get_loader, get_test_loader
 from ..models import get_model, get_teacher_model
 from .. import obs
-from ..obs import (MetricsRegistry, StallWatchdog, StepCollector,
-                   emit_memory, span)
+from ..obs import (MetricsRegistry, SampledProfiler, StallWatchdog,
+                   StepCollector, emit_memory, span, update_memory_gauges)
 from ..parallel import (batch_sharding, data_sharding, init_multihost,
                         main_rank, make_global_array, make_mesh, replicated)
 from ..utils import (TBWriter, get_colormap, get_logger, iou_from_cm,
@@ -73,6 +73,7 @@ class SegTrainer:
         self.epoch_losses = []             # mean loss per trained epoch
         self._obs_sink = None              # segscope sink (training only)
         self._watchdog = None              # stall watchdog (run() scope)
+        self._profiler = None              # segprof sampler (run() scope)
         # live metrics plane (segtrace): the step collectors feed this
         # registry so step time / data-wait / goodput are queryable
         # mid-run by any in-process consumer (obs.metrics.get_registry()
@@ -323,6 +324,15 @@ class SegTrainer:
                            if cfg.obs_stall_trace else None),
                 logger=self.logger)
             self._watchdog.start()
+        if self._obs_sink is not None and cfg.profile_every > 0:
+            # segprof sampled profiling: every profile_every train steps
+            # capture profile_capture_iters fenced iterations and emit
+            # the parsed device-time breakdown as a 'profile' event
+            self._profiler = SampledProfiler(
+                self._obs_sink, every=cfg.profile_every,
+                iters=cfg.profile_capture_iters,
+                jitted=introspectable(self.train_step),
+                registry=self.metrics, logger=self.logger)
         try:
             for epoch in range(self.cur_epoch, cfg.total_epoch):
                 self.cur_epoch = epoch
@@ -348,6 +358,11 @@ class SegTrainer:
             try:
                 self._ckpt_writer.join()
             finally:
+                if self._profiler is not None:
+                    # a step that raised mid-capture leaves the profiler
+                    # window half-open; tear it down before the sink goes
+                    self._profiler.abort()
+                    self._profiler = None
                 if self._watchdog is not None:
                     self._watchdog.stop()
                     self._watchdog = None
@@ -398,11 +413,21 @@ class SegTrainer:
         step0 = int(self.state.step)
         tb_buf = []
         tb_every = cfg.log_interval if cfg.log_interval > 0 else 50
+        # segprof sampled captures stand down while the one-off
+        # profile_dir trace owns the profiler (epoch 0, every rank); the
+        # shared capture lock would skip them anyway — this skips the
+        # fence too
+        sampler = (self._profiler
+                   if not (cfg.profile_dir is not None
+                           and self.cur_epoch == 0)
+                   else None)
         batches = self._batches(self.train_loader)
         try:
             for i, batch in enumerate(col.wrap(batches)):
                 if profiling and i == 1:      # skip the compile step
                     jax.profiler.start_trace(cfg.profile_dir)
+                if sampler is not None:
+                    sampler.before_step(self.state)
                 with span('train/dispatch', record=False):
                     self.state, metrics = self.train_step(self.state,
                                                           *batch)
@@ -410,6 +435,8 @@ class SegTrainer:
                     else loss_sum + metrics['loss']
                 n_steps += 1
                 col.end_step(step=step0 + n_steps)
+                if sampler is not None:
+                    sampler.after_step(self.state, step=step0 + n_steps)
                 if profiling and i == cfg.profile_steps:
                     jax.block_until_ready(self.state.params)
                     jax.profiler.stop_trace()
@@ -441,6 +468,12 @@ class SegTrainer:
             close = getattr(batches, 'close', None)
             if close is not None:
                 close()
+        if sampler is not None:
+            # a window opened on the epoch's last steps must not stay
+            # open across validation/checkpointing (it would pollute the
+            # trace and hold the capture lock); emit it with the
+            # iterations it actually captured
+            sampler.finish(self.state, step=step0 + n_steps)
         if profiling:                         # epoch shorter than the window
             jax.profiler.stop_trace()
         if metrics is None:
@@ -461,6 +494,9 @@ class SegTrainer:
                 'step_s': round(col.total_dur, 3),
                 'compile_s': round(col.compile_s, 3)})
             emit_memory(self._obs_sink)
+        # device memory watermarks onto the live plane (no-op on
+        # backends without memory_stats, e.g. CPU)
+        update_memory_gauges(self.metrics)
 
     def _flush_tb(self, buf) -> None:
         """Write buffered (step, metrics) pairs to TensorBoard with ONE

@@ -1,0 +1,738 @@
+"""segprof (rtseg_tpu/obs/profile.py): the device-time attribution plane.
+
+Parser goldens against the committed synthetic trace fixture
+(tests/data/segprof_golden.trace.json.gz), op-category classification,
+CPU-trace fallback selection, the sampled profiler's event schema +
+retrace guard, capture serialization (one at a time, CaptureBusy),
+the serve front-end's POST /debug/profile (incl. 409 on a concurrent
+capture), device memory gauges, the report/diff device section with
+measured-MFU + per-category regression rows + --check gating, and the
+`segscope live` device frames in sink and /metrics modes.
+
+All CPU-fast; the full-trainer sampled-profiling e2e rides behind
+`slow` (its scenario is also the CI segscope job's gate)."""
+
+import gzip
+import io
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from rtseg_tpu.config import SegConfig
+from rtseg_tpu.obs.core import EventSink, update_memory_gauges
+from rtseg_tpu.obs.live import (MetricsPoller, SinkTailer, check_frame,
+                                format_frame)
+from rtseg_tpu.obs.metrics import MetricsRegistry, render_prometheus
+from rtseg_tpu.obs.profile import (_CAPTURE_LOCK, CaptureBusy,
+                                   SampledProfiler, capture_window,
+                                   categorize, module_of, parse_trace)
+from rtseg_tpu.obs.report import (diff_rows, diff_table, format_summary,
+                                  load_roofline, summarize)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE_DIR = os.path.join(REPO, 'tests', 'data')
+SEGSCOPE = os.path.join(REPO, 'tools', 'segscope.py')
+
+
+# ----------------------------------------------------------------- parser
+def test_categorize_covers_the_canonical_families():
+    assert categorize('convolution.8') == 'conv'
+    assert categorize('conv_general_dilated') == 'conv'
+    assert categorize('dot.6') == 'matmul'
+    assert categorize('custom-call-gemm.2') == 'matmul'
+    assert categorize('all-reduce.3') == 'collective'
+    assert categorize('all-gather.1') == 'collective'
+    assert categorize('reduce-scatter.9') == 'collective'
+    assert categorize('copy.5') == 'copy'
+    assert categorize('copy-start.1') == 'copy'
+    assert categorize('fusion.12') == 'fusion'
+    assert categorize('loop_fusion.4') == 'fusion'
+    assert categorize('infeed.1') == 'infeed'
+    assert categorize('outfeed') == 'infeed'
+    # anything else lands in a NAMED opcode bucket, never 'unknown'
+    assert categorize('tanh.2') == 'tanh'
+    assert categorize('reduce-window.7') == 'reduce-window'
+    # dtype casts must NOT inflate conv (bf16 traces are full of them)
+    assert categorize('convert.3') == 'convert'
+    # only an unparseable name is unattributed
+    assert categorize('%') == 'unattributed'
+    assert categorize('') == 'unattributed'
+
+
+def test_parse_trace_golden_fixture():
+    """The committed synthetic TPU-style trace has hand-computed device
+    times: 7 ops, 310us busy over a 400us window, with host events and
+    the whole-step container line excluded from attribution."""
+    p = parse_trace(FIXTURE_DIR, depth=1)
+    assert p.device_track and p.n_ops == 7
+    assert p.window_us == pytest.approx(400.0)
+    assert p.busy_us == pytest.approx(310.0)
+    assert p.busy_frac == pytest.approx(0.775)
+    assert p.idle_us == pytest.approx(90.0)
+    assert p.categories == {
+        'conv': 100.0, 'fusion': 80.0, 'matmul': 50.0, 'collective': 30.0,
+        'copy': 20.0, 'infeed': 10.0, 'unattributed': 20.0}
+    assert p.attributed_frac == pytest.approx(1 - 20.0 / 310.0)
+    # module aggregation from the long_name source paths (jit()/
+    # transpose() wrappers dropped so fwd+bwd of one module merge)
+    assert p.modules == {'backbone': 130.0, 'head': 130.0}
+    p2 = parse_trace(FIXTURE_DIR, depth=2)
+    assert p2.modules == {'backbone/conv2d_1': 100.0, 'head/fusion': 80.0,
+                          'head/dense_0': 50.0, 'backbone/psum': 30.0}
+    assert p.top_ops[0] == ('convolution.1', 100.0)
+    ev = p.to_event(source='test')
+    assert ev['event'] == 'profile' and ev['source'] == 'test'
+    assert ev['device_busy_ms'] == pytest.approx(0.31)
+    assert ev['busy_frac'] == pytest.approx(0.775)
+    assert ev['categories']['conv'] == pytest.approx(0.1)
+
+
+def test_module_of_drops_wrappers_and_params():
+    e = {'args': {'long_name':
+                  'jit(train_step)/transpose(jvp)/backbone/conv/'
+                  'conv_general_dilated/padding=SAME'}}
+    assert module_of(e, 1) == 'backbone'
+    assert module_of(e, 2) == 'backbone/conv'
+    assert module_of({'args': {'hlo_op': 'dot.6'}}, 1) is None
+
+
+def test_parse_trace_cpu_fallback_selects_hlo_events(tmp_path):
+    """The CPU backend has no device process track; op events are the
+    ones carrying HLO metadata args — python host events must not leak
+    into the busy accounting."""
+    events = [
+        {'ph': 'M', 'pid': 7, 'name': 'process_name',
+         'args': {'name': '/host:CPU'}},
+        # python line: huge host-side event, NO hlo args -> excluded
+        {'ph': 'X', 'pid': 7, 'tid': 1, 'ts': 0.0, 'dur': 5000.0,
+         'name': 'PjitFunction(f)'},
+        # XLA executor line: op events with hlo args
+        {'ph': 'X', 'pid': 7, 'tid': 2, 'ts': 100.0, 'dur': 60.0,
+         'name': 'dot.1', 'args': {'hlo_module': 'jit_f',
+                                   'hlo_op': 'dot.1'}},
+        {'ph': 'X', 'pid': 7, 'tid': 2, 'ts': 180.0, 'dur': 40.0,
+         'name': 'convolution.2', 'args': {'hlo_module': 'jit_f',
+                                           'hlo_op': 'convolution.2'}},
+    ]
+    with gzip.open(tmp_path / 'vm.trace.json.gz', 'wt') as f:
+        json.dump({'traceEvents': events}, f)
+    p = parse_trace(str(tmp_path))
+    assert not p.device_track and p.n_ops == 2
+    assert p.busy_us == pytest.approx(100.0)
+    assert p.window_us == pytest.approx(120.0)
+    assert p.categories == {'matmul': 60.0, 'conv': 40.0}
+    assert p.attributed_frac == 1.0
+
+
+def test_parse_trace_missing_dir(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        parse_trace(str(tmp_path / 'nope'))
+
+
+# --------------------------------------------------------------- captures
+@pytest.fixture(scope='module')
+def jitted_work():
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def f(x):
+        return jnp.tanh(x @ x).sum()
+
+    x = jnp.ones((128, 128), jnp.float32)
+    f(x).block_until_ready()               # compile outside any capture
+    return f, x
+
+
+def test_capture_window_parses_live_work_and_serializes(jitted_work):
+    f, x = jitted_work
+
+    stop = threading.Event()
+
+    def spin():
+        while not stop.is_set():
+            f(x).block_until_ready()
+
+    t = threading.Thread(target=spin, daemon=True)
+    t.start()
+    try:
+        prof = capture_window(0.2)
+    finally:
+        stop.set()
+        t.join(timeout=5)
+    assert prof.n_ops > 0 and prof.busy_us > 0
+    assert 0 < prof.busy_frac <= 1.0
+    assert prof.attributed_frac >= 0.9     # no silent unknown bucket
+    assert 'matmul' in prof.categories
+    # one capture at a time, process-wide
+    assert _CAPTURE_LOCK.acquire(blocking=False)
+    try:
+        with pytest.raises(CaptureBusy):
+            capture_window(0.01)
+    finally:
+        _CAPTURE_LOCK.release()
+
+
+def test_sampled_profiler_event_schema_and_cadence(tmp_path, jitted_work):
+    """every=2, iters=1: captures open exactly on the cadence boundary,
+    emit one schema-complete `profile` event each, feed the live gauges,
+    and leave no trace dirs behind."""
+    f, x = jitted_work
+    p = str(tmp_path / 'events-000.jsonl')
+    sink = EventSink(p, static={'host': 0})
+    reg = MetricsRegistry()
+    sp = SampledProfiler(sink, every=2, iters=1, jitted=f, registry=reg)
+    for step in range(1, 7):               # 6 steps -> captures at 3, 5
+        sp.before_step(x)
+        out = f(x)
+        out.block_until_ready()
+        sp.after_step(out, step=step)
+    sink.close()
+    evs = [json.loads(line) for line in open(p)]
+    profs = [e for e in evs if e['event'] == 'profile']
+    # windows open before steps 3 and 5 (after 2 resp. 4 completed
+    # steps); step 1's would-be window is skipped (compile-step guard)
+    assert len(profs) == sp.captures == 2
+    assert [e['step'] for e in profs] == [3, 5]
+    assert not _CAPTURE_LOCK.locked()
+    for e in profs:
+        for key in ('window_ms', 'device_busy_ms', 'idle_ms', 'busy_frac',
+                    'attributed_frac', 'n_ops', 'categories', 'modules',
+                    'top_ops', 'iters', 'retraced', 'ms_per_iter',
+                    'source', 'step'):
+            assert key in e, key
+        assert e['source'] == 'sampled' and e['iters'] == 1
+        assert not e['retraced']
+        assert 0 < e['busy_frac'] <= 1.0
+        assert e['attributed_frac'] >= 0.9
+        assert e['device_busy_ms'] > 0
+    snap = reg.snapshot()
+    assert snap['profile_captures_total'] == 2
+    assert 0 < snap['device_busy_frac'] <= 1.0
+
+
+def test_sampled_profiler_flags_retrace(tmp_path):
+    """A capture window during which the step's jit cache grew is
+    flagged `retraced` — compile time must not read as device time."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def g(x):
+        return (x * 2).sum()
+
+    x = jnp.ones((8, 8))
+    g(x).block_until_ready()
+    p = str(tmp_path / 'events-000.jsonl')
+    sink = EventSink(p, static={'host': 0})
+    sp = SampledProfiler(sink, every=1, iters=1, jitted=g)
+    sp.before_step(x)                      # no window: seq == 0
+    g(x).block_until_ready()
+    sp.after_step(x, step=1)
+    sp.before_step(x)                      # window opens
+    y = jnp.ones((4, 4))
+    g(y).block_until_ready()               # new shape -> retrace inside
+    sp.after_step(y, step=2)
+    sink.close()
+    profs = [json.loads(line) for line in open(p)]
+    profs = [e for e in profs if e['event'] == 'profile']
+    assert len(profs) == 1 and profs[0]['retraced'] is True
+    assert not _CAPTURE_LOCK.locked()
+
+
+def test_sampled_profiler_finish_closes_partial_window(tmp_path,
+                                                       jitted_work):
+    """A window still open when the loop ends (cadence boundary on the
+    last steps) is closed by finish() with the iterations it actually
+    captured — never left open across validation, never lock-held."""
+    f, x = jitted_work
+    p = str(tmp_path / 'events-000.jsonl')
+    sink = EventSink(p, static={'host': 0})
+    sp = SampledProfiler(sink, every=2, iters=4, jitted=f)
+    for step in (1, 2, 3):                 # window opens before step 3
+        sp.before_step(x)
+        f(x).block_until_ready()
+        sp.after_step(x, step=step)
+    assert sp._active is not None          # 3 of 4 iters still pending
+    sp.finish(x, step=3)
+    assert sp._active is None and not _CAPTURE_LOCK.locked()
+    sink.close()
+    profs = [json.loads(line) for line in open(p)]
+    profs = [e for e in profs if e['event'] == 'profile']
+    assert len(profs) == 1 and profs[0]['iters'] == 1
+    # the event keeps the step so step+iters window reconstruction
+    # (the overhead-A/B protocol) covers finish()-closed windows too
+    assert profs[0]['step'] == 3
+    assert profs[0]['device_busy_ms'] > 0
+    # a window that captured zero iterations is aborted, not emitted
+    sp2 = SampledProfiler(None, every=1, iters=2, jitted=f)
+    sp2._seq = 1
+    sp2.before_step(x)
+    assert sp2._active is not None
+    sp2.finish(x)
+    assert sp2._active is None and not _CAPTURE_LOCK.locked()
+
+
+def test_watchdog_stall_gains_top_device_ops_and_respects_lock(tmp_path):
+    """The stall event carries the parsed top_device_ops field from its
+    auto-dumped trace; while another capture holds the profiler the
+    watchdog skips the trace (stacks still land) instead of racing it."""
+    from rtseg_tpu.obs.watchdog import StallWatchdog
+    p = str(tmp_path / 'events-000.jsonl')
+    sink = EventSink(p, static={'host': 0})
+    wd = StallWatchdog(sink, min_deadline_s=0.15, factor=10.0,
+                       poll_s=0.03, trace_dir=str(tmp_path / 'tr'))
+    # the stall event is emitted only after _try_trace released the
+    # capture lock (per-line flush in EventSink), so "event visible in
+    # the file" is the deterministic wait — a fixed sleep races the
+    # 0.5s trace window + profiler start/stop overhead on a loaded host
+    def wait_stalls(n, timeout_s=30.0):
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            got = []
+            for line in open(p):
+                try:
+                    e = json.loads(line)
+                except ValueError:         # torn tail mid-write
+                    continue
+                if e.get('event') == 'stall':
+                    got.append(e)
+            if len(got) >= n:
+                return got
+            time.sleep(0.05)
+        raise AssertionError(f'expected {n} stall events in {timeout_s}s')
+
+    wd.start()
+    try:
+        wd.beat(dur_s=0.01, step=7)
+        wait_stalls(1)                     # seeded stall -> trace dumped
+        assert _CAPTURE_LOCK.acquire(blocking=False)
+        try:
+            wd.beat(dur_s=0.01, step=8)
+            wait_stalls(2)                 # second stall, profiler busy
+        finally:
+            _CAPTURE_LOCK.release()
+    finally:
+        wd.stop()
+        sink.close()
+    stalls = wait_stalls(2)
+    assert len(stalls) == 2
+    assert 'top_device_ops' in stalls[0]
+    assert stalls[0]['trace_dir'] == str(tmp_path / 'tr')
+    # second stall: capture lock held -> no trace, no parsed ops, but
+    # the stacks still made it out
+    assert stalls[1]['trace_dir'] is None
+    assert stalls[1]['top_device_ops'] is None
+    assert stalls[1]['stacks']
+
+
+def test_sampled_profiler_abort_releases_lock(jitted_work):
+    f, x = jitted_work
+    sp = SampledProfiler(None, every=1, iters=4, jitted=f)
+    sp._seq = 1                            # next before_step opens
+    sp.before_step(x)
+    assert sp._active is not None and _CAPTURE_LOCK.locked()
+    sp.abort()
+    assert sp._active is None and not _CAPTURE_LOCK.locked()
+    sp.abort()                             # idempotent
+
+
+# ---------------------------------------------------------- memory gauges
+def test_memory_gauges_registration():
+    reg = MetricsRegistry()
+    stats = {'bytes_in_use': 11, 'peak_bytes_in_use': 22,
+             'bytes_limit': 33, 'not_a_watermark': 44}
+    assert update_memory_gauges(reg, stats=stats)
+    snap = reg.snapshot()
+    assert snap['device_memory_bytes{kind="bytes_in_use"}'] == 11
+    assert snap['device_memory_bytes{kind="peak_bytes_in_use"}'] == 22
+    assert snap['device_memory_bytes{kind="bytes_limit"}'] == 33
+    assert not any('not_a_watermark' in k for k in snap)
+    text = render_prometheus(reg)
+    assert 'device_memory_bytes{kind="peak_bytes_in_use"} 22' in text
+    # empty stats register nothing
+    reg2 = MetricsRegistry()
+    assert not update_memory_gauges(reg2, stats={})
+    assert reg2.snapshot() == {}
+    assert update_memory_gauges(None) is False
+
+
+# ------------------------------------------------------- /debug/profile
+@pytest.fixture(scope='module')
+def serve_cfg():
+    c = SegConfig(dataset='synthetic', model='fastscnn', num_class=5,
+                  colormap='custom', compute_dtype='float32',
+                  save_dir='/tmp/rtseg_segprof_test', use_tb=False)
+    c.resolve(num_devices=1)
+    return c
+
+
+@pytest.fixture(scope='module')
+def http_server(serve_cfg):
+    import jax
+    import jax.numpy as jnp
+    from rtseg_tpu.models import get_model
+    from rtseg_tpu.serve import (ServeEngine, ServePipeline,
+                                 make_preprocess, make_server)
+    from rtseg_tpu.utils import get_colormap
+    model = get_model(serve_cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 32, 32, 3), jnp.float32), False)
+    engine = ServeEngine.from_config(serve_cfg, [(32, 32)], 4,
+                                     variables=variables)
+    pipe = ServePipeline(engine, max_wait_ms=5, max_queue=32,
+                         preprocess=make_preprocess(serve_cfg))
+    server = make_server(pipe, port=0, colormap=get_colormap(serve_cfg))
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    yield f'http://127.0.0.1:{server.server_address[1]}', pipe
+    server.shutdown()
+    pipe.close()
+
+
+def _png_bytes(seed=3):
+    from PIL import Image
+    rng = np.random.RandomState(seed)
+    buf = io.BytesIO()
+    Image.fromarray((rng.rand(32, 32, 3) * 255).astype(np.uint8)).save(
+        buf, format='PNG')
+    return buf.getvalue()
+
+
+def test_debug_profile_endpoint(http_server):
+    """POST /debug/profile captures under live traffic and returns the
+    parsed breakdown; captures serialize (409), bad input 400s, and the
+    response's busy_frac reconciles with the /metrics gauge."""
+    base, pipe = http_server
+    body = _png_bytes()
+
+    stop = threading.Event()
+
+    def traffic():
+        while not stop.is_set():
+            req = urllib.request.Request(f'{base}/predict', data=body,
+                                         method='POST')
+            with urllib.request.urlopen(req, timeout=60) as r:
+                r.read()
+
+    t = threading.Thread(target=traffic, daemon=True)
+    t.start()
+    try:
+        req = urllib.request.Request(f'{base}/debug/profile?ms=150',
+                                     method='POST')
+        with urllib.request.urlopen(req, timeout=60) as r:
+            prof = json.loads(r.read())
+    finally:
+        stop.set()
+        t.join(timeout=10)
+    assert prof['event'] == 'profile' and prof['source'] == 'debug'
+    assert prof['requested_ms'] == 150.0
+    assert 0 < prof['busy_frac'] <= 1.0
+    assert prof['n_ops'] > 0
+    # total device time reconciles with the capture window: busy_frac is
+    # busy/window clamped to 1.0 — raw busy_ms itself may exceed the
+    # window on multi-core CPU (intra-op parallelism sums ops past wall
+    # time; the parser documents exactly this), so assert the clamp, not
+    # busy <= window
+    assert prof['busy_frac'] == pytest.approx(
+        min(1.0, prof['device_busy_ms'] / prof['window_ms']), abs=1e-3)
+    assert sum(prof['categories'].values()) == pytest.approx(
+        prof['device_busy_ms'], abs=0.05)
+    assert prof['attributed_frac'] >= 0.9
+    # live-plane reconciliation: the gauge holds this capture's number
+    with urllib.request.urlopen(f'{base}/metrics', timeout=30) as r:
+        text = r.read().decode()
+    assert 'profile_captures_total 1' in text
+    gauge = next(float(line.rsplit(' ', 1)[1])
+                 for line in text.splitlines()
+                 if line.startswith('device_busy_frac '))
+    assert gauge == pytest.approx(prof['busy_frac'], abs=1e-3)
+    # concurrent capture -> 409 (serialized, never queued)
+    assert _CAPTURE_LOCK.acquire(blocking=False)
+    try:
+        req = urllib.request.Request(f'{base}/debug/profile?ms=50',
+                                     method='POST')
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == 409
+        ei.value.read()
+    finally:
+        _CAPTURE_LOCK.release()
+    # non-finite or non-numeric durations -> 400 (NaN would bypass the
+    # min/max clamp and serialize as invalid JSON)
+    for bad in ('abc', 'nan', 'inf'):
+        req = urllib.request.Request(f'{base}/debug/profile?ms={bad}',
+                                     method='POST')
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == 400, bad
+        ei.value.read()
+    # MetricsPoller renders the device frame from the same scrape
+    poller = MetricsPoller(base)
+    frame = poller.poll()
+    assert frame['device'] is not None
+    assert frame['device']['captures'] == 1
+    assert frame['device']['busy_frac'] == pytest.approx(
+        prof['busy_frac'], abs=1e-3)
+
+
+# ------------------------------------------------------------ report/diff
+def _mk_events(cat_scale=1.0, retraced_extra=False, with_memory=True):
+    """A minimal synthetic run: 4 steps + 2 profile captures (+ 1
+    retraced) + a memory watermark."""
+    ts = 1000.0
+    evs = [{'event': 'run_start', 'model': 'fastscnn', 'ts': ts,
+            'host': 0}]
+    for i in range(4):
+        evs.append({'event': 'step', 'kind': 'train', 'seq': i + 1,
+                    'dur_s': 0.1, 'data_wait_s': 0.0, 'imgs': 4,
+                    'ts': ts + i, 'host': 0,
+                    **({'compile': True} if i == 0 else {})})
+    for j in range(2):
+        evs.append({'event': 'profile', 'source': 'sampled', 'iters': 2,
+                    'window_ms': 100.0, 'device_busy_ms': 80.0,
+                    'idle_ms': 20.0, 'busy_frac': 0.8,
+                    'attributed_frac': 1.0, 'n_ops': 10,
+                    'retraced': False, 'ts': ts + 10 + j, 'host': 0,
+                    'categories': {'conv': 40.0 * cat_scale,
+                                   'matmul': 20.0,
+                                   'collective': 10.0 * cat_scale,
+                                   'copy': 10.0},
+                    'modules': {'backbone': 50.0, 'head': 30.0}})
+    if retraced_extra:
+        evs.append({'event': 'profile', 'source': 'sampled', 'iters': 2,
+                    'window_ms': 100.0, 'device_busy_ms': 99.0,
+                    'busy_frac': 0.99, 'attributed_frac': 0.1,
+                    'retraced': True, 'ts': ts + 15, 'host': 0,
+                    'categories': {'conv': 99.0}, 'modules': {}})
+    if with_memory:
+        evs.append({'event': 'memory', 'device': 'TPU:0',
+                    'bytes_in_use': 100 * 2**20,
+                    'peak_bytes_in_use': 256 * 2**20,
+                    'ts': ts + 20, 'host': 0})
+    evs.append({'event': 'run_end', 'wall_s': 10.0, 'ts': ts + 30,
+                'host': 0})
+    return evs
+
+
+def test_report_device_section_and_measured_mfu():
+    s = summarize(_mk_events(retraced_extra=True))
+    dv = s['device']
+    assert dv['captures'] == 2             # the retraced one is excluded
+    assert s['profile_captures'] == 2
+    assert dv['busy_frac'] == pytest.approx(0.8)
+    assert dv['attributed_frac'] == pytest.approx(1.0)
+    assert dv['category_ms']['conv'] == pytest.approx(80.0)
+    assert dv['category_shares']['conv'] == pytest.approx(0.5)
+    assert dv['top_modules']['backbone'] == pytest.approx(100.0)
+    assert dv['ms_per_iter'] == pytest.approx(160.0 / 4)
+    assert dv['peak_hbm_bytes'] == 256 * 2**20
+    # flattened per-category rows: ms per captured iteration
+    assert s['device_busy_frac'] == pytest.approx(0.8)
+    assert s['dev_conv_ms'] == pytest.approx(20.0)
+    assert s['dev_collective_ms'] == pytest.approx(5.0)
+    assert s['dev_infeed_ms'] == pytest.approx(0.0)
+    assert s['peak_hbm_bytes'] == 256 * 2**20
+    assert 'measured_mfu' not in dv        # no roofline handed in
+    # with the roofline ceiling the measured-MFU line exists
+    s2 = summarize(_mk_events(),
+                   roofline={'fastscnn': {'model': 'fastscnn',
+                                          'ceiling_mfu': 0.5,
+                                          'lane_adj_ceiling_mfu': 0.4}})
+    assert s2['device']['ceiling_mfu'] == pytest.approx(0.4)
+    assert s2['device']['measured_mfu'] == pytest.approx(0.8 * 0.4)
+    out = format_summary(s2)
+    assert 'device         : busy 80.0%' in out
+    assert 'measured MFU   : 32.0%' in out
+    assert 'peak HBM       : 256 MiB' in out
+    # a run without profile events has no device section
+    s3 = summarize([e for e in _mk_events(with_memory=False)
+                    if e['event'] != 'profile'])
+    assert s3['device'] is None and s3['dev_conv_ms'] is None
+
+
+def test_load_roofline_drops_error_rows(tmp_path):
+    p = tmp_path / 'roof.json'
+    p.write_text(
+        json.dumps({'model': 'fastscnn', 'ceiling_mfu': 0.5}) + '\n'
+        + json.dumps({'model': 'broken', 'error': 'boom'}) + '\n'
+        + 'not json\n')
+    roof = load_roofline(str(p))
+    assert set(roof) == {'fastscnn'}
+
+
+def test_report_per_iter_rows_exclude_iterless_captures():
+    """An on-demand /debug/profile capture in the sink adds to the
+    device totals but not to any per-iteration number: its window has
+    no iteration denominator, so folding it in would inflate ms/iter
+    and spuriously trip the dev_* diff regression rows."""
+    base = summarize(_mk_events())
+    evs = _mk_events()
+    evs.insert(-1, {'event': 'profile', 'source': 'debug',
+                    'window_ms': 500.0, 'device_busy_ms': 500.0,
+                    'busy_frac': 1.0, 'attributed_frac': 1.0,
+                    'retraced': False, 'ts': 1025.0, 'host': 0,
+                    'categories': {'conv': 500.0}, 'modules': {}})
+    s = summarize(evs)
+    dv, bdv = s['device'], base['device']
+    assert dv['captures'] == bdv['captures'] + 1
+    assert dv['device_busy_ms'] == pytest.approx(
+        bdv['device_busy_ms'] + 500.0)
+    assert dv['category_ms']['conv'] == pytest.approx(
+        bdv['category_ms']['conv'] + 500.0)
+    # every per-iter number is unchanged by the iter-less capture
+    assert dv['iters'] == bdv['iters'] == 4
+    assert dv['ms_per_iter'] == bdv['ms_per_iter']
+    assert dv['category_ms_per_iter'] == bdv['category_ms_per_iter']
+    assert s['dev_conv_ms'] == base['dev_conv_ms']
+    assert not {r['key']: r for r in diff_rows(base, s)
+                }['dev_conv_ms']['regressed']
+
+
+def test_diff_device_regression_rows_and_check(tmp_path):
+    a = summarize(_mk_events())
+    b = summarize(_mk_events(cat_scale=1.5))
+    rows = {r['key']: r for r in diff_rows(a, b)}
+    assert rows['dev_conv_ms']['regressed']        # 20 -> 30 ms/iter
+    assert rows['dev_collective_ms']['regressed']  # 5 -> 7.5 ms/iter
+    assert not rows['dev_matmul_ms']['regressed']
+    assert not rows['dev_copy_ms']['regressed']
+    table = diff_table(a, b)
+    assert 'dev conv (ms/iter) | 20.00 | 30.00' in table
+    assert table.count('REGRESSED') >= 2
+    # sub-floor categories never regress (profiler noise)
+    a2, b2 = dict(a), dict(b)
+    a2['dev_infeed_ms'], b2['dev_infeed_ms'] = 0.001, 0.01
+    assert not {r['key']: r for r in
+                diff_rows(a2, b2)}['dev_infeed_ms']['regressed']
+    # a 0 -> nonzero jump (single-device baseline vs multi-device run)
+    # must stay RFC-JSON: '+inf', never json.dumps's bare Infinity token
+    a3, b3 = dict(a), dict(b)
+    a3['dev_copy_ms'], b3['dev_copy_ms'] = 0.0, 3.0
+    row = {r['key']: r for r in diff_rows(a3, b3)}['dev_copy_ms']
+    assert row['delta'] == '+inf' and row['regressed']
+    assert 'Infinity' not in json.dumps(row)
+    assert '+inf' in diff_table(a3, b3)
+    # CLI --check gates on the regressed rows (exit 1)
+    for name, evs in (('a', _mk_events()),
+                      ('b', _mk_events(cat_scale=1.5))):
+        d = tmp_path / name
+        d.mkdir()
+        with open(d / 'events-000.jsonl', 'w') as f:
+            for e in evs:
+                f.write(json.dumps(e) + '\n')
+    r = subprocess.run(
+        [sys.executable, SEGSCOPE, 'diff', str(tmp_path / 'a'),
+         str(tmp_path / 'b'), '--check'],
+        capture_output=True, text=True)
+    assert r.returncode == 1
+    assert 'dev conv' in r.stderr
+    r = subprocess.run(
+        [sys.executable, SEGSCOPE, 'diff', str(tmp_path / 'a'),
+         str(tmp_path / 'a'), '--check'],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    # --json --check on the success path keeps stdout a pure JSON doc
+    # (the check-OK line goes to stderr)
+    r = subprocess.run(
+        [sys.executable, SEGSCOPE, 'diff', str(tmp_path / 'a'),
+         str(tmp_path / 'a'), '--json', '--check'],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    assert 'check OK' in r.stderr
+    json.loads(r.stdout)
+
+
+def test_report_cli_roofline(tmp_path):
+    d = tmp_path / 'run'
+    d.mkdir()
+    with open(d / 'events-000.jsonl', 'w') as f:
+        for e in _mk_events():
+            f.write(json.dumps(e) + '\n')
+    roof = tmp_path / 'roof.json'
+    roof.write_text(json.dumps({'model': 'fastscnn',
+                                'ceiling_mfu': 0.5}) + '\n')
+    r = subprocess.run(
+        [sys.executable, SEGSCOPE, 'report', str(d), '--roofline',
+         str(roof), '--json'],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    s = json.loads(r.stdout)
+    assert s['device']['measured_mfu'] == pytest.approx(0.4)
+
+
+# ------------------------------------------------------------------- live
+def test_live_sink_device_frame_and_hbm_gate(tmp_path):
+    d = tmp_path / 'run'
+    d.mkdir()
+    with open(d / 'events-000.jsonl', 'w') as f:
+        for e in _mk_events(retraced_extra=True):
+            f.write(json.dumps(e) + '\n')
+    tailer = SinkTailer(str(d), window_s=1e9)
+    frame = tailer.poll()
+    dv = frame['device']
+    assert dv is not None
+    # last NON-retraced capture's busy fraction; retraced ones are
+    # counted as captures but never update the gauge
+    assert dv['busy_frac'] == pytest.approx(0.8)
+    assert dv['captures'] == 3
+    assert dv['peak_hbm_bytes'] == 256 * 2**20
+    assert 'device         : busy 80.0%' in format_frame(frame)
+    assert check_frame(frame, max_hbm_bytes=512 * 2**20) == []
+    problems = check_frame(frame, max_hbm_bytes=128 * 2**20)
+    assert any('peak HBM' in p for p in problems)
+
+
+def test_profile_step_cli_on_fixture(tmp_path):
+    """The refactored tools/profile_step.py aggregates an existing trace
+    through the shared parser and keeps its module-share table."""
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, 'tools', 'profile_step.py'),
+         '--no-capture', '--trace-dir', FIXTURE_DIR, '--iters', '1'],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    assert '| backbone |' in r.stdout and '| head |' in r.stdout
+    # module-less device ops (50 of 310 us in the fixture) get an
+    # explicit row so the table sums to its own TOTAL
+    assert '| (unattributed) | 0.05 | 16.1% |' in r.stdout
+    assert 'busy 77.5% of the capture window' in r.stdout
+
+
+# ------------------------------------------------------------------- slow
+@pytest.mark.slow
+def test_trainer_sampled_profiling_e2e(tmp_path):
+    """config.profile_every on a real 2-epoch synthetic run: profile
+    events land in the sink on cadence, attribute >=90% of device time,
+    and the report's device section renders — the CI segscope job's
+    scenario as a test."""
+    from rtseg_tpu.train import SegTrainer
+    cfg = SegConfig(dataset='synthetic', model='fastscnn', num_class=5,
+                    crop_size=32, train_bs=4, val_bs=4, total_epoch=2,
+                    val_interval=1, compute_dtype='float32', use_tb=False,
+                    use_ema=True, base_workers=0, log_interval=0,
+                    load_ckpt=False, save_ckpt=False,
+                    profile_every=2, profile_capture_iters=2,
+                    save_dir=str(tmp_path))
+    cfg.resolve()
+    # under the test harness's 8 virtual devices the synthetic set is 2
+    # steps/epoch, so the cadence must fire within 4 total steps
+    SegTrainer(cfg).run()
+    evs = [json.loads(line)
+           for line in open(tmp_path / 'segscope' / 'events-000.jsonl')]
+    profs = [e for e in evs if e.get('event') == 'profile'
+             and not e.get('retraced')]
+    assert len(profs) >= 1
+    for e in profs:
+        assert 0 < e['busy_frac'] <= 1.0
+        assert e['attributed_frac'] >= 0.9
+        assert e['iters'] == 2 and e['source'] == 'sampled'
+    s = summarize([e for e in evs])
+    assert s['device'] is not None and s['device']['captures'] >= 1
+    assert s['dev_conv_ms'] > 0            # convs dominate fastscnn

@@ -2,9 +2,10 @@
 
 The round-3 method that found bisenetv2's DetailBranch at 41% of step time
 (BENCHMARKS.md "Flagship train-step profile") as a repeatable tool: jit the
-full train step, trace N fenced iterations with jax.profiler, then parse the
-trace-viewer JSON and aggregate device time by the model-module prefix XLA
-records in each op's metadata (jax source-info -> HLO op_name).
+full train step, trace N fenced iterations with jax.profiler, then parse
+the trace with the shared segprof parser (rtseg_tpu/obs/profile.py — the
+same DeviceProfile the trainer's sampled profiling and the serve
+front-end's `/debug/profile` emit) and print the module-share table.
 
     python tools/profile_step.py --model ddrnet --batch 96
     python tools/profile_step.py --model stdc --batch 128 --hires-remat
@@ -12,24 +13,24 @@ records in each op's metadata (jax source-info -> HLO op_name).
 
 Writes the trace under --trace-dir (default /tmp, NOT the repo: binary
 traces stay out of git per the round-3 advisor note) and prints a
-module-share table. The traced region is armed with the recompile guard
-(rtseg_tpu/analysis/recompile.py): a profile whose iterations secretly
-retraced raises instead of attributing compile time to model modules.
+module-share table (falling back to op categories on traces without
+module metadata, e.g. the CPU backend). The traced region is armed with
+the recompile guard (rtseg_tpu/analysis/recompile.py): a profile whose
+iterations secretly retraced raises instead of attributing compile time
+to model modules.
 """
 
 import argparse
-import collections
-import glob
-import gzip
 import json
 import os
-import re
 import sys
 from os import path
 
 sys.path.append(path.dirname(path.dirname(path.abspath(__file__))))
 
 import numpy as np
+
+from rtseg_tpu.obs.profile import load_trace_events, parse_trace
 
 
 def capture(model_name, batch, h, w, trace_dir, iters, hires_remat=False,
@@ -101,81 +102,8 @@ def capture(model_name, batch, h, w, trace_dir, iters, hires_remat=False,
     return float(np.asarray(metrics['loss']))
 
 
-def load_events(trace_dir):
-    """All complete ('X') events from the newest trace.json.gz under
-    trace_dir, with the process-name map so device tracks are findable."""
-    files = sorted(glob.glob(path.join(
-        trace_dir, '**', '*.trace.json.gz'), recursive=True),
-        key=path.getmtime)
-    if not files:
-        raise FileNotFoundError(f'no *.trace.json.gz under {trace_dir}')
-    with gzip.open(files[-1], 'rt') as f:
-        data = json.load(f)
-    events = data['traceEvents'] if isinstance(data, dict) else data
-    pid_names = {e.get('pid'): e.get('args', {}).get('name', '')
-                 for e in events
-                 if e.get('ph') == 'M' and e.get('name') == 'process_name'}
-    xevents = [e for e in events if e.get('ph') == 'X']
-    return xevents, pid_names
-
-
-# jax records the originating module path in the HLO metadata op_name, which
-# the trace viewer surfaces per event (args key varies across versions)
-_ARGS_KEYS = ('long_name', 'tf_op', 'hlo_op', 'name')
-_MODULE_RE = re.compile(r'([A-Za-z0-9_]+_\d+|[a-z_]+[0-9]?)/')
-
-
-def module_of(event, depth):
-    args = event.get('args', {}) or {}
-    meta = ''
-    for k in _ARGS_KEYS:
-        v = args.get(k, '')
-        if isinstance(v, str) and '/' in v:
-            meta = v
-            break
-    if not meta:
-        return None
-    parts = [p for p in meta.split('/') if p and '=' not in p]
-    # drop transpose/jit wrappers so fwd and bwd of one module aggregate
-    parts = [p for p in parts if not p.startswith(('jit(', 'transpose('))]
-    if not parts:
-        return None
-    return '/'.join(parts[:depth])
-
-
-def aggregate(trace_dir, depth):
-    events, pid_names = load_events(trace_dir)
-    device_pids = {pid for pid, name in pid_names.items()
-                   if 'TPU' in name or 'GPU' in name or '/device' in name}
-    if not device_pids:
-        print('# WARNING: no device (TPU/GPU) process track found — '
-              'aggregating HOST events; module shares will be '
-              'meaningless for device-time analysis', flush=True)
-    dev_events = [e for e in events
-                  if (not device_pids or e.get('pid') in device_pids)
-                  and float(e.get('dur', 0)) > 0]
-    # the device track carries several thread lines: whole-step container
-    # events (one per iteration) AND the per-HLO-op line; summing all of
-    # them double-counts every cycle. The op-level line is the tid with
-    # the most events — aggregate only that one.
-    per_line = collections.Counter(
-        (e.get('pid'), e.get('tid')) for e in dev_events)
-    if per_line:
-        op_line = per_line.most_common(1)[0][0]
-        dev_events = [e for e in dev_events
-                      if (e.get('pid'), e.get('tid')) == op_line]
-    rows = collections.Counter()
-    total = 0.0
-    for e in dev_events:
-        dur = float(e.get('dur', 0.0))
-        mod = module_of(e, depth)
-        total += dur
-        rows[mod if mod else '(unattributed)'] += dur
-    return rows, total
-
-
 def inspect(trace_dir, n=15):
-    events, pid_names = load_events(trace_dir)
+    events, pid_names = load_trace_events(trace_dir)
     print('processes:', pid_names)
     shown = 0
     for e in sorted(events, key=lambda e: -float(e.get('dur', 0))):
@@ -232,21 +160,41 @@ def main():
     if args.inspect:
         inspect(trace_dir)
         return 0
-    rows, total = aggregate(trace_dir, args.depth)
-    print(f'\n| module (depth {args.depth}) | device ms/iter | share |')
+    prof = parse_trace(trace_dir, depth=args.depth)
+    total = prof.busy_us
+    if prof.modules:
+        rows, what = dict(prof.modules), f'module (depth {args.depth})'
+        # device ops with no source-module path (runtime internals)
+        # get an explicit row — the table must sum to its own TOTAL
+        residue = total - sum(rows.values())
+        if total > 0 and residue / total > 1e-4:
+            rows['(unattributed)'] = residue
+    else:
+        # traces without module metadata (CPU backend) still attribute
+        # by op category — never an empty table
+        rows, what = prof.categories, 'op category'
+        if not prof.device_track:
+            print('# WARNING: no device (TPU/GPU) process track found — '
+                  'aggregated the XLA op events of the host backend; '
+                  'module paths unavailable, showing op categories',
+                  flush=True)
+    print(f'\n| {what} | device ms/iter | share |')
     print('|---|---|---|')
-    for mod, dur in rows.most_common(20):
+    for mod, dur in sorted(rows.items(), key=lambda kv: -kv[1])[:20]:
         print(f'| {mod} | {dur / 1000 / args.iters:.2f} | '
               f'{100 * dur / total:.1f}% |')
-    print(f'| TOTAL | {total / 1000 / args.iters:.2f} | 100% |')
+    print(f'| TOTAL | {total / 1000 / args.iters:.2f} | 100% | '
+          f'(busy {100 * prof.busy_frac:.1f}% of the capture window, '
+          f'{100 * prof.attributed_frac:.1f}% attributed)')
     if sink is not None:
-        sink.emit({'event': 'profile', 'model': args.model,
-                   'mode': 'eval' if args.eval else 'train',
-                   'iters': args.iters, 'trace_dir': trace_dir,
-                   'ms_per_iter': round(total / 1000 / args.iters, 3),
-                   'module_shares': {
-                       (mod or '(unattributed)'): round(dur / total, 4)
-                       for mod, dur in rows.most_common(20)}})
+        sink.emit(prof.to_event(
+            model=args.model, mode='eval' if args.eval else 'train',
+            iters=args.iters, trace_dir=trace_dir,
+            ms_per_iter=round(total / 1000 / args.iters, 3),
+            module_shares={mod: round(dur / total, 4)
+                           for mod, dur in sorted(rows.items(),
+                                                  key=lambda kv: -kv[1])
+                           [:20] if total}))
     return 0
 
 

@@ -24,6 +24,11 @@ TPU-post-fusion counts. Peak/bandwidth default to TPU v5e; override with
 tools/benchmark_all.py PEAK_BF16_BY_KIND for peaks).
 
     python tools/roofline.py --models fastscnn,bisenetv2
+
+`--json` (one object per model per line) is the format
+`tools/segscope.py report --roofline` consumes: the report's
+measured-MFU line divides measured device busy time (segprof profile
+events, rtseg_tpu/obs/profile.py) into the lane-adjusted ceiling here.
 """
 
 import argparse

@@ -13,13 +13,22 @@ the loaders' per-epoch cache events; `diff` marks data-wait/h2d
 regressions >5% as REGRESSED. Pure stdlib+numpy: works on machines
 without jax (e.g. a laptop holding synced run dirs).
 
+Runs with segprof sampled profiling on (`config.profile_every`) or
+`/debug/profile` captures get a device section — busy %, per-category
+(conv/matmul/collective/copy/fusion/infeed) and per-module device time,
+attribution coverage, peak HBM — and `--roofline` (the `tools/roofline.py
+--json` output) adds a measured-MFU line: device busy fraction x the
+model's analytical ceiling. `diff` grows per-category device regression
+rows and `--check` turns any REGRESSED row into exit 1.
+
 Usage:
     python tools/segscope.py report save/segscope
     python tools/segscope.py report save/segscope --json
     python tools/segscope.py report save/segscope --check   # CI gate:
                                         # goodput > 0 and 0 stalls, else 1
     python tools/segscope.py report save/segscope --all-runs
-    python tools/segscope.py diff runA/segscope runB/segscope
+    python tools/segscope.py report save/segscope --roofline roofline.json
+    python tools/segscope.py diff runA/segscope runB/segscope [--check]
 
     # live plane (segtrace): follow a RUNNING system — tail a run's sink
     # dir, or poll a serve replica's /metrics endpoint — and render a
@@ -50,8 +59,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from rtseg_tpu.obs.live import (MetricsPoller, SinkTailer,    # noqa: E402
                                 check_frame, format_frame)
-from rtseg_tpu.obs.report import (diff_table, format_summary,  # noqa: E402
-                                  load_events, summarize)
+from rtseg_tpu.obs.report import (diff_rows, diff_table,      # noqa: E402
+                                  format_summary, load_events,
+                                  load_roofline, summarize)
 
 
 def _run_live(args) -> int:
@@ -73,7 +83,8 @@ def _run_live(args) -> int:
             # full-frame repaint: clear + home, like watch(1)
             print('\x1b[2J\x1b[H' + out, flush=True)
         if args.check:
-            problems = check_frame(frame, p99_ms=args.p99_ms)
+            problems = check_frame(frame, p99_ms=args.p99_ms,
+                                   max_hbm_bytes=args.max_hbm_bytes)
             if problems:
                 # a transient empty first frame is not a failure while
                 # following; only --once treats it as terminal
@@ -104,11 +115,18 @@ def main(argv=None) -> int:
     rp.add_argument('--check', action='store_true',
                     help='exit 1 unless goodput > 0, stalls == 0 and at '
                          'least one train step was recorded')
+    rp.add_argument('--roofline', default=None, metavar='PATH',
+                    help='tools/roofline.py --json output; enables the '
+                         'measured-MFU line (device busy x analytical '
+                         'ceiling) in the device section')
 
     dp = sub.add_parser('diff', help='compare two runs (A=baseline, B=new)')
     dp.add_argument('a')
     dp.add_argument('b')
     dp.add_argument('--json', action='store_true')
+    dp.add_argument('--check', action='store_true',
+                    help='exit 1 when any row is REGRESSED (>5% worse; '
+                         'includes the segprof per-category device rows)')
 
     lp = sub.add_parser('live', help='follow a running system (sink dir '
                                      'or /metrics URL)')
@@ -125,6 +143,10 @@ def main(argv=None) -> int:
                          'activity observed, p99 under --p99-ms')
     lp.add_argument('--p99-ms', type=float, default=None,
                     help='--check request p99 threshold (ms)')
+    lp.add_argument('--max-hbm-bytes', type=float, default=None,
+                    help='--check peak device memory threshold (bytes, '
+                         'from the device_memory_bytes gauges / memory '
+                         'events)')
     args = ap.parse_args(argv)
 
     try:
@@ -135,7 +157,9 @@ def main(argv=None) -> int:
                 return 0
         if args.cmd == 'report':
             events = load_events(args.path, last_run=not args.all_runs)
-            s = summarize(events)
+            roofline = (load_roofline(args.roofline)
+                        if args.roofline else None)
+            s = summarize(events, roofline=roofline)
             if args.json:
                 print(json.dumps(s, indent=2, default=str))
             else:
@@ -156,11 +180,24 @@ def main(argv=None) -> int:
 
         sa = summarize(load_events(args.a))
         sb = summarize(load_events(args.b))
+        rows = diff_rows(sa, sb)
         if args.json:
-            print(json.dumps({'a': sa, 'b': sb}, indent=2, default=str))
+            print(json.dumps({'a': sa, 'b': sb, 'rows': rows},
+                             indent=2, default=str))
         else:
             print(f'segscope diff — A: {args.a}  B: {args.b}')
-            print(diff_table(sa, sb))
+            print(diff_table(sa, sb, rows=rows))
+        if args.check:
+            regressed = [r for r in rows if r['regressed']]
+            if regressed:
+                print('segscope diff check FAILED: '
+                      + '; '.join(f"{r['label']} {r['a']:.2f} -> "
+                                  f"{r['b']:.2f}" for r in regressed),
+                      file=sys.stderr)
+                return 1
+            # stderr under --json: stdout is the machine-readable doc
+            print('segscope diff check OK: 0 regressed rows',
+                  file=sys.stderr if args.json else sys.stdout)
         return 0
     except FileNotFoundError as e:
         print(f'segscope: {e}', file=sys.stderr)

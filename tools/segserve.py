@@ -143,8 +143,8 @@ def cmd_serve(args) -> int:
                          colormap=get_colormap(cfg))
     host, port = server.server_address[:2]
     print(f'segserve: {cfg.model} on http://{host}:{port} | buckets '
-          f'{args.buckets} x batch {engine.batch} | POST /predict, '
-          f'GET /healthz /stats /metrics', flush=True)
+          f'{args.buckets} x batch {engine.batch} | POST /predict '
+          f'/debug/profile?ms=, GET /healthz /stats /metrics', flush=True)
     try:
         server.serve_forever()
     except KeyboardInterrupt:
