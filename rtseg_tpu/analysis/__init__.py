@@ -3,8 +3,12 @@
 Three tiers (see tools/segcheck.py for the CLI):
 
   * AST lint (pure stdlib `ast`, no jax import): import hygiene, registry
-    consistency, trace purity, evidence citations.  Each rule is a function
-    `check_*(root) -> list[Finding]` in its own module.
+    consistency, trace purity, evidence citations, obs purity, warm-key
+    coverage, and the segrace concurrency auditor (concurrency.py +
+    lockgraph.py: lock-discipline inference, the SEGRACE.json lock-order
+    gate, atomicity lints — all over the shared entry-point walker in
+    walker.py).  Each rule is a function `check_*(root) -> list[Finding]`
+    in its own module.
   * trace audit (imports jax, still CPU-safe): `jax.eval_shape` sweep over
     the whole model zoo (shape_audit) and the runtime recompile guard
     (recompile) that the trainer hooks behind config.recompile_guard.
@@ -28,6 +32,9 @@ from .lint_trace import check_trace_purity
 from .lint_evidence import check_evidence_citations
 from .lint_obs import check_obs_purity
 from .lint_warm import check_warm_key_coverage
+from .concurrency import (build_lockgraph, check_concurrency,
+                          update_lockgraph)
+from .lockgraph import LockGraph
 # audit modules defer their jax imports to call time, so importing the
 # package stays jax-free
 from .recompile import (PIN_ATTRS, RecompileError, RecompileGuard,
@@ -50,6 +57,8 @@ __all__ = [
     'check_import_hygiene', 'check_registry_consistency',
     'check_trace_purity', 'check_evidence_citations', 'check_obs_purity',
     'check_warm_key_coverage',
+    'check_concurrency', 'build_lockgraph', 'update_lockgraph',
+    'LockGraph',
     'PIN_ATTRS', 'RecompileError', 'RecompileGuard', 'guard_step',
     'introspectable',
     'AuditResult', 'audit_model', 'audit_zoo', 'zoo_variants',

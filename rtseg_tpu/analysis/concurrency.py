@@ -1,0 +1,916 @@
+"""Rule `concurrency`: static audit of the threaded runtime planes.
+
+PRs 7-12 grew a genuinely concurrent runtime — the serving pipeline's
+stage threads, the metrics registry scraped mid-write, the stall
+watchdog, the executable cache shared by a compile pool, the segpipe
+prefetchers. Their thread-safety was pinned only by hammer tests; this
+rule makes the invariants machine-checked source properties, the same
+way SEGAUDIT.json made collective counts one. Three passes, all pure
+stdlib ``ast`` over :data:`TARGET_PREFIXES`:
+
+1. **lock-discipline inference** — per class, every ``self.<attr>``
+   access site is mapped to the set of locks held on the path (``with
+   self._lock:`` blocks, ``acquire``/``release`` calls, ``Condition``
+   context managers; private helpers are inlined into their callers so
+   a helper that runs under the caller's lock is credited with it).
+   Concurrent entry points are discovered from the AST: ``Thread(target=
+   self._loop)``, ``executor.submit(self._finish, ...)``,
+   ``add_done_callback``, ``do_GET``/``do_POST`` handler methods, and
+   classes built on stdlib threading bases. A field that is
+   *majority*-guarded by some lock but has unguarded outlier sites, and
+   is reachable from two or more concurrent contexts with at least one
+   write, is a finding attributed to each outlier site. (A field that is
+   *consistently* unguarded is not flagged here — it may be
+   thread-confined by design; the atomicity pass below catches the
+   specifically dangerous shapes.)
+
+2. **lock-order graph** — every "acquired B while holding A" pair in the
+   tree becomes a directed edge (calls are resolved conservatively: a
+   call to a scanned method by bare name contributes every lock that
+   method may transitively acquire). The global digraph must be acyclic
+   and every edge must appear in the committed ``SEGRACE.json`` sidecar
+   (lockgraph.py); a new edge is a reviewable event, re-pinned with
+   ``tools/segcheck.py --update-lockgraph``.
+
+3. **atomicity lints** — read-modify-write of a shared field with no
+   lock held in a thread-entry context (``x += 1`` is three bytecodes);
+   check-then-act on a shared dict/deque (``.get``/``in``/indexing
+   followed by a mutation in the same function, both lockless);
+   ``notify``/``notify_all`` without the condition's lock held; and
+   ``Thread.start`` inside ``__init__`` before all fields are assigned
+   (the started thread can observe a partially constructed object).
+
+Findings are suppressible per line with ``# segcheck: disable=
+concurrency`` exactly like every other rule; the house policy (pinned by
+tests/test_segrace.py) is that each committed suppression carries a
+one-line justification and the total count only goes down.
+
+Known conservatisms, by design: lock identity is per class *attribute*
+(all instances of a class share one discipline); method calls resolve by
+bare name across the scanned tree, except stdlib container/file method
+names (``get``/``append``/``write``/...) which are never resolved to
+scanned classes; closures run with no inherited locks (they execute
+later, on some other thread).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from .core import (Finding, RULE_CONCURRENCY, SourceFile,
+                   iter_python_files)
+from .lockgraph import LockGraph, compare, load_sidecar, save_sidecar
+from .walker import dotted_name, index_functions
+
+#: the threaded planes this rule audits. Distinct from lint_trace's
+#: TARGET_PREFIXES (the jit-reachable scope): obs/ and train/checkpoint.py
+#: are never traced but are exactly where the daemon threads live.
+TARGET_PREFIXES = (
+    'rtseg_tpu/serve/', 'rtseg_tpu/obs/', 'rtseg_tpu/warm/',
+    'rtseg_tpu/data/', 'rtseg_tpu/train/checkpoint.py',
+    'rtseg_tpu/native/',
+)
+
+#: constructor names (last dotted segment) that create a lock object;
+#: Condition is tracked separately so the notify lint knows its kind
+_LOCK_FACTORIES: Dict[str, str] = {
+    'Lock': 'lock', 'RLock': 'lock', 'Condition': 'condition',
+    'Semaphore': 'lock', 'BoundedSemaphore': 'lock',
+}
+
+#: attrs bound to internally synchronized / immutable-by-contract
+#: primitives: excluded from the field analysis (a Queue guards itself)
+_SAFE_FACTORIES = frozenset({
+    'Lock', 'RLock', 'Condition', 'Event', 'Semaphore',
+    'BoundedSemaphore', 'Barrier', 'Queue', 'SimpleQueue', 'LifoQueue',
+    'PriorityQueue', 'ThreadPoolExecutor', 'ProcessPoolExecutor',
+    'local', 'Thread', 'Timer', 'count',
+})
+
+_THREAD_FACTORIES = frozenset({'Thread', 'Timer'})
+
+#: call names that receive a function destined for another thread
+_SPAWN_WRAPPERS = frozenset({'Thread', 'Timer', 'submit',
+                             'add_done_callback', 'call_soon_threadsafe'})
+
+#: methods invoked per-connection by stdlib threading servers
+_HANDLER_METHODS = frozenset({'do_GET', 'do_POST', 'do_PUT', 'do_DELETE',
+                              'do_HEAD', 'do_PATCH'})
+
+#: base-class names that imply every public method runs on its own thread
+_THREADED_BASES = frozenset({'ThreadingHTTPServer', 'ThreadingMixIn',
+                             'ThreadingTCPServer', 'ThreadingUDPServer',
+                             'BaseHTTPRequestHandler'})
+
+#: stdlib container/file/str method names that are never resolved to
+#: scanned classes when computing may-acquire summaries — ``d.get(k)``
+#: under a lock must not inherit edges from every scanned ``def get``
+_BUILTIN_METHODS = frozenset({
+    'get', 'put', 'get_nowait', 'put_nowait', 'append', 'appendleft',
+    'pop', 'popleft', 'clear', 'update', 'extend', 'remove', 'discard',
+    'insert', 'add', 'setdefault', 'keys', 'values', 'items', 'copy',
+    'sort', 'index', 'read', 'write', 'flush', 'readline', 'seek',
+    'decode', 'encode', 'split', 'rsplit', 'strip', 'lstrip', 'rstrip',
+    'join', 'format', 'replace', 'partition', 'startswith', 'endswith',
+    'result', 'done', 'cancel', 'set_result', 'set_exception',
+    'is_alive', 'is_set', 'wait', 'acquire', 'release', 'locked',
+    'notify', 'notify_all',
+    # stdlib lifecycle names shared by files, threads, executors and
+    # servers — `self._f.close()` under a lock is a *file* close, and a
+    # `t.start()` is a Thread start; neither may inherit the locks of
+    # every scanned `def close`/`def start`
+    'close', 'join', 'shutdown', 'start', 'stop', 'terminate', 'kill',
+})
+
+#: container mutators for the check-then-act lint
+_MUTATORS = frozenset({'append', 'appendleft', 'pop', 'popleft', 'clear',
+                       'update', 'extend', 'remove', 'discard', 'insert',
+                       'add'})
+
+#: container read/probe spellings for the check-then-act lint
+_CHECKERS = frozenset({'get'})
+
+
+# --------------------------------------------------------------------- model
+@dataclass
+class Access:
+    attr: str
+    kind: str                 # 'read' | 'write' | 'rmw'
+    line: int
+    held: FrozenSet[str]
+    ctx: str                  # 'thread:<m>' | 'api:<m>' | 'init'
+    func_key: str             # per-walked-function key (check-then-act)
+    flavor: str = ''          # 'check' | 'mutate' | ''
+
+
+@dataclass
+class ClassInfo:
+    sf: SourceFile
+    node: ast.ClassDef
+    lock_attrs: Dict[str, str] = field(default_factory=dict)
+    safe_attrs: Set[str] = field(default_factory=set)
+    thread_attrs: Set[str] = field(default_factory=set)
+    container_attrs: Set[str] = field(default_factory=set)
+    methods: Dict[str, ast.FunctionDef] = field(default_factory=dict)
+    entry_methods: Set[str] = field(default_factory=set)
+    handler_base: bool = False
+    accesses: List[Access] = field(default_factory=list)
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+    def lock_id(self, attr: str) -> str:
+        return f'{self.sf.relpath}:{self.name}.{attr}'
+
+    @property
+    def concurrent(self) -> bool:
+        """Whether this class participates in threading at all: it owns a
+        lock, spawns/receives threads, or subclasses a threading base."""
+        return bool(self.lock_attrs or self.entry_methods
+                    or self.handler_base)
+
+
+@dataclass
+class ModuleInfo:
+    sf: SourceFile
+    classes: List[ClassInfo] = field(default_factory=list)
+    functions: Dict[str, ast.FunctionDef] = field(default_factory=dict)
+    mod_locks: Dict[str, str] = field(default_factory=dict)  # name -> id
+    spawned_names: Set[str] = field(default_factory=set)
+
+
+def target_files(root: str, files: Optional[Sequence[SourceFile]] = None
+                 ) -> List[SourceFile]:
+    """The scanned SourceFiles under this rule's TARGET_PREFIXES."""
+    if files is not None:
+        return [sf for sf in files
+                if sf.relpath.replace('\\', '/').startswith(TARGET_PREFIXES)]
+    rels = [rel for rel in iter_python_files(root)
+            if rel.replace('\\', '/').startswith(TARGET_PREFIXES)]
+    return [SourceFile.load(root, rel) for rel in rels]
+
+
+# ---------------------------------------------------------------- extraction
+def _call_last_seg(node: ast.expr) -> Optional[str]:
+    d = dotted_name(node)
+    return d.split('.')[-1] if d else None
+
+
+def _self_attr(node: ast.expr) -> Optional[str]:
+    """'x' for a bare ``self.x`` attribute node."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == 'self'):
+        return node.attr
+    return None
+
+
+def _is_container_value(v: ast.expr) -> bool:
+    if isinstance(v, (ast.Dict, ast.List, ast.Set, ast.DictComp,
+                      ast.ListComp, ast.SetComp)):
+        return True
+    if isinstance(v, ast.Call):
+        seg = _call_last_seg(v.func)
+        return seg in ('dict', 'list', 'set', 'deque', 'defaultdict',
+                       'OrderedDict')
+    return False
+
+
+def _extract_module(sf: SourceFile) -> ModuleInfo:
+    mod = ModuleInfo(sf=sf)
+    for node in sf.tree.body:
+        if isinstance(node, ast.ClassDef):
+            ci = ClassInfo(sf=sf, node=node)
+            base_names = {(_call_last_seg(b) or '') for b in node.bases}
+            ci.handler_base = bool(base_names & _THREADED_BASES) or any(
+                'Threading' in b for b in base_names)
+            for item in node.body:
+                if isinstance(item, ast.FunctionDef):
+                    ci.methods[item.name] = item
+            mod.classes.append(ci)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            mod.functions[node.name] = node
+        elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            v = node.value
+            if isinstance(v, ast.Call) \
+                    and _call_last_seg(v.func) in _LOCK_FACTORIES:
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        mod.mod_locks[t.id] = f'{sf.relpath}:{t.id}'
+    # names passed (positionally or by keyword, e.g. target=) into
+    # thread-spawn calls anywhere in the file — walker.index_functions
+    # does exactly this collection for a configurable wrapper set
+    _, mod.spawned_names = index_functions(sf, _SPAWN_WRAPPERS)
+    # classify instance attrs from every method body
+    for ci in mod.classes:
+        for m in ci.methods.values():
+            for sub in ast.walk(m):
+                if isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                    targets = (sub.targets if isinstance(sub, ast.Assign)
+                               else [sub.target])
+                    for t in targets:
+                        attr = _self_attr(t)
+                        if attr is None:
+                            continue
+                        v = sub.value
+                        if v is None:
+                            continue
+                        if isinstance(v, ast.Call):
+                            seg = _call_last_seg(v.func)
+                            if seg in _LOCK_FACTORIES:
+                                ci.lock_attrs[attr] = _LOCK_FACTORIES[seg]
+                            if seg in _SAFE_FACTORIES:
+                                ci.safe_attrs.add(attr)
+                            if seg in _THREAD_FACTORIES:
+                                ci.thread_attrs.add(attr)
+                        if _is_container_value(v):
+                            ci.container_attrs.add(attr)
+        ci.entry_methods = {
+            name for name in ci.methods
+            if name in mod.spawned_names or name in _HANDLER_METHODS}
+    return mod
+
+
+# ------------------------------------------------------- may-acquire summary
+def _fn_units(mods: List[ModuleInfo]):
+    """Yield (key, fn_node, class_or_None, mod) for every function/method
+    (nested defs included) in the scanned tree."""
+    for mod in mods:
+        for ci in mod.classes:
+            for name, fn in ci.methods.items():
+                yield (f'{mod.sf.relpath}:{ci.name}.{name}', fn, ci, mod)
+        for name, fn in mod.functions.items():
+            yield (f'{mod.sf.relpath}:{name}', fn, None, mod)
+
+
+def _resolve_lock(node: ast.expr, ci: Optional[ClassInfo],
+                  mod: ModuleInfo) -> Optional[str]:
+    """Lock id for an expression that names a lock: ``self._lock`` (a
+    class lock attr) or a module-level lock global."""
+    attr = _self_attr(node)
+    if attr is not None and ci is not None and attr in ci.lock_attrs:
+        return ci.lock_id(attr)
+    if isinstance(node, ast.Name) and node.id in mod.mod_locks:
+        return mod.mod_locks[node.id]
+    return None
+
+
+def _summaries(mods: List[ModuleInfo]) -> Dict[str, Set[str]]:
+    """Fixpoint of may-acquire(fn): every lock id a function can acquire
+    transitively, with bare-name call resolution (minus builtin
+    container/file names)."""
+    direct: Dict[str, Set[str]] = {}
+    calls: Dict[str, Set[Tuple[str, str]]] = {}   # key -> {(kind, name)}
+    for key, fn, ci, mod in _fn_units(mods):
+        acq: Set[str] = set()
+        out: Set[Tuple[str, str]] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    lock = _resolve_lock(item.context_expr, ci, mod)
+                    if lock:
+                        acq.add(lock)
+            elif isinstance(node, ast.Call):
+                if isinstance(node.func, ast.Attribute):
+                    m = node.func.attr
+                    if m == 'acquire':
+                        lock = _resolve_lock(node.func.value, ci, mod)
+                        if lock:
+                            acq.add(lock)
+                    elif m not in _BUILTIN_METHODS:
+                        recv = node.func.value
+                        if isinstance(recv, ast.Name) \
+                                and recv.id == 'self' \
+                                and ci is not None and m in ci.methods:
+                            out.add(('self',
+                                     f'{mod.sf.relpath}:{ci.name}.{m}'))
+                        else:
+                            out.add(('bare', m))
+                elif isinstance(node.func, ast.Name):
+                    out.add(('bare', node.func.id))
+        direct[key] = acq
+        calls[key] = out
+    # strip class-method keys down to bare method names for resolution
+    bare_index: Dict[str, List[str]] = {}
+    for key in direct:
+        tail = key.split(':', 1)[1]
+        bare = tail.rsplit('.', 1)[-1]
+        bare_index.setdefault(bare, []).append(key)
+
+    summary = {k: set(v) for k, v in direct.items()}
+    changed = True
+    while changed:
+        changed = False
+        for key, outs in calls.items():
+            cur = summary[key]
+            before = len(cur)
+            for kind, name in outs:
+                if kind == 'self':
+                    cur |= summary.get(name, set())
+                else:
+                    if name in _BUILTIN_METHODS:
+                        continue
+                    for target in bare_index.get(name, ()):
+                        cur |= summary[target]
+            if len(cur) != before:
+                changed = True
+    return {k: v for k, v in summary.items()}
+
+
+def _bare_summary(bare: str, summaries: Dict[str, Set[str]],
+                  cache: Dict[str, Set[str]]) -> Set[str]:
+    got = cache.get(bare)
+    if got is None:
+        got = set()
+        for key, locks in summaries.items():
+            tail = key.split(':', 1)[1]
+            if tail.rsplit('.', 1)[-1] == bare:
+                got |= locks
+        cache[bare] = got
+    return got
+
+
+# --------------------------------------------------------------- the walker
+class _Analysis:
+    """One full-tree analysis run: accesses, lock-order edges, and the
+    walk-time findings (notify-without-lock, init publication)."""
+
+    def __init__(self, mods: List[ModuleInfo]):
+        self.mods = mods
+        self.graph = LockGraph()
+        self.summaries = _summaries(mods)
+        self._bare_cache: Dict[str, Set[str]] = {}
+        self.raw_findings: List[Tuple[SourceFile, int, str]] = []
+        for mod in mods:
+            for lock_id in mod.mod_locks.values():
+                self.graph.add_node(lock_id)
+            for ci in mod.classes:
+                for attr in ci.lock_attrs:
+                    self.graph.add_node(ci.lock_id(attr))
+
+    # ------------------------------------------------------------- entry
+    def run(self) -> None:
+        for mod in self.mods:
+            for ci in mod.classes:
+                self._walk_class(ci, mod)
+            for name, fn in mod.functions.items():
+                self._walk_fn(fn, set(), 'fn', f'{mod.sf.relpath}:{name}',
+                              None, mod, ())
+        for mod in self.mods:
+            for ci in mod.classes:
+                self._check_init_publication(ci, mod)
+
+    def _contexts(self, ci: ClassInfo) -> List[Tuple[str, str]]:
+        ctxs: List[Tuple[str, str]] = []
+        for m in sorted(ci.entry_methods):
+            ctxs.append((f'thread:{m}', m))
+        for m in sorted(ci.methods):
+            if m in ci.entry_methods:
+                continue
+            public = (not m.startswith('_')
+                      or m in ('__iter__', '__next__', '__enter__',
+                               '__exit__', '__call__'))
+            if public:
+                ctxs.append((f'api:{m}', m))
+        if '__init__' in ci.methods:
+            ctxs.append(('init', '__init__'))
+        return ctxs
+
+    def _walk_class(self, ci: ClassInfo, mod: ModuleInfo) -> None:
+        for ctx, m in self._contexts(ci):
+            self._walk_fn(ci.methods[m], set(), ctx,
+                          f'{mod.sf.relpath}:{ci.name}.{m}', ci, mod,
+                          ((ci.name, m),))
+
+    # -------------------------------------------------------- statement walk
+    def _walk_fn(self, fn, held: Set[str], ctx: str, func_key: str,
+                 ci: Optional[ClassInfo], mod: ModuleInfo,
+                 stack: Tuple) -> None:
+        if len(stack) > 10:
+            return
+        self._walk_body(fn.body, held, ctx, func_key, ci, mod, stack)
+
+    def _walk_body(self, stmts, held: Set[str], ctx: str, func_key: str,
+                   ci, mod, stack) -> None:
+        for stmt in stmts:
+            self._walk_stmt(stmt, held, ctx, func_key, ci, mod, stack)
+
+    def _walk_stmt(self, stmt, held: Set[str], ctx: str, func_key: str,
+                   ci, mod, stack) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a closure: it runs later, on whatever thread it is handed
+            # to, with none of the locks currently held
+            sub_ctx = (f'thread:{ctx.split(":", 1)[-1]}.{stmt.name}'
+                       if stmt.name in mod.spawned_names
+                       else f'closure:{ctx.split(":", 1)[-1]}.{stmt.name}')
+            self._walk_fn(stmt, set(), sub_ctx,
+                          f'{func_key}.{stmt.name}', ci, mod,
+                          stack + ((stmt.name,),))
+            return
+        if isinstance(stmt, ast.With):
+            new_held = set(held)
+            for item in stmt.items:
+                lock = _resolve_lock(item.context_expr, ci, mod)
+                if lock is not None:
+                    for h in new_held:
+                        self._edge(h, lock, mod.sf.relpath,
+                                   item.context_expr.lineno)
+                    new_held.add(lock)
+                else:
+                    self._scan_expr(item.context_expr, new_held, ctx,
+                                    func_key, ci, mod, stack)
+            self._walk_body(stmt.body, new_held, ctx, func_key, ci, mod,
+                            stack)
+            return
+        if isinstance(stmt, ast.Try):
+            for block in (stmt.body, stmt.orelse, stmt.finalbody):
+                self._walk_body(block, set(held), ctx, func_key, ci, mod,
+                                stack)
+            for handler in stmt.handlers:
+                self._walk_body(handler.body, set(held), ctx, func_key,
+                                ci, mod, stack)
+            return
+        if isinstance(stmt, ast.If):
+            # acquire-in-test (`if not lock.acquire(blocking=False):
+            # raise`) leaves the lock held on the fallthrough path
+            self._scan_expr(stmt.test, held, ctx, func_key, ci, mod,
+                            stack)
+            self._walk_body(stmt.body, set(held), ctx, func_key, ci, mod,
+                            stack)
+            self._walk_body(stmt.orelse, set(held), ctx, func_key, ci,
+                            mod, stack)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._scan_expr(stmt.iter, held, ctx, func_key, ci, mod,
+                            stack)
+            self._walk_body(stmt.body, set(held), ctx, func_key, ci, mod,
+                            stack)
+            self._walk_body(stmt.orelse, set(held), ctx, func_key, ci,
+                            mod, stack)
+            return
+        if isinstance(stmt, ast.While):
+            self._scan_expr(stmt.test, held, ctx, func_key, ci, mod,
+                            stack)
+            self._walk_body(stmt.body, set(held), ctx, func_key, ci, mod,
+                            stack)
+            self._walk_body(stmt.orelse, set(held), ctx, func_key, ci,
+                            mod, stack)
+            return
+        if isinstance(stmt, ast.AugAssign):
+            attr = _self_attr(stmt.target)
+            if attr is None and isinstance(stmt.target, ast.Subscript):
+                attr = _self_attr(stmt.target.value)
+            if attr is not None and ci is not None:
+                self._record(ci, attr, 'rmw', stmt.lineno, held, ctx,
+                             func_key)
+            self._scan_expr(stmt.value, held, ctx, func_key, ci, mod,
+                            stack)
+            if isinstance(stmt.target, ast.Subscript):
+                self._scan_expr(stmt.target.slice, held, ctx, func_key,
+                                ci, mod, stack)
+            return
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+            targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                       else [stmt.target])
+            for t in targets:
+                attr = _self_attr(t)
+                if attr is not None and ci is not None:
+                    self._record(ci, attr, 'write', t.lineno, held, ctx,
+                                 func_key)
+                elif isinstance(t, ast.Subscript):
+                    sattr = _self_attr(t.value)
+                    if sattr is not None and ci is not None:
+                        self._record(ci, sattr, 'write', t.lineno, held,
+                                     ctx, func_key, flavor='mutate')
+                    else:
+                        self._scan_expr(t.value, held, ctx, func_key, ci,
+                                        mod, stack)
+                    self._scan_expr(t.slice, held, ctx, func_key, ci,
+                                    mod, stack)
+                elif isinstance(t, (ast.Tuple, ast.List)):
+                    for el in t.elts:
+                        a = _self_attr(el)
+                        if a is not None and ci is not None:
+                            self._record(ci, a, 'write', el.lineno, held,
+                                         ctx, func_key)
+            if stmt.value is not None:
+                self._scan_expr(stmt.value, held, ctx, func_key, ci, mod,
+                                stack)
+            return
+        if isinstance(stmt, ast.Delete):
+            for t in stmt.targets:
+                if isinstance(t, ast.Subscript):
+                    sattr = _self_attr(t.value)
+                    if sattr is not None and ci is not None:
+                        self._record(ci, sattr, 'write', t.lineno, held,
+                                     ctx, func_key, flavor='mutate')
+            return
+        # expression-bearing simple statements
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._scan_expr(child, held, ctx, func_key, ci, mod,
+                                stack)
+            elif isinstance(child, ast.stmt):
+                self._walk_stmt(child, held, ctx, func_key, ci, mod,
+                                stack)
+
+    # ------------------------------------------------------ expression scan
+    def _scan_expr(self, expr, held: Set[str], ctx: str, func_key: str,
+                   ci, mod, stack) -> None:
+        """Recursive single-visit dispatch (ast.walk would re-visit every
+        nested call once per ancestor)."""
+        if expr is None or isinstance(expr, ast.Lambda):
+            return
+        if isinstance(expr, ast.Call):
+            self._scan_call(expr, held, ctx, func_key, ci, mod, stack)
+            return
+        if isinstance(expr, ast.Attribute):
+            attr = _self_attr(expr)
+            if attr is not None:
+                if ci is not None and isinstance(expr.ctx, ast.Load):
+                    # reading the reference is the racy part, whatever
+                    # happens to the object afterwards
+                    self._record(ci, attr, 'read', expr.lineno, held,
+                                 ctx, func_key)
+                return
+            self._scan_expr(expr.value, held, ctx, func_key, ci, mod,
+                            stack)
+            return
+        if isinstance(expr, ast.Compare):
+            self._scan_expr(expr.left, held, ctx, func_key, ci, mod,
+                            stack)
+            for op, comparator in zip(expr.ops, expr.comparators):
+                a = _self_attr(comparator)
+                if isinstance(op, (ast.In, ast.NotIn)) and a is not None:
+                    if ci is not None:
+                        # membership probe: the `check` half of
+                        # check-then-act
+                        self._record(ci, a, 'read', comparator.lineno,
+                                     held, ctx, func_key, flavor='check')
+                else:
+                    self._scan_expr(comparator, held, ctx, func_key, ci,
+                                    mod, stack)
+            return
+        if isinstance(expr, ast.Subscript):
+            a = _self_attr(expr.value)
+            if a is not None and ci is not None \
+                    and isinstance(expr.ctx, ast.Load):
+                self._record(ci, a, 'read', expr.lineno, held, ctx,
+                             func_key, flavor='check')
+            else:
+                self._scan_expr(expr.value, held, ctx, func_key, ci, mod,
+                                stack)
+            self._scan_expr(expr.slice, held, ctx, func_key, ci, mod,
+                            stack)
+            return
+        for child in ast.iter_child_nodes(expr):
+            if isinstance(child, ast.expr):
+                self._scan_expr(child, held, ctx, func_key, ci, mod,
+                                stack)
+            elif isinstance(child, ast.comprehension):
+                self._scan_expr(child.iter, held, ctx, func_key, ci, mod,
+                                stack)
+                for cond in child.ifs:
+                    self._scan_expr(cond, held, ctx, func_key, ci, mod,
+                                    stack)
+
+    def _scan_call(self, node: ast.Call, held: Set[str], ctx: str,
+                   func_key: str, ci, mod, stack) -> None:
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            m = f.attr
+            lock = _resolve_lock(f.value, ci, mod)
+            if lock is not None:
+                if m == 'acquire':
+                    for h in held:
+                        self._edge(h, lock, mod.sf.relpath, node.lineno)
+                    held.add(lock)
+                elif m == 'release':
+                    held.discard(lock)
+                elif m in ('notify', 'notify_all') and lock not in held:
+                    self.raw_findings.append((
+                        mod.sf, node.lineno,
+                        f'{dotted_name(f)}() without holding the '
+                        f'condition lock {lock} — a waiter can miss the '
+                        f'wakeup or the call raises RuntimeError; call '
+                        f'it inside `with` on the condition'))
+            elif isinstance(f.value, ast.Name) and f.value.id == 'self' \
+                    and ci is not None and m in ci.methods:
+                # intra-class `self.helper()`: inline with the current
+                # lock set, so helpers are credited with their caller's
+                # guard (e.g. _poll_locked's lock covers the fields its
+                # private callees touch)
+                key = (ci.name, m)
+                if key not in stack:
+                    self._walk_fn(ci.methods[m], set(held), ctx,
+                                  f'{mod.sf.relpath}:{ci.name}.{m}', ci,
+                                  mod, stack + (key,))
+            else:
+                recv_attr = _self_attr(f.value)
+                if recv_attr is not None and ci is not None:
+                    self._record(ci, recv_attr, 'read', f.lineno, held,
+                                 ctx, func_key)
+                    # container probes / mutations through methods: the
+                    # two halves of check-then-act
+                    if m in _MUTATORS:
+                        self._record(ci, recv_attr, 'write', f.lineno,
+                                     held, ctx, func_key,
+                                     flavor='mutate')
+                    elif m in _CHECKERS:
+                        self._record(ci, recv_attr, 'read', f.lineno,
+                                     held, ctx, func_key, flavor='check')
+                else:
+                    self._scan_expr(f.value, held, ctx, func_key, ci,
+                                    mod, stack)
+                if m not in _BUILTIN_METHODS and held:
+                    # foreign call while holding: every lock the bare
+                    # name may transitively acquire becomes an edge
+                    for lock2 in _bare_summary(m, self.summaries,
+                                               self._bare_cache):
+                        for h in held:
+                            self._edge(h, lock2, mod.sf.relpath,
+                                       node.lineno)
+        elif isinstance(f, ast.Name) and held:
+            if f.id in mod.functions:
+                key = f'{mod.sf.relpath}:{f.id}'
+                for lock2 in self.summaries.get(key, set()):
+                    for h in held:
+                        self._edge(h, lock2, mod.sf.relpath, node.lineno)
+            elif f.id not in _BUILTIN_METHODS:
+                for lock2 in _bare_summary(f.id, self.summaries,
+                                           self._bare_cache):
+                    for h in held:
+                        self._edge(h, lock2, mod.sf.relpath, node.lineno)
+        elif not isinstance(f, (ast.Name, ast.Attribute)):
+            self._scan_expr(f, held, ctx, func_key, ci, mod, stack)
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            self._scan_expr(arg, held, ctx, func_key, ci, mod, stack)
+
+    # ----------------------------------------------------------- recording
+    def _record(self, ci: ClassInfo, attr: str, kind: str, line: int,
+                held: Set[str], ctx: str, func_key: str,
+                flavor: str = '') -> None:
+        if attr in ci.safe_attrs or attr in ci.lock_attrs:
+            return
+        ci.accesses.append(Access(attr=attr, kind=kind, line=line,
+                                  held=frozenset(held), ctx=ctx,
+                                  func_key=func_key, flavor=flavor))
+
+    def _edge(self, held: str, acquired: str, path: str,
+              line: int) -> None:
+        self.graph.add_edge(held, acquired, path, line)
+
+    # ------------------------------------------------- init publication (3d)
+    def _check_init_publication(self, ci: ClassInfo,
+                                mod: ModuleInfo) -> None:
+        init = ci.methods.get('__init__')
+        if init is None:
+            return
+        order: List[ast.stmt] = []
+
+        def flatten(stmts):
+            for s in stmts:
+                order.append(s)
+                for block in ('body', 'orelse', 'finalbody'):
+                    sub = getattr(s, block, None)
+                    if sub:
+                        flatten(sub)
+                for handler in getattr(s, 'handlers', ()):
+                    flatten(handler.body)
+
+        flatten(init.body)
+        first_assign: Dict[str, int] = {}
+        starts: List[Tuple[int, int, str]] = []   # (order idx, line, name)
+        for idx, s in enumerate(order):
+            for sub in ast.walk(s):
+                if isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                    targets = (sub.targets if isinstance(sub, ast.Assign)
+                               else [sub.target])
+                    for t in targets:
+                        a = _self_attr(t)
+                        if a is not None:
+                            first_assign.setdefault(a, idx)
+                elif isinstance(sub, ast.Call) \
+                        and isinstance(sub.func, ast.Attribute) \
+                        and sub.func.attr == 'start':
+                    recv = sub.func.value
+                    a = _self_attr(recv)
+                    started = None
+                    if a is not None and a in ci.thread_attrs:
+                        started = f'self.{a}'
+                    elif isinstance(recv, ast.Call) \
+                            and _call_last_seg(recv.func) \
+                            in _THREAD_FACTORIES:
+                        started = 'Thread(...)'
+                    if started is not None:
+                        starts.append((idx, sub.lineno, started))
+        for idx, line, name in starts:
+            late = sorted(a for a, i in first_assign.items() if i > idx)
+            if late:
+                self.raw_findings.append((
+                    mod.sf, line,
+                    f'{name}.start() in {ci.name}.__init__ before '
+                    f'field(s) {", ".join(late)} are assigned — the '
+                    f'started thread can observe a partially constructed '
+                    f'object; assign every field before publishing'))
+
+
+# ------------------------------------------------------------------ passes
+def _uniq(accs: List[Access]) -> List[Access]:
+    seen = set()
+    out = []
+    for a in accs:
+        key = (a.line, a.kind, a.held)
+        if key not in seen:
+            seen.add(key)
+            out.append(a)
+    return out
+
+
+def _field_findings(ci: ClassInfo) -> List[Tuple[SourceFile, int, str]]:
+    """Pass 1 (majority-guard outliers) + pass 3a (lockless RMW in a
+    thread context) + pass 3b (lockless check-then-act on a container)
+    for one class."""
+    out: List[Tuple[SourceFile, int, str]] = []
+    by_attr: Dict[str, List[Access]] = {}
+    for a in ci.accesses:
+        by_attr.setdefault(a.attr, []).append(a)
+    for attr in sorted(by_attr):
+        accs = [a for a in by_attr[attr] if a.ctx != 'init']
+        if not accs:
+            continue
+        writes = any(a.kind in ('write', 'rmw') for a in accs)
+        ctxs = {a.ctx for a in accs}
+        thread_ctxs = {c for c in ctxs if c.startswith('thread:')}
+        shared = (len(ctxs) >= 2 or ci.handler_base
+                  or (thread_ctxs and len(ctxs) > len(thread_ctxs)))
+        uniq = _uniq(accs)
+        flagged_lines: Set[int] = set()
+
+        # ---- pass 1: majority-guard inference
+        if writes and shared:
+            lock_votes: Dict[str, int] = {}
+            for a in uniq:
+                for lk in a.held:
+                    lock_votes[lk] = lock_votes.get(lk, 0) + 1
+            if lock_votes:
+                best = max(sorted(lock_votes), key=lambda k: lock_votes[k])
+                n_guard, n = lock_votes[best], len(uniq)
+                if 2 * n_guard > n and n_guard < n:
+                    for a in uniq:
+                        if best not in a.held:
+                            flagged_lines.add(a.line)
+                            out.append((
+                                ci.sf, a.line,
+                                f"field '{ci.name}.{attr}' is guarded by "
+                                f'{best} on {n_guard}/{n} access sites, '
+                                f'but this {a.kind} (context {a.ctx}) '
+                                f'holds no such lock — take the lock, or '
+                                f'suppress with a justification if the '
+                                f'race is benign by design'))
+
+        # ---- pass 3a: lockless read-modify-write in a thread context
+        if ci.lock_attrs or ci.handler_base:
+            for a in uniq:
+                if a.kind != 'rmw' or a.held or a.line in flagged_lines:
+                    continue
+                if a.ctx.startswith('thread:') or ci.handler_base:
+                    flagged_lines.add(a.line)
+                    out.append((
+                        ci.sf, a.line,
+                        f"read-modify-write of '{ci.name}.{attr}' with "
+                        f'no lock held in concurrent context {a.ctx} — '
+                        f'`+=` is a read, an add and a write; a parallel '
+                        f'writer loses updates. Guard it with the class '
+                        f'lock'))
+
+        # ---- pass 3b: lockless check-then-act on a shared container
+        if attr in ci.container_attrs and ci.concurrent and shared:
+            by_fn: Dict[str, List[Access]] = {}
+            for a in accs:
+                by_fn.setdefault(a.func_key, []).append(a)
+            for fn_accs in by_fn.values():
+                checks = [a for a in fn_accs
+                          if a.flavor == 'check' and not a.held]
+                mutates = [a for a in fn_accs
+                           if a.flavor == 'mutate' and not a.held]
+                for m in mutates:
+                    if m.line in flagged_lines:
+                        continue
+                    priors = [c for c in checks if c.line <= m.line]
+                    if priors:
+                        flagged_lines.add(m.line)
+                        out.append((
+                            ci.sf, m.line,
+                            f"check-then-act on '{ci.name}.{attr}': "
+                            f'checked at line {priors[0].line}, mutated '
+                            f'here, no lock held at either site — '
+                            f'another thread can interleave between the '
+                            f'check and the act; hold one lock across '
+                            f'both'))
+    return out
+
+
+# -------------------------------------------------------------- public API
+def analyze(root: str, files: Optional[Sequence[SourceFile]] = None
+            ) -> Tuple[_Analysis, List[SourceFile]]:
+    """Run the extraction + walk; returns the Analysis (accesses, lock
+    graph, walk-time findings) and the scanned files."""
+    sfs = target_files(root, files)
+    mods = [_extract_module(sf) for sf in sfs]
+    ana = _Analysis(mods)
+    ana.run()
+    return ana, sfs
+
+
+def build_lockgraph(root: str,
+                    files: Optional[Sequence[SourceFile]] = None
+                    ) -> LockGraph:
+    """The observed acquired-while-holding graph for the tree."""
+    ana, _ = analyze(root, files)
+    return ana.graph
+
+
+def update_lockgraph(root: str) -> Dict:
+    """Re-pin SEGRACE.json from the observed graph (refuses on a cycle).
+    Returns the written sidecar dict."""
+    return save_sidecar(root, build_lockgraph(root))
+
+
+def check_concurrency(root: str,
+                      files: Optional[Sequence[SourceFile]] = None
+                      ) -> List[Finding]:
+    """All three passes + the SEGRACE.json gate; suppression via
+    ``# segcheck: disable=concurrency`` like every other rule."""
+    ana, sfs = analyze(root, files)
+    raw: List[Tuple[SourceFile, int, str]] = list(ana.raw_findings)
+    for mod in ana.mods:
+        for ci in mod.classes:
+            raw.extend(_field_findings(ci))
+    # lock-order gate (cycles always; edges vs the committed sidecar)
+    by_path = {sf.relpath: sf for sf in sfs}
+    for path, line, msg in compare(ana.graph, load_sidecar(root)):
+        sf = by_path.get(path)
+        if sf is not None:
+            raw.append((sf, line, msg))
+        else:
+            raw.append((None, line, msg))
+
+    findings: List[Finding] = []
+    seen = set()
+    for sf, line, msg in raw:
+        if sf is None:
+            findings.append(Finding(rule=RULE_CONCURRENCY,
+                                    path='SEGRACE.json', line=line,
+                                    message=msg))
+            continue
+        f = sf.finding(RULE_CONCURRENCY, line, msg)
+        if f is not None and (f.path, f.line, f.message) not in seen:
+            seen.add((f.path, f.line, f.message))
+            findings.append(f)
+    return sorted(findings, key=lambda f: (f.path, f.line, f.message))

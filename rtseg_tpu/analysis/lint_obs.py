@@ -28,7 +28,8 @@ import os
 from typing import Dict, List, Set, Tuple
 
 from .core import Finding, RULE_OBS, SourceFile
-from .lint_trace import _dotted, jit_reachable, target_files
+from .lint_trace import jit_reachable, target_files
+from .walker import dotted_name as _dotted
 
 def _obs_submodules() -> frozenset:
     """rtseg_tpu/obs submodule names, derived from the package directory

@@ -19,9 +19,12 @@ files too) and flags forbidden calls in any reachable function.
 from __future__ import annotations
 
 import ast
-from typing import Dict, List, Optional, Set, Tuple
+from typing import List, Optional, Tuple
 
 from .core import Finding, RULE_TRACE, SourceFile, iter_python_files
+from .walker import FnInfo as _FnInfo  # noqa: F401 — re-export (tests)
+from .walker import dotted_name as _dotted
+from .walker import index_functions, reachable_functions
 
 #: files whose functions may end up inside a jax trace. serve/ is covered
 #: so the serving subsystem's host-side queue/telemetry code (wall clocks,
@@ -56,88 +59,11 @@ FORBIDDEN_PREFIXES: Tuple[Tuple[str, str], ...] = (
 )
 
 
-def _dotted(func: ast.expr) -> Optional[str]:
-    parts: List[str] = []
-    node = func
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return '.'.join(reversed(parts))
-    return None
-
-
-class _FnInfo:
-    def __init__(self, sf: SourceFile, node: ast.AST, qualname: str):
-        self.sf = sf
-        self.node = node
-        self.qualname = qualname
-        self.is_root = False
-        self.refs: Set[str] = set()        # bare names referenced in body
-
-
-def _decorated_jit(node) -> bool:
-    for dec in node.decorator_list:
-        target = dec.func if isinstance(dec, ast.Call) else dec
-        name = _dotted(target)
-        if name and name.split('.')[-1] in JIT_WRAPPERS:
-            return True
-        # functools.partial(jax.jit, ...) style decorators
-        if isinstance(dec, ast.Call):
-            for arg in dec.args:
-                d = _dotted(arg)
-                if d and d.split('.')[-1] in JIT_WRAPPERS:
-                    return True
-    return False
-
-
-def _index_file(sf: SourceFile) -> Tuple[Dict[str, _FnInfo], Set[str]]:
-    """Return (functions by bare name, names passed into jit wrappers)."""
-    fns: Dict[str, _FnInfo] = {}
-    root_refs: Set[str] = set()
-
-    def visit(node, prefix):
-        for child in ast.iter_child_nodes(node):
-            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                qual = f'{prefix}{child.name}'
-                info = _FnInfo(sf, child, qual)
-                info.is_root = _decorated_jit(child)
-                for sub in ast.walk(child):
-                    if isinstance(sub, ast.Name):
-                        info.refs.add(sub.id)
-                # keep the outermost definition under a given bare name;
-                # same-name nested closures merge their refs conservatively
-                if child.name in fns:
-                    fns[child.name].refs |= info.refs
-                    fns[child.name].is_root |= info.is_root
-                else:
-                    fns[child.name] = info
-                visit(child, f'{qual}.')
-            else:
-                visit(child, prefix)
-
-    visit(sf.tree, '')
-    for node in ast.walk(sf.tree):
-        if not isinstance(node, ast.Call):
-            continue
-        name = _dotted(node.func)
-        if not name or name.split('.')[-1] not in JIT_WRAPPERS:
-            continue
-        for arg in list(node.args) + [kw.value for kw in node.keywords]:
-            # unwrap functools.partial(fn, ...) around the traced callable
-            if isinstance(arg, ast.Call):
-                fname = _dotted(arg.func)
-                if fname and fname.split('.')[-1] == 'partial':
-                    for inner in arg.args:
-                        d = _dotted(inner)
-                        if d:
-                            root_refs.add(d.split('.')[-1])
-                continue
-            d = _dotted(arg)
-            if d:
-                root_refs.add(d.split('.')[-1])
-    return fns, root_refs
+def _index_file(sf: SourceFile):
+    """(functions by bare name, names passed into jit wrappers) — thin
+    jit-specific view of walker.index_functions (kept: tests probe the
+    recognized root set through it)."""
+    return index_functions(sf, JIT_WRAPPERS)
 
 
 def _forbidden(call: ast.Call) -> Optional[str]:
@@ -166,34 +92,10 @@ def target_files(root: str, files=None) -> List[SourceFile]:
 def jit_reachable(files: List[SourceFile]) -> List[_FnInfo]:
     """Every function reachable from a jit root across `files`, in sorted
     name order. Shared by trace-purity and obs-purity — one definition of
-    'this code runs under a jax trace'."""
-    # global function index by bare name (cross-file edges resolve here)
-    all_fns: Dict[str, List[_FnInfo]] = {}
-    roots: Set[str] = set()
-    wrapper_refs: Set[str] = set()
-    for sf in files:
-        fns, root_refs = _index_file(sf)
-        for name, info in fns.items():
-            all_fns.setdefault(name, []).append(info)
-            if info.is_root:
-                roots.add(name)
-        wrapper_refs |= root_refs
-    roots |= {r for r in wrapper_refs if r in all_fns}
-
-    # reachability over bare-name reference edges
-    reachable: Set[str] = set()
-    frontier = [r for r in roots if r in all_fns]
-    while frontier:
-        name = frontier.pop()
-        if name in reachable:
-            continue
-        reachable.add(name)
-        for info in all_fns.get(name, ()):
-            for ref in info.refs:
-                if ref in all_fns and ref not in reachable:
-                    frontier.append(ref)
-
-    return [info for name in sorted(reachable) for info in all_fns[name]]
+    'this code runs under a jax trace'. The generic walk lives in
+    walker.py (the concurrency auditor runs the same machinery with
+    thread-spawn wrappers as roots instead)."""
+    return reachable_functions(files, JIT_WRAPPERS)
 
 
 def check_trace_purity(root: str, files=None) -> List[Finding]:

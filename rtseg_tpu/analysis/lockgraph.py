@@ -1,0 +1,198 @@
+"""Lock-order graph: acquired-while-holding edges, cycles, and the
+committed SEGRACE.json sidecar.
+
+The concurrency auditor (concurrency.py) walks every scanned function
+with a simulated held-lock set and reports each "acquired B while holding
+A" pair as a directed edge A -> B. This module owns what happens next:
+
+  * :class:`LockGraph` — the measured digraph (nodes = every lock
+    discovered in the tree, edges = acquisition orderings with one
+    witness site each);
+  * cycle detection — a cycle in the acquired-while-holding graph is a
+    potential ABBA deadlock, always a finding, never committable;
+  * topological ranks — the global acquisition order the tree actually
+    implements (rank(A) < rank(B) for every edge A -> B), which is what
+    README's "Locking order" table renders;
+  * the SEGRACE.json sidecar (house style: SEGAUDIT.json) — the committed
+    graph. The gate fails on any measured edge absent from the committed
+    set (a NEW lock ordering is a reviewable event, exactly like a new
+    collective) and on any cycle; ``tools/segcheck.py --update-lockgraph``
+    re-pins after review.
+
+Lock identity is source-anchored: ``<relpath>:<Class>.<attr>`` for
+instance locks (one node per class attribute — every instance of a class
+follows the same discipline, which is the property being checked) and
+``<relpath>:<NAME>`` for module-level locks.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+#: the committed sidecar, repo-root relative
+SEGRACE_FILE = 'SEGRACE.json'
+
+
+class LockGraph:
+    """Observed acquired-while-holding digraph over source-anchored lock
+    ids. ``add_edge`` keeps the first witness site per edge for finding
+    messages."""
+
+    def __init__(self):
+        self.nodes: Set[str] = set()
+        self.edges: Dict[Tuple[str, str], Tuple[str, int]] = {}
+
+    def add_node(self, lock: str) -> None:
+        self.nodes.add(lock)
+
+    def add_edge(self, held: str, acquired: str, path: str,
+                 line: int) -> None:
+        if held == acquired:
+            return                      # re-entrant acquire, not an order
+        self.nodes.add(held)
+        self.nodes.add(acquired)
+        self.edges.setdefault((held, acquired), (path, line))
+
+    # ------------------------------------------------------------- queries
+    def successors(self) -> Dict[str, Set[str]]:
+        out: Dict[str, Set[str]] = {n: set() for n in self.nodes}
+        for a, b in self.edges:
+            out[a].add(b)
+        return out
+
+    def cycles(self) -> List[List[str]]:
+        """Every elementary cycle's node list (deterministic order).
+        Simple DFS back-edge enumeration — lock graphs here are tiny."""
+        succ = self.successors()
+        cycles: List[List[str]] = []
+        seen_keys: Set[Tuple[str, ...]] = set()
+
+        def dfs(node: str, stack: List[str], on_stack: Set[str]) -> None:
+            for nxt in sorted(succ.get(node, ())):
+                if nxt in on_stack:
+                    cyc = stack[stack.index(nxt):] + [nxt]
+                    # canonical key: rotation-invariant
+                    body = cyc[:-1]
+                    i = body.index(min(body))
+                    key = tuple(body[i:] + body[:i])
+                    if key not in seen_keys:
+                        seen_keys.add(key)
+                        cycles.append(cyc)
+                elif nxt not in visited:
+                    visited.add(nxt)
+                    stack.append(nxt)
+                    on_stack.add(nxt)
+                    dfs(nxt, stack, on_stack)
+                    stack.pop()
+                    on_stack.discard(nxt)
+
+        visited: Set[str] = set()
+        for start in sorted(self.nodes):
+            if start not in visited:
+                visited.add(start)
+                dfs(start, [start], {start})
+        return cycles
+
+    def topo_ranks(self) -> Dict[str, int]:
+        """Kahn's algorithm with lexicographic tie-break; raises
+        ValueError on a cycle (a cyclic order cannot be committed)."""
+        succ = self.successors()
+        indeg = {n: 0 for n in self.nodes}
+        for _, b in self.edges:
+            indeg[b] += 1
+        ready = sorted(n for n, d in indeg.items() if d == 0)
+        ranks: Dict[str, int] = {}
+        rank = 0
+        while ready:
+            n = ready.pop(0)
+            ranks[n] = rank
+            rank += 1
+            for m in sorted(succ.get(n, ())):
+                indeg[m] -= 1
+                if indeg[m] == 0:
+                    ready.append(m)
+            ready.sort()
+        if len(ranks) != len(self.nodes):
+            raise ValueError('lock graph has a cycle; refusing to pin an '
+                             'order — fix the cycle first')
+        return ranks
+
+    # ----------------------------------------------------------- sidecar IO
+    def to_sidecar(self) -> Dict:
+        ranks = self.topo_ranks()
+        return {
+            '_comment': (
+                'segrace lock-order sidecar: the committed global lock '
+                'acquisition order. Every measured acquired-while-holding '
+                'edge must appear in "edges" (rank[from] < rank[to]); a '
+                'new edge or a cycle fails `segcheck`. Re-pin after '
+                'review with `tools/segcheck.py --update-lockgraph`.'),
+            'locks': {n: ranks[n] for n in sorted(self.nodes,
+                                                  key=lambda n: (ranks[n],
+                                                                 n))},
+            'edges': [[a, b, f'{p}:{ln}']
+                      for (a, b), (p, ln) in sorted(self.edges.items())],
+        }
+
+
+def sidecar_path(root: str) -> str:
+    return os.path.join(root, SEGRACE_FILE)
+
+
+def load_sidecar(root: str) -> Optional[Dict]:
+    path = sidecar_path(root)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def save_sidecar(root: str, graph: LockGraph) -> Dict:
+    data = graph.to_sidecar()        # raises on a cycle, nothing written
+    with open(sidecar_path(root), 'w') as f:
+        json.dump(data, f, indent=1, sort_keys=False)
+        f.write('\n')
+    return data
+
+
+def committed_edges(sidecar: Dict) -> Set[Tuple[str, str]]:
+    return {(e[0], e[1]) for e in sidecar.get('edges', ())}
+
+
+def compare(graph: LockGraph, sidecar: Optional[Dict]
+            ) -> List[Tuple[str, int, str]]:
+    """Gate the observed graph against the committed sidecar. Returns
+    (path, line, message) triples — cycles first (always findings, with
+    or without a sidecar), then missing-sidecar / new-edge findings."""
+    problems: List[Tuple[str, int, str]] = []
+    for cyc in graph.cycles():
+        sites = ' ; '.join(
+            f'{a}->{b} at {graph.edges[(a, b)][0]}:{graph.edges[(a, b)][1]}'
+            for a, b in zip(cyc, cyc[1:]) if (a, b) in graph.edges)
+        first = next(((a, b) for a, b in zip(cyc, cyc[1:])
+                      if (a, b) in graph.edges), None)
+        path, line = graph.edges[first] if first else ('SEGRACE.json', 1)
+        problems.append((path, line,
+                         'lock-order cycle (potential ABBA deadlock): '
+                         + ' -> '.join(cyc) + f' [{sites}]'))
+    if problems:
+        return problems                # a cyclic graph gates on the cycle
+    if graph.edges and sidecar is None:
+        path, line = sorted(graph.edges.values())[0]
+        problems.append((path, line,
+                         f'{SEGRACE_FILE} is missing but the tree has '
+                         f'{len(graph.edges)} lock-order edge(s); pin the '
+                         f'order with `tools/segcheck.py '
+                         f'--update-lockgraph` and commit it'))
+        return problems
+    known = committed_edges(sidecar) if sidecar else set()
+    for (a, b), (path, line) in sorted(graph.edges.items()):
+        if (a, b) not in known:
+            problems.append((
+                path, line,
+                f'new lock-order edge {a} -> {b} (acquired-while-holding) '
+                f'is not in the committed {SEGRACE_FILE}; review the '
+                f'ordering and re-pin with --update-lockgraph'))
+    return problems

@@ -1,0 +1,163 @@
+"""Shared AST entry-point walker for the source-level analyzers.
+
+Three lint families need the same primitive: "find the functions that run
+in a special execution context (under a jax trace, on another thread),
+then walk everything reachable from them". The jit-reachability half used
+to live inside lint_trace and was borrowed by lint_obs; the concurrency
+auditor (concurrency.py) needs the identical machinery with a different
+root set (thread targets instead of jit wrappers). This module is the one
+definition of that walk:
+
+  * :func:`dotted_name` — ``a.b.c`` spelling of a call target;
+  * :class:`FnInfo` — one (possibly nested) function definition plus the
+    bare names it references;
+  * :func:`index_functions` — every function in a file, plus the names
+    passed by reference into a configurable wrapper-call set (covers
+    positional args, keyword values like ``Thread(target=f)``, and
+    ``functools.partial(f, ...)`` wrapping);
+  * :func:`reachable_functions` — the transitive closure over bare-name
+    reference edges across files, from decorator roots + wrapper-passed
+    roots.
+
+Resolution is deliberately bare-name conservative (a reference to any
+scanned function of that name counts, across files): over-approximation
+keeps the reachability sound for lint purposes without a type system.
+Pure stdlib ``ast`` — this stays importable in the jax-less lint tier.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, List, Optional, Set, Tuple
+
+from .core import SourceFile
+
+
+def dotted_name(func: ast.expr) -> Optional[str]:
+    """``a.b.c`` for an Attribute/Name chain, else None (subscripts,
+    calls-of-calls and other dynamic receivers are unresolvable)."""
+    parts: List[str] = []
+    node = func
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return '.'.join(reversed(parts))
+    return None
+
+
+class FnInfo:
+    """One function definition: its SourceFile, AST node, dotted
+    qualname, whether it is a context root, and the bare names its body
+    references (the reachability edges)."""
+
+    def __init__(self, sf: SourceFile, node: ast.AST, qualname: str):
+        self.sf = sf
+        self.node = node
+        self.qualname = qualname
+        self.is_root = False
+        self.refs: Set[str] = set()        # bare names referenced in body
+
+
+def decorated_with(node, wrappers: FrozenSet[str]) -> bool:
+    """Whether any decorator's last dotted segment is in ``wrappers``
+    (including ``functools.partial(jax.jit, ...)``-style wrapping)."""
+    for dec in node.decorator_list:
+        target = dec.func if isinstance(dec, ast.Call) else dec
+        name = dotted_name(target)
+        if name and name.split('.')[-1] in wrappers:
+            return True
+        if isinstance(dec, ast.Call):
+            for arg in dec.args:
+                d = dotted_name(arg)
+                if d and d.split('.')[-1] in wrappers:
+                    return True
+    return False
+
+
+def index_functions(sf: SourceFile, wrappers: FrozenSet[str]
+                    ) -> Tuple[Dict[str, FnInfo], Set[str]]:
+    """(functions by bare name, bare names passed into wrapper calls).
+
+    A function is a root when decorated with a wrapper; a name is a
+    wrapper-passed root when it appears as a positional arg or a keyword
+    value of a call whose last dotted segment is in ``wrappers`` (so both
+    ``jit(step)`` and ``Thread(target=loop)`` are covered). Same-name
+    definitions merge conservatively (outermost node kept, refs unioned).
+    """
+    fns: Dict[str, FnInfo] = {}
+    root_refs: Set[str] = set()
+
+    def visit(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f'{prefix}{child.name}'
+                info = FnInfo(sf, child, qual)
+                info.is_root = decorated_with(child, wrappers)
+                for sub in ast.walk(child):
+                    if isinstance(sub, ast.Name):
+                        info.refs.add(sub.id)
+                # keep the outermost definition under a given bare name;
+                # same-name nested closures merge their refs conservatively
+                if child.name in fns:
+                    fns[child.name].refs |= info.refs
+                    fns[child.name].is_root |= info.is_root
+                else:
+                    fns[child.name] = info
+                visit(child, f'{qual}.')
+            else:
+                visit(child, prefix)
+
+    visit(sf.tree, '')
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func)
+        if not name or name.split('.')[-1] not in wrappers:
+            continue
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            # unwrap functools.partial(fn, ...) around the passed callable
+            if isinstance(arg, ast.Call):
+                fname = dotted_name(arg.func)
+                if fname and fname.split('.')[-1] == 'partial':
+                    for inner in arg.args:
+                        d = dotted_name(inner)
+                        if d:
+                            root_refs.add(d.split('.')[-1])
+                continue
+            d = dotted_name(arg)
+            if d:
+                root_refs.add(d.split('.')[-1])
+    return fns, root_refs
+
+
+def reachable_functions(files: List[SourceFile],
+                        wrappers: FrozenSet[str]) -> List[FnInfo]:
+    """Every function reachable (bare-name reference edges, cross-file)
+    from a wrapper root across ``files``, in sorted name order."""
+    all_fns: Dict[str, List[FnInfo]] = {}
+    roots: Set[str] = set()
+    wrapper_refs: Set[str] = set()
+    for sf in files:
+        fns, root_refs = index_functions(sf, wrappers)
+        for name, info in fns.items():
+            all_fns.setdefault(name, []).append(info)
+            if info.is_root:
+                roots.add(name)
+        wrapper_refs |= root_refs
+    roots |= {r for r in wrapper_refs if r in all_fns}
+
+    reachable: Set[str] = set()
+    frontier = [r for r in roots if r in all_fns]
+    while frontier:
+        name = frontier.pop()
+        if name in reachable:
+            continue
+        reachable.add(name)
+        for info in all_fns.get(name, ()):
+            for ref in info.refs:
+                if ref in all_fns and ref not in reachable:
+                    frontier.append(ref)
+
+    return [info for name in sorted(reachable) for info in all_fns[name]]

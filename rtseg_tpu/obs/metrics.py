@@ -246,22 +246,28 @@ class MetricsRegistry:
                 self._metrics[key] = m
             return m
 
+    def _set_help(self, name: str, help: str) -> None:
+        # under the registry lock like every other registry map: a scrape
+        # iterating help text must never race a first registration
+        # (CPython dict setdefault happens to be atomic; the segrace
+        # discipline is one lock per metric map, not bytecode trivia)
+        if help and self.enabled:
+            with self._lock:
+                self._help.setdefault(name, help)
+
     def counter(self, name: str, help: str = '',
                 **labels: str) -> Counter:
-        if help and self.enabled:
-            self._help.setdefault(name, help)
+        self._set_help(name, help)
         return self._get('counter', name, labels, Counter)
 
     def gauge(self, name: str, help: str = '', **labels: str) -> Gauge:
-        if help and self.enabled:
-            self._help.setdefault(name, help)
+        self._set_help(name, help)
         return self._get('gauge', name, labels, Gauge)
 
     def histogram(self, name: str, help: str = '',
                   bounds: Tuple[float, ...] = DEFAULT_MS_BOUNDS,
                   window: int = 2048, **labels: str) -> Histogram:
-        if help and self.enabled:
-            self._help.setdefault(name, help)
+        self._set_help(name, help)
         return self._get(
             'histogram', name, labels,
             lambda n, lk: Histogram(n, lk, bounds=bounds, window=window))
@@ -274,6 +280,10 @@ class MetricsRegistry:
     def kind(self, name: str) -> Optional[str]:
         with self._lock:
             return self._types.get(name)
+
+    def help_text(self, name: str) -> str:
+        with self._lock:
+            return self._help.get(name, '')
 
     def snapshot(self) -> Dict[str, Any]:
         """JSON-able view: counters/gauges flat, histograms with bucket
@@ -310,7 +320,7 @@ def render_prometheus(reg: MetricsRegistry) -> str:
     for name in sorted(by_family):
         fam = by_family[name]
         kind = reg.kind(name) or 'untyped'
-        help_text = reg._help.get(name, '')
+        help_text = reg.help_text(name)
         if help_text:
             lines.append(f'# HELP {name} {help_text}')
         lines.append(f'# TYPE {name} {kind}')

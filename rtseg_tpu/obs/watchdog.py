@@ -90,18 +90,28 @@ class StallWatchdog:
 
     # ------------------------------------------------------------ lifecycle
     def start(self) -> None:
-        if self._thread is not None:
-            return
-        self._stop.clear()
-        self._thread = threading.Thread(target=self._loop, daemon=True,
-                                        name='segscope-watchdog')
-        self._thread.start()
+        with self._lock:
+            if self._thread is not None:
+                return
+            self._stop.clear()
+            t = threading.Thread(target=self._loop, daemon=True,
+                                 name='segscope-watchdog')
+            self._thread = t
+        t.start()
 
     def stop(self) -> None:
+        """Idempotent, re-entrant, concurrency-safe shutdown: a double
+        stop() is a no-op, two racing stop()s join at most once (the
+        thread handle is swapped out under the lock), and a stop()
+        issued from the watchdog thread itself never self-joins. The
+        join happens outside the lock so the loop (which takes the lock
+        per poll) can always drain."""
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=5.0)
-            self._thread = None
+        with self._lock:
+            t, self._thread = self._thread, None
+        if t is None or t is threading.current_thread():
+            return
+        t.join(timeout=5.0)
 
     # ----------------------------------------------------------------- loop
     def _loop(self) -> None:
@@ -123,7 +133,10 @@ class StallWatchdog:
 
     def _fire(self, elapsed: float, deadline: float,
               step: Optional[int]) -> None:
-        self.stall_count += 1
+        # the count is read by tests/operators from other threads; `+=`
+        # outside the lock would be a lost-update window (segrace lint)
+        with self._lock:
+            self.stall_count += 1
         stacks = dump_all_stacks()
         # segprof: a short trace of the stalled window, auto-parsed so
         # the stall event itself names what the device was doing (a

@@ -48,34 +48,61 @@ class AsyncCkptWriter:
     ``submit``/``join`` — the epoch loop hears about a bad disk at the
     next save instead of silently training past it. ``join()`` must also
     run before anything *reads* the checkpoint (resume, val_best) and at
-    the end of ``run()``."""
+    the end of ``run()``.
+
+    Shutdown discipline (audited by the segrace `concurrency` lint and
+    pinned by tests): the thread handle and the captured error are
+    lock-guarded, ``join``/``close`` are idempotent (a double close is a
+    no-op) and re-entrant (a call that somehow lands on the writer
+    thread itself — teardown callbacks — never self-joins), and
+    submitters are serialized so two racing ``submit`` calls cannot leak
+    an unjoined writer. Saves therefore stay strictly ordered even when
+    shutdown interleaves with the last save."""
 
     def __init__(self):
+        self._submit_lock = threading.Lock()   # serializes submitters
+        self._lock = threading.Lock()          # guards _thread/_err
         self._thread: Optional[threading.Thread] = None
         self._err: Optional[BaseException] = None
 
     def submit(self, fn: Callable[[], None]) -> None:
-        self.join()
+        with self._submit_lock:
+            self.join()
 
-        def run():
-            try:
-                fn()
-            except BaseException as e:   # noqa: BLE001 — re-raised on join
-                self._err = e
+            def run():
+                try:
+                    fn()
+                except BaseException as e:   # noqa: BLE001 — on join
+                    with self._lock:
+                        self._err = e
 
-        self._thread = threading.Thread(target=run, name='ckpt-writer',
-                                        daemon=True)
-        self._thread.start()
+            t = threading.Thread(target=run, name='ckpt-writer',
+                                 daemon=True)
+            with self._lock:
+                self._thread = t
+            t.start()
 
     def join(self) -> None:
-        t = self._thread
-        if t is not None:
+        with self._lock:
+            t = self._thread
+        # join outside the lock (the writer takes it to record errors);
+        # never self-join — re-entrancy from the writer thread is a no-op
+        if t is not None and t is not threading.current_thread():
             t.join()
-            self._thread = None
-        if self._err is not None:
+        with self._lock:
+            if self._thread is t:
+                self._thread = None
             err, self._err = self._err, None
+        if err is not None:
             raise RuntimeError(
                 'background checkpoint write failed') from err
+
+    def close(self) -> None:
+        """Flush-and-stop for teardown paths: identical to ``join()``
+        (write failures still raise — silently losing the final
+        checkpoint is worse than a noisy exit) but named for the
+        idempotent double-``close()`` contract the lifecycle tests pin."""
+        self.join()
 
 
 def _ckptr():

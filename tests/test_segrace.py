@@ -1,0 +1,601 @@
+"""segrace (analysis/concurrency.py + lockgraph.py): the static
+concurrency auditor must be green on the real tree, every pass must
+catch its seeded violation (a lint that cannot fail its negative test is
+decoration, not enforcement), the committed SEGRACE.json lock order must
+gate new edges and cycles, and the suppression budget may only go down.
+
+The `slow` half is the runtime twin: a hammer that drives MicroBatcher
+admit/drain, MetricsRegistry scrapes, EventSink writes and profiler
+captures concurrently under a tiny switch interval and asserts the
+invariants the static pass promises (admitted == terminal, histogram
+count == bucket sum, no deadlock within timeout).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from rtseg_tpu.analysis import (build_lockgraph, check_concurrency,
+                                update_lockgraph)
+from rtseg_tpu.analysis.concurrency import target_files
+from rtseg_tpu.analysis.core import (ALL_RULES, RULE_CONCURRENCY,
+                                     repo_root)
+from rtseg_tpu.analysis.lockgraph import SEGRACE_FILE, load_sidecar
+
+REPO = repo_root()
+
+
+def _write(root, relpath, text):
+    path = os.path.join(str(root), relpath)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, 'w') as f:
+        f.write(textwrap.dedent(text))
+
+
+def _msgs(findings):
+    return '\n'.join(str(f) for f in findings)
+
+
+# ---------------------------------------------------------- positive gates
+def test_real_tree_concurrency_clean():
+    """The committed tree passes the concurrency rule — the CI gate. Every
+    true finding was fixed or carries a justified suppression."""
+    fs = check_concurrency(REPO)
+    assert fs == [], _msgs(fs)
+
+
+def test_rule_registered():
+    assert RULE_CONCURRENCY in ALL_RULES
+
+
+def test_real_tree_lockgraph_matches_sidecar():
+    """The committed SEGRACE.json is exactly the observed graph: every
+    observed edge is committed (the clean gate proves that) AND every
+    committed edge is still observed (a stale sidecar would let a removed
+    ordering silently re-appear reversed)."""
+    g = build_lockgraph(REPO)
+    sidecar = load_sidecar(REPO)
+    assert sidecar is not None, 'SEGRACE.json must be committed'
+    committed = {(e[0], e[1]) for e in sidecar['edges']}
+    assert set(g.edges) == committed
+    # ranks must be consistent with every committed edge
+    ranks = sidecar['locks']
+    for a, b in committed:
+        assert ranks[a] < ranks[b], (a, b)
+    # every observed lock is ranked
+    assert g.nodes <= set(ranks)
+
+
+def test_suppression_budget_only_goes_down():
+    """One justified `# segcheck: disable=concurrency` in the tree (the
+    ServeHTTPServer per-code counter cache, idempotent by design). Fixing
+    a site lowers this number; never raise it without a justification
+    comment on the suppressed line."""
+    n = 0
+    sites = []
+    for sf in target_files(REPO):
+        for line, rules in sf.suppressed.items():
+            if RULE_CONCURRENCY in rules or 'all' in rules:
+                n += 1
+                sites.append(f'{sf.relpath}:{line}')
+    assert n == 1, f'concurrency suppressions changed: {sites}'
+
+
+# ------------------------------------------- pass 1: lock-discipline seeds
+def test_unguarded_outlier_flagged(tmp_path):
+    _write(tmp_path, 'rtseg_tpu/serve/seed.py', '''
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+                self._t = threading.Thread(target=self._work)
+                self._t.start()
+
+            def _work(self):
+                while True:
+                    with self._lock:
+                        self._n += 1
+
+            def read(self):
+                with self._lock:
+                    return self._n
+
+            def poke(self):
+                self._n = 5
+        ''')
+    fs = check_concurrency(str(tmp_path))
+    hits = [f for f in fs if 'guarded by' in f.message]
+    assert len(hits) == 1, _msgs(fs)
+    assert hits[0].path == 'rtseg_tpu/serve/seed.py'
+    assert hits[0].line == 21              # the unguarded poke() write
+    assert 'Box._n' in hits[0].message
+
+
+def test_consistently_unguarded_field_not_flagged(tmp_path):
+    """A field that never takes a lock anywhere has no majority guard —
+    it may be thread-confined by design; pass 1 stays quiet (the RMW/
+    check-then-act lints catch the specifically dangerous shapes)."""
+    _write(tmp_path, 'rtseg_tpu/serve/seed.py', '''
+        import threading
+
+        class Flag:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.closing = False
+                threading.Thread(target=self._work).start()
+
+            def _work(self):
+                while not self.closing:
+                    pass
+
+            def close(self):
+                self.closing = True
+        ''')
+    fs = check_concurrency(str(tmp_path))
+    assert fs == [], _msgs(fs)
+
+
+def test_helper_inlined_with_callers_lock(tmp_path):
+    """A private helper that only ever runs under its caller's lock is
+    credited with that lock — no false outlier."""
+    _write(tmp_path, 'rtseg_tpu/serve/seed.py', '''
+        import threading
+
+        class Inline:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+                threading.Thread(target=self._work).start()
+
+            def _work(self):
+                while True:
+                    with self._lock:
+                        self._bump()
+
+            def _bump(self):
+                self._n += 1
+
+            def read(self):
+                with self._lock:
+                    return self._n
+        ''')
+    fs = check_concurrency(str(tmp_path))
+    assert fs == [], _msgs(fs)
+
+
+def test_suppression_honored(tmp_path):
+    _write(tmp_path, 'rtseg_tpu/serve/seed.py', '''
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+                threading.Thread(target=self._work).start()
+
+            def _work(self):
+                while True:
+                    with self._lock:
+                        self._n += 1
+
+            def read(self):
+                with self._lock:
+                    return self._n
+
+            def poke(self):
+                self._n = 5  # segcheck: disable=concurrency
+        ''')
+    assert check_concurrency(str(tmp_path)) == []
+
+
+# ------------------------------------------------ pass 2: lock-order seeds
+_CYCLE = '''
+    import threading
+
+    class AB:
+        def __init__(self):
+            self._l1 = threading.Lock()
+            self._l2 = threading.Lock()
+            threading.Thread(target=self.f).start()
+
+        def f(self):
+            with self._l1:
+                with self._l2:
+                    pass
+
+        def g(self):
+            with self._l2:
+                with self._l1:
+                    pass
+    '''
+
+
+def test_lock_order_cycle_flagged(tmp_path):
+    _write(tmp_path, 'rtseg_tpu/serve/seed.py', _CYCLE)
+    fs = check_concurrency(str(tmp_path))
+    cyc = [f for f in fs if 'lock-order cycle' in f.message]
+    assert len(cyc) == 1, _msgs(fs)
+    assert 'AB._l1' in cyc[0].message and 'AB._l2' in cyc[0].message
+
+
+def test_update_lockgraph_refuses_cycle(tmp_path):
+    _write(tmp_path, 'rtseg_tpu/serve/seed.py', _CYCLE)
+    with pytest.raises(ValueError, match='cycle'):
+        update_lockgraph(str(tmp_path))
+    assert not os.path.exists(os.path.join(str(tmp_path), SEGRACE_FILE))
+
+
+def test_missing_sidecar_then_repin_then_new_edge(tmp_path):
+    """The full SEGRACE.json lifecycle: an edge with no sidecar fails;
+    --update-lockgraph pins it and the gate goes green; a NEW edge fails
+    against the committed order until re-pinned."""
+    _write(tmp_path, 'rtseg_tpu/serve/seed.py', '''
+        import threading
+
+        class Nest:
+            def __init__(self):
+                self._outer = threading.Lock()
+                self._inner = threading.Lock()
+                threading.Thread(target=self.f).start()
+
+            def f(self):
+                with self._outer:
+                    with self._inner:
+                        pass
+        ''')
+    fs = check_concurrency(str(tmp_path))
+    assert any(SEGRACE_FILE in f.message and 'missing' in f.message
+               for f in fs), _msgs(fs)
+    data = update_lockgraph(str(tmp_path))
+    assert len(data['edges']) == 1
+    assert data['locks']['rtseg_tpu/serve/seed.py:Nest._outer'] \
+        < data['locks']['rtseg_tpu/serve/seed.py:Nest._inner']
+    assert check_concurrency(str(tmp_path)) == []
+    # grow a new ordering: outer -> third
+    _write(tmp_path, 'rtseg_tpu/serve/seed2.py', '''
+        import threading
+
+        class Nest2:
+            def __init__(self):
+                self._outer2 = threading.Lock()
+                self._third = threading.Lock()
+                threading.Thread(target=self.f).start()
+
+            def f(self):
+                with self._outer2:
+                    with self._third:
+                        pass
+        ''')
+    fs = check_concurrency(str(tmp_path))
+    new = [f for f in fs if 'new lock-order edge' in f.message]
+    assert len(new) == 1, _msgs(fs)
+    assert 'Nest2._outer2' in new[0].message
+    update_lockgraph(str(tmp_path))
+    assert check_concurrency(str(tmp_path)) == []
+
+
+def test_cross_object_edge_via_bare_name_summary(tmp_path):
+    """An edge through a foreign call: holding my lock while calling a
+    method (resolved by bare name) that takes its own lock."""
+    _write(tmp_path, 'rtseg_tpu/serve/seed.py', '''
+        import threading
+
+        class Gaugey:
+            def __init__(self):
+                self._glock = threading.Lock()
+                self._v = 0.0
+
+            def poke(self, v):
+                with self._glock:
+                    self._v = v
+
+        class Holder:
+            def __init__(self, g):
+                self._hlock = threading.Lock()
+                self._g = g
+                threading.Thread(target=self.loop).start()
+
+            def loop(self):
+                with self._hlock:
+                    self._g.poke(1.0)
+        ''')
+    g = build_lockgraph(str(tmp_path))
+    assert ('rtseg_tpu/serve/seed.py:Holder._hlock',
+            'rtseg_tpu/serve/seed.py:Gaugey._glock') in g.edges
+
+
+# ------------------------------------------------ pass 3: atomicity seeds
+def test_rmw_outside_lock_flagged(tmp_path):
+    _write(tmp_path, 'rtseg_tpu/serve/seed.py', '''
+        import threading
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+                threading.Thread(target=self.loop).start()
+
+            def loop(self):
+                while True:
+                    self.count += 1
+        ''')
+    fs = check_concurrency(str(tmp_path))
+    hits = [f for f in fs if 'read-modify-write' in f.message]
+    assert len(hits) == 1, _msgs(fs)
+    assert hits[0].line == 12 and 'C.count' in hits[0].message
+
+
+def test_check_then_act_flagged(tmp_path):
+    _write(tmp_path, 'rtseg_tpu/serve/seed.py', '''
+        import threading
+
+        class F:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cache = {}
+                threading.Thread(target=self.loop).start()
+
+            def loop(self):
+                while True:
+                    v = self._cache.get('k')
+                    if v is None:
+                        self._cache['k'] = 1
+
+            def reader(self):
+                with self._lock:
+                    return len(self._cache)
+        ''')
+    fs = check_concurrency(str(tmp_path))
+    hits = [f for f in fs if 'check-then-act' in f.message]
+    assert len(hits) == 1, _msgs(fs)
+    assert 'F._cache' in hits[0].message and hits[0].line == 14
+
+
+def test_notify_without_lock_flagged(tmp_path):
+    _write(tmp_path, 'rtseg_tpu/serve/seed.py', '''
+        import threading
+
+        class D:
+            def __init__(self):
+                self._cond = threading.Condition()
+                threading.Thread(target=self.w).start()
+
+            def w(self):
+                with self._cond:
+                    self._cond.wait()
+
+            def kick(self):
+                self._cond.notify()
+        ''')
+    fs = check_concurrency(str(tmp_path))
+    hits = [f for f in fs if 'notify' in f.message]
+    assert len(hits) == 1, _msgs(fs)
+    assert hits[0].line == 14
+
+
+def test_notify_under_lock_clean(tmp_path):
+    _write(tmp_path, 'rtseg_tpu/serve/seed.py', '''
+        import threading
+
+        class D:
+            def __init__(self):
+                self._cond = threading.Condition()
+                threading.Thread(target=self.w).start()
+
+            def w(self):
+                with self._cond:
+                    self._cond.wait()
+
+            def kick(self):
+                with self._cond:
+                    self._cond.notify()
+        ''')
+    assert check_concurrency(str(tmp_path)) == []
+
+
+def test_start_before_init_done_flagged(tmp_path):
+    _write(tmp_path, 'rtseg_tpu/serve/seed.py', '''
+        import threading
+
+        class E:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._t = threading.Thread(target=self.run)
+                self._t.start()
+                self.ready = True
+
+            def run(self):
+                return self.ready
+        ''')
+    fs = check_concurrency(str(tmp_path))
+    hits = [f for f in fs if 'partially constructed' in f.message]
+    assert len(hits) == 1, _msgs(fs)
+    assert hits[0].line == 8 and 'ready' in hits[0].message
+
+
+def test_start_last_in_init_clean(tmp_path):
+    _write(tmp_path, 'rtseg_tpu/serve/seed.py', '''
+        import threading
+
+        class E2:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.ready = True
+                self._t = threading.Thread(target=self.run)
+                self._t.start()
+
+            def run(self):
+                return self.ready
+        ''')
+    assert check_concurrency(str(tmp_path)) == []
+
+
+# ----------------------------------------------------------------- CLI e2e
+def test_cli_concurrency_rule_green():
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, 'tools', 'segcheck.py'),
+         '--lint-only', '--rules', 'concurrency'],
+        capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert '0 finding(s)' in r.stdout
+
+
+def test_cli_update_lockgraph(tmp_path):
+    _write(tmp_path, 'rtseg_tpu/serve/seed.py', '''
+        import threading
+
+        class Nest:
+            def __init__(self):
+                self._outer = threading.Lock()
+                self._inner = threading.Lock()
+                threading.Thread(target=self.f).start()
+
+            def f(self):
+                with self._outer:
+                    with self._inner:
+                        pass
+        ''')
+    args = [sys.executable, os.path.join(REPO, 'tools', 'segcheck.py'),
+            '--root', str(tmp_path), '--lint-only',
+            '--rules', 'concurrency']
+    r = subprocess.run(args, capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 1        # edge with no sidecar: gate fails
+    r = subprocess.run(args + ['--update-lockgraph'],
+                       capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert 're-pinned' in r.stdout
+    with open(os.path.join(str(tmp_path), SEGRACE_FILE)) as f:
+        data = json.load(f)
+    assert len(data['edges']) == 1
+    r = subprocess.run(args, capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+# ------------------------------------------------------- runtime twin (slow)
+@pytest.mark.slow
+def test_stress_admit_drain_scrape_capture(tmp_path):
+    """Dynamic cross-check of the invariants the static pass reasons
+    about: hammer MicroBatcher admit/drain, MetricsRegistry scrapes,
+    EventSink writes and profiler capture windows concurrently for a few
+    seconds under a 10us switch interval. Asserts admitted == terminal
+    outcomes, histogram count == bucket sum on every scrape, and that
+    every thread exits within its timeout (a deadlock turns this test
+    red, not hung — CI wraps it in a hard wall-clock timeout too)."""
+    from rtseg_tpu import obs
+    from rtseg_tpu.obs.core import EventSink
+    from rtseg_tpu.obs.metrics import (Histogram, MetricsRegistry,
+                                       render_prometheus)
+    from rtseg_tpu.serve.batcher import MicroBatcher, ServeReject
+
+    old_interval = sys.getswitchinterval()
+    prev_sink = obs.get_sink()
+    sink = EventSink(os.path.join(str(tmp_path), 'events.jsonl'))
+    obs.set_sink(sink)
+    sys.setswitchinterval(1e-5)
+    errors = []
+    threads = []
+    try:
+        reg = MetricsRegistry()
+        batcher = MicroBatcher([(8, 8)], max_batch=4, max_wait_ms=0.5,
+                               max_queue=64, registry=reg)
+        c_ok = reg.counter('serve_requests_total', status='ok')
+        stop = threading.Event()          # producers/sinker/capturer
+        closed = threading.Event()        # drain: None now means drained
+        img = np.zeros((8, 8, 3), np.float32)
+
+        def producer(seed):
+            rng = np.random.default_rng(seed)
+            while not stop.is_set():
+                try:
+                    batcher.submit(
+                        img,
+                        deadline_ms=float(rng.choice((0.01, 50.0))))
+                except ServeReject:
+                    time.sleep(0.0005)
+
+        def drain():
+            while True:
+                got = batcher.get_batch(timeout=0.05)
+                if got is None:
+                    if closed.is_set():
+                        return
+                    continue
+                _, reqs = got
+                c_ok.inc(len(reqs))
+                for r in reqs:
+                    r.future.set_result(None)
+
+        def scraper():
+            while not stop.is_set():
+                render_prometheus(reg)
+                reg.snapshot()
+                for m in reg.collect():
+                    if isinstance(m, Histogram):
+                        s = m.snapshot()
+                        if s['count'] != sum(s['counts']):
+                            errors.append(
+                                ('torn histogram', m.name, s['count'],
+                                 sum(s['counts'])))
+
+        def sinker():
+            i = 0
+            while not stop.is_set():
+                sink.emit({'event': 'hammer', 'i': i})
+                i += 1
+
+        def capturer():
+            from rtseg_tpu.obs.profile import CaptureBusy, capture_window
+            while not stop.is_set():
+                try:
+                    capture_window(0.05)
+                except CaptureBusy:
+                    time.sleep(0.01)
+                except Exception as e:   # noqa: BLE001 — recorded
+                    errors.append(('capture', repr(e)))
+                    return
+
+        for i in range(3):
+            threads.append(threading.Thread(target=producer, args=(i,),
+                                            daemon=True))
+        drain_t = threading.Thread(target=drain, daemon=True)
+        threads += [drain_t,
+                    threading.Thread(target=scraper, daemon=True),
+                    threading.Thread(target=sinker, daemon=True),
+                    threading.Thread(target=capturer, daemon=True)]
+        for t in threads:
+            t.start()
+        time.sleep(2.5)
+        stop.set()
+        for t in threads:
+            if t is not drain_t:
+                t.join(timeout=30)
+        batcher.close()                  # queued requests still drain
+        closed.set()
+        drain_t.join(timeout=30)
+        stuck = [t.name for t in threads if t.is_alive()]
+        assert not stuck, f'deadlocked/stuck threads: {stuck}'
+        assert errors == [], errors[:5]
+
+        # admitted == terminal: every admitted request either reached a
+        # batch (ok) or was deadline-dropped; rejects were never admitted
+        assert batcher.submitted == c_ok.value + batcher.dropped, (
+            batcher.submitted, c_ok.value, batcher.dropped)
+        assert batcher.submitted > 0 and batcher.batches > 0
+        # final histogram consistency, including the queue-stage latency
+        for m in reg.collect():
+            if isinstance(m, Histogram):
+                s = m.snapshot()
+                assert s['count'] == sum(s['counts']), m.name
+    finally:
+        sys.setswitchinterval(old_interval)
+        obs.set_sink(prev_sink)
+        sink.close()

@@ -8,6 +8,7 @@ Usage:
     python tools/segcheck.py --audit-only    # eval_shape zoo sweep only
     python tools/segcheck.py --deep          # + jaxpr/HLO deep audits
     python tools/segcheck.py --deep --update-budget   # re-pin SEGAUDIT.json
+    python tools/segcheck.py --update-lockgraph       # re-pin SEGRACE.json
 
 Rules (suppress one finding with `# segcheck: disable=<rule>` on its line):
     import-hygiene        torch/torchvision never import at module scope
@@ -17,6 +18,11 @@ Rules (suppress one finding with `# segcheck: disable=<rule>` on its line):
                           headings or committed logs
     obs-purity            no host-side segscope (rtseg_tpu.obs) calls in
                           jit-reachable code
+    concurrency           segrace: lock-discipline inference over the
+                          threaded serving/obs/warm planes, lock-order
+                          graph gated by SEGRACE.json, atomicity lints
+                          (lockless +=, check-then-act, notify without
+                          the condition, Thread.start publication races)
 
 Audit: jax.eval_shape sweep of every registry model (aux/detail variants
 included) asserting the [B, H, W, num_class] eval contract — no weights
@@ -73,6 +79,10 @@ def main(argv=None) -> int:
     ap.add_argument('--update-budget', action='store_true',
                     help='rewrite SEGAUDIT.json with the measured '
                          'collective counts instead of gating on them')
+    ap.add_argument('--update-lockgraph', action='store_true',
+                    help='rewrite SEGRACE.json with the observed lock-'
+                         'order graph (after review of a new edge) '
+                         'before the lint gate runs; refuses on a cycle')
     ap.add_argument('-q', '--quiet', action='store_true',
                     help='print findings only, no summary')
     args = ap.parse_args(argv)
@@ -82,6 +92,9 @@ def main(argv=None) -> int:
         ap.error('--lint-only and --deep are mutually exclusive')
     if args.update_budget and not args.deep:
         ap.error('--update-budget requires --deep')
+    if args.update_lockgraph and args.audit_only:
+        ap.error('--update-lockgraph is a lint-tier operation; drop '
+                 '--audit-only')
 
     try:
         root = args.root or repo_root()
@@ -90,6 +103,19 @@ def main(argv=None) -> int:
         return 2
 
     failures = 0
+    if args.update_lockgraph:
+        # pure-AST, no jax: re-pin the committed lock order, then let the
+        # normal lint gate below verify the tree against it
+        from rtseg_tpu.analysis.concurrency import update_lockgraph
+        try:
+            data = update_lockgraph(root)
+        except ValueError as e:          # cyclic graph: nothing written
+            print(f'segcheck: {e}', file=sys.stderr)
+            return 1
+        if not args.quiet:
+            print(f'segcheck: SEGRACE.json re-pinned '
+                  f'({len(data["locks"])} locks, '
+                  f'{len(data["edges"])} edges)')
     if not args.audit_only:
         rules = [r.strip() for r in args.rules.split(',')] \
             if args.rules else None
