@@ -1,0 +1,50 @@
+"""segfleet — the multi-replica serving fleet above segserve.
+
+Where :mod:`rtseg_tpu.serve` is one engine behind one HTTP server, this
+package is the layer that serves heavy traffic: N replica *processes*
+per model behind one front door, with lifecycle, admission and scaling
+as first-class, observable operations.
+
+Layers (each its own module, composable and separately testable):
+
+  * :mod:`replica`    — ReplicaProcess: one segserve subprocess
+    (ephemeral port via ``--port-file``, /healthz readiness,
+    /drain?exit=1 graceful exit, state machine under its own lock);
+  * :mod:`manager`    — ReplicaGroup + FleetManager: spawn/monitor/
+    restart-with-backoff/drain across groups, ``fleet`` events into the
+    segscope sink for every lifecycle action;
+  * :mod:`policy`     — routing policies (least-outstanding default,
+    round-robin);
+  * :mod:`router`     — FleetRouter: spreads ``POST /predict`` across
+    ready replicas, fleet-level SLO admission + deadline propagation,
+    one retry on a different replica when one dies mid-request,
+    multi-model tenancy via path or ``X-Model``, aggregate
+    ``/stats`` + ``/metrics`` that reconcile exactly with the replica
+    scrapes;
+  * :mod:`autoscaler` — metrics-driven scaling: per-replica
+    MetricsPoller frames (obs/live.py) -> pure ``decide()`` ->
+    ``FleetManager.scale_to``.
+
+Everything here is host-side pure stdlib — replicas own the jax engines
+in their own processes; the fleet plane never imports jax. The segrace
+``concurrency`` lint audits this package (analysis/concurrency.py
+TARGET_PREFIXES) and its lock orderings are pinned in SEGRACE.json.
+CLI: ``tools/segfleet.py``.
+"""
+
+from .autoscaler import (Autoscaler, AutoscalePolicy, decide,
+                         serving_signals)
+from .manager import FleetManager, ReplicaGroup, SpawnCmd
+from .policy import (POLICIES, LeastOutstanding, RoundRobin,
+                     RoutingPolicy, get_policy)
+from .replica import ReplicaProcess
+from .router import MODEL_HEADER, FleetRouter, make_router
+
+__all__ = [
+    'Autoscaler', 'AutoscalePolicy', 'decide', 'serving_signals',
+    'FleetManager', 'ReplicaGroup', 'SpawnCmd',
+    'POLICIES', 'LeastOutstanding', 'RoundRobin', 'RoutingPolicy',
+    'get_policy',
+    'ReplicaProcess',
+    'MODEL_HEADER', 'FleetRouter', 'make_router',
+]

@@ -1,0 +1,162 @@
+"""Metrics-driven autoscaler: poll replica /metrics, scale the group.
+
+The feedback loop ROADMAP item 1 asked for: each ready replica already
+exposes its live plane as ``GET /metrics`` (segtrace; online p50/p95/p99,
+queue depth, occupancy) and segprof's ``device_busy_frac`` gauge rides
+the same scrape — so the autoscaler reuses :class:`MetricsPoller`
+(obs/live.py) per replica instead of inventing a second telemetry
+channel, and the numbers it scales on are by construction the numbers a
+human sees in ``segscope live``.
+
+Decision core (:func:`decide`) is a pure function of the polled frames —
+the thresholds live in :class:`AutoscalePolicy`, the loop feeds it and
+acts through ``FleetManager.scale_to`` — so the scaling behavior is unit-
+testable from seeded frames with no processes, no sleeps and no HTTP
+(tests/test_segfleet.py drives exactly that).
+
+Signals and shape:
+
+  * **scale up** when the worst replica's windowed p99 breaches
+    ``p99_high_ms``, or the mean queue depth per replica breaches
+    ``queue_high`` — sustained for ``up_consecutive`` polls (one poll's
+    burst is noise, a streak is load);
+  * **scale down** when every replica's p99 sits under ``p99_low_ms``
+    and queues are empty — sustained for ``down_consecutive`` polls
+    (down is slower than up on purpose: flapping wastes warm replicas);
+  * a ``cooldown_s`` window after every action lets the fleet re-settle
+    before the next judgment; min/max clamping is the manager's.
+
+The loop emits nothing itself — ``scale_to`` emits the ``fleet``
+``scale_up``/``scale_down`` events with the decision's reason attached,
+so the sink's scaling history says *why* every action happened.
+Pure stdlib.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from ..obs.live import MetricsPoller
+from .manager import FleetManager
+
+
+@dataclass
+class AutoscalePolicy:
+    """Thresholds for :func:`decide`; bounds live on the ReplicaGroup."""
+    p99_high_ms: float = 1000.0     # worst replica p99 above -> up
+    p99_low_ms: float = 200.0       # all replicas p99 below -> down ok
+    queue_high: float = 4.0         # mean queued reqs/replica above -> up
+    queue_low: float = 0.5          # mean queue below -> down ok
+    up_consecutive: int = 2         # polls a breach must persist
+    down_consecutive: int = 5       # polls idleness must persist
+    cooldown_s: float = 10.0        # settle time after any action
+
+
+def serving_signals(frames: List[dict]) -> Optional[Dict[str, float]]:
+    """Collapse per-replica MetricsPoller frames into the decision
+    signals: worst p99, mean queue depth. None when no frame carries a
+    serving section yet (replicas up but never scraped mid-traffic)."""
+    servings = [f.get('serving') for f in frames if f.get('serving')]
+    if not servings:
+        return None
+    p99s = [s['p99_ms'] for s in servings if s.get('p99_ms') is not None]
+    queues = [s['queue_depth'] for s in servings
+              if s.get('queue_depth') is not None]
+    return {
+        'worst_p99_ms': max(p99s) if p99s else 0.0,
+        'mean_queue': (sum(queues) / len(queues)) if queues else 0.0,
+        'replicas_reporting': float(len(servings)),
+    }
+
+
+def decide(frames: List[dict], n_ready: int, policy: AutoscalePolicy,
+           streak: Tuple[int, int]) -> Tuple[int, str, Tuple[int, int]]:
+    """One scaling judgment. Returns (delta, reason, new_streak) where
+    delta is -1/0/+1 and streak is the (up, down) consecutive-signal
+    counters threaded through successive calls."""
+    up_streak, down_streak = streak
+    sig = serving_signals(frames)
+    if sig is None or n_ready == 0:
+        return 0, 'no signal', (0, 0)
+    hot = (sig['worst_p99_ms'] > policy.p99_high_ms
+           or sig['mean_queue'] > policy.queue_high)
+    idle = (sig['worst_p99_ms'] < policy.p99_low_ms
+            and sig['mean_queue'] < policy.queue_low)
+    up_streak = up_streak + 1 if hot else 0
+    down_streak = down_streak + 1 if idle else 0
+    if up_streak >= policy.up_consecutive:
+        reason = (f'p99 {sig["worst_p99_ms"]:.0f}ms / queue '
+                  f'{sig["mean_queue"]:.1f} over {up_streak} polls')
+        return 1, reason, (0, 0)
+    if down_streak >= policy.down_consecutive:
+        reason = (f'idle (p99 {sig["worst_p99_ms"]:.0f}ms, queue '
+                  f'{sig["mean_queue"]:.1f}) over {down_streak} polls')
+        return -1, reason, (0, 0)
+    return 0, 'steady', (up_streak, down_streak)
+
+
+class Autoscaler:
+    """The polling loop around :func:`decide` for one replica group."""
+
+    def __init__(self, manager: FleetManager, group_name: str,
+                 policy: Optional[AutoscalePolicy] = None,
+                 poll_s: float = 2.0):
+        if group_name not in manager.groups:
+            raise ValueError(f'unknown group {group_name!r}')
+        self.manager = manager
+        self.group_name = group_name
+        self.policy = policy if policy is not None else AutoscalePolicy()
+        self.poll_s = poll_s
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f'segfleet-autoscale-'
+                                             f'{group_name}')
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=10)
+
+    # ---------------------------------------------------------------- loop
+    def _loop(self) -> None:
+        # all state below is confined to this thread: pollers are keyed
+        # by replica id so counter-delta rates survive across polls as
+        # long as the replica does
+        pollers: Dict[str, MetricsPoller] = {}
+        streak = (0, 0)
+        cooldown_until = 0.0
+        while not self._stop.wait(self.poll_s):
+            group = self.manager.groups[self.group_name]
+            ready = group.ready()
+            frames = []
+            for r in ready:
+                url = r.url
+                if url is None:
+                    continue
+                poller = pollers.get(r.replica_id)
+                if poller is None:
+                    poller = MetricsPoller(url)
+                    pollers[r.replica_id] = poller
+                try:
+                    frames.append(poller.poll())
+                except Exception:   # noqa: BLE001 — a scrape may race a
+                    continue        # replica death; skip this frame
+            # drop pollers of replicas that left the ready set so a
+            # restarted replica gets a fresh delta baseline
+            gone = set(pollers) - {r.replica_id for r in ready}
+            for rid in gone:
+                del pollers[rid]
+            delta, reason, streak = decide(frames, len(ready),
+                                           self.policy, streak)
+            if delta == 0 or time.monotonic() < cooldown_until:
+                continue
+            self.manager.scale_to(self.group_name, len(ready) + delta,
+                                  reason=f'autoscale: {reason}')
+            cooldown_until = time.monotonic() + self.policy.cooldown_s
+            streak = (0, 0)

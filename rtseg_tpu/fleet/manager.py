@@ -1,0 +1,343 @@
+"""Fleet manager: replica groups, lifecycle monitoring, scaling.
+
+One :class:`ReplicaGroup` per served model (multi-model tenancy: the
+router maps a model name to its group). The :class:`FleetManager` owns a
+single monitor thread that drives every replica's lifecycle:
+
+  * **spawn -> ready** — poll the ``--port-file`` for the ephemeral port,
+    then ``GET /healthz`` until the replica answers ``ready`` (segwarm
+    makes this seconds instead of a full XLA compile on a warm cache;
+    each spawn's ready latency is recorded and emitted);
+  * **crash detection** — a replica whose process exits outside a drain
+    is ``dead``: emit a ``fleet`` ``replica_death`` event and restart it
+    with exponential backoff, bounded by ``max_restarts`` consecutive
+    failures (then ``failed``, a terminal state a human has to look at);
+  * **drain** — ``scale_to`` shrinking a group (or ``stop``) sends
+    ``POST /drain?exit=1``: the replica stops admitting, finishes its
+    in-flight requests and exits 0; the monitor reaps it as ``stopped``.
+    A drain that overstays ``drain_grace_s`` is terminated.
+
+Every lifecycle action emits a structured ``fleet`` event
+(``{'event': 'fleet', 'action': scale_up|scale_down|replica_ready|
+replica_death|restart|drain|drain_complete|replica_failed, ...}``) into
+the process-global segscope sink, so segscope tooling and the CI gates
+see scaling history next to the request stream. Scaling decisions are
+serialized by one lock; event emission and drain HTTP requests happen
+outside it (house style: serve/batcher.py keeps I/O off its condition
+lock for the same reason).
+
+Pure stdlib; replicas are subprocesses, never in-process engines.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..obs import get_sink
+from .replica import ReplicaProcess
+
+#: argv builder: (replica_id, port_file_path) -> subprocess argv
+SpawnCmd = Callable[[str, str], List[str]]
+
+
+def _emit_fleet(action: str, group: str, **fields) -> None:
+    sink = get_sink()
+    if sink is not None:
+        sink.emit({'event': 'fleet', 'action': action, 'group': group,
+                   **fields})
+
+
+class ReplicaGroup:
+    """The replicas serving one model, plus how to spawn more of them."""
+
+    def __init__(self, name: str, spawn_cmd: SpawnCmd,
+                 min_replicas: int = 1, max_replicas: int = 4,
+                 env: Optional[Dict[str, str]] = None):
+        if min_replicas < 1 or max_replicas < min_replicas:
+            raise ValueError(f'bad replica bounds '
+                             f'[{min_replicas}, {max_replicas}]')
+        self.name = name
+        self.spawn_cmd = spawn_cmd
+        self.min_replicas = min_replicas
+        self.max_replicas = max_replicas
+        self.env = env
+        self._lock = threading.Lock()
+        self._replicas: List[ReplicaProcess] = []
+        self._seq = 0
+
+    def replicas(self) -> List[ReplicaProcess]:
+        """Snapshot of every live handle (any state)."""
+        with self._lock:
+            return list(self._replicas)
+
+    def ready(self) -> List[ReplicaProcess]:
+        """The replicas the router may send traffic to, id-sorted."""
+        return sorted((r for r in self.replicas()
+                       if r.state == 'ready'),
+                      key=lambda r: r.replica_id)
+
+    def active(self) -> List[ReplicaProcess]:
+        """Replicas that count toward the scale target (not yet stopped
+        or failed), id-sorted."""
+        return sorted((r for r in self.replicas()
+                       if r.state in ('starting', 'ready', 'dead')),
+                      key=lambda r: r.replica_id)
+
+    def next_id(self) -> str:
+        with self._lock:
+            self._seq += 1
+            return f'{self.name}-{self._seq}'
+
+    def add(self, replica: ReplicaProcess) -> None:
+        with self._lock:
+            self._replicas.append(replica)
+
+    def stats(self) -> dict:
+        reps = self.replicas()
+        return {'name': self.name,
+                'min': self.min_replicas, 'max': self.max_replicas,
+                'ready': sum(1 for r in reps if r.state == 'ready'),
+                'replicas': [r.snapshot() for r in reps]}
+
+
+class FleetManager:
+    """Spawns, watches, restarts and drains the replicas of all groups."""
+
+    def __init__(self, groups: List[ReplicaGroup],
+                 run_dir: Optional[str] = None,
+                 poll_s: float = 0.25,
+                 restart_backoff_s: float = 0.5,
+                 max_restarts: int = 5,
+                 drain_grace_s: float = 30.0,
+                 health_timeout_s: float = 2.0):
+        names = [g.name for g in groups]
+        if len(set(names)) != len(names):
+            raise ValueError(f'duplicate group names: {names}')
+        self.groups: Dict[str, ReplicaGroup] = {g.name: g for g in groups}
+        self.run_dir = run_dir or tempfile.mkdtemp(prefix='segfleet-')
+        os.makedirs(self.run_dir, exist_ok=True)
+        self.poll_s = poll_s
+        self.restart_backoff_s = restart_backoff_s
+        self.max_restarts = max_restarts
+        self.drain_grace_s = drain_grace_s
+        self.health_timeout_s = health_timeout_s
+        # serializes scale decisions (autoscaler thread vs. CLI thread);
+        # never held across event emission or replica HTTP requests
+        self._scale_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._monitor = threading.Thread(target=self._monitor_loop,
+                                         daemon=True,
+                                         name='segfleet-monitor')
+
+    # ------------------------------------------------------------ lifetime
+    def start(self) -> None:
+        """Spawn every group up to its min_replicas, start the monitor."""
+        for g in self.groups.values():
+            self.scale_to(g.name, g.min_replicas, reason='startup')
+        self._monitor.start()
+
+    def stop(self, drain: bool = True, timeout_s: float = 30.0) -> None:
+        """Drain (or terminate) everything and stop the monitor."""
+        if drain:
+            for g in self.groups.values():
+                victims = []
+                with self._scale_lock:
+                    for r in g.ready():
+                        self._mark_draining(r)
+                        victims.append(r)
+                for r in victims:
+                    self._drain_marked(g, r, reason='shutdown')
+                # replicas with no traffic to flush (still compiling, or
+                # dead awaiting a restart) have nothing to drain — reap
+                # them now instead of stalling the wait loop below for
+                # the full grace window
+                for r in g.replicas():
+                    if r.state in ('starting', 'dead'):
+                        r.terminate()
+                        r.set_state('stopped')
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() < deadline:
+                if all(r.poll_exit() is not None
+                       for g in self.groups.values()
+                       for r in g.replicas()):
+                    break
+                time.sleep(0.05)
+        self._stop.set()
+        if self._monitor.is_alive():
+            self._monitor.join(timeout=10)
+        for g in self.groups.values():
+            for r in g.replicas():
+                r.terminate(kill=True)
+
+    # ------------------------------------------------------------- scaling
+    def scale_to(self, group_name: str, n: int, reason: str = '') -> int:
+        """Grow (spawn) or shrink (drain youngest-first) ``group_name``
+        toward ``n`` replicas, clamped to [min, max]. Returns the new
+        target. Emits one ``scale_up``/``scale_down`` fleet event when
+        the target actually moves."""
+        g = self.groups[group_name]
+        n = max(g.min_replicas, min(g.max_replicas, int(n)))
+        victims: List[ReplicaProcess] = []
+        grew = False
+        with self._scale_lock:
+            cur = len(g.active())
+            if n > cur:
+                for _ in range(n - cur):
+                    self._spawn_one(g)
+                grew = True
+            elif n < cur:
+                # shrink youngest-first: the longest-lived replicas have
+                # the warmest caches and the longest metric history
+                victims = [r for r in reversed(g.active())
+                           if r.state == 'ready'][:cur - n]
+                for r in victims:
+                    self._mark_draining(r)
+        if grew:
+            _emit_fleet('scale_up', g.name, frm=cur, to=n, reason=reason)
+        for r in victims:
+            self._drain_marked(g, r, reason=reason or 'scale_down')
+        if victims:
+            _emit_fleet('scale_down', g.name, frm=cur,
+                        to=cur - len(victims), reason=reason)
+        return n
+
+    def drain_replica(self, group_name: str, replica_id: str,
+                      reason: str = 'manual') -> bool:
+        """Gracefully drain one specific replica (it exits 0 once its
+        in-flight requests finish)."""
+        g = self.groups[group_name]
+        victim = None
+        with self._scale_lock:
+            for r in g.replicas():
+                if r.replica_id == replica_id and r.state == 'ready':
+                    self._mark_draining(r)
+                    victim = r
+                    break
+        if victim is None:
+            return False
+        self._drain_marked(g, victim, reason=reason)
+        return True
+
+    def wait_ready(self, group_name: str, n: Optional[int] = None,
+                   timeout_s: float = 300.0) -> List[ReplicaProcess]:
+        """Block until ``group_name`` has >= n ready replicas (default:
+        its min_replicas). Raises TimeoutError with the stuck states."""
+        g = self.groups[group_name]
+        want = g.min_replicas if n is None else n
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            ready = g.ready()
+            if len(ready) >= want:
+                return ready
+            time.sleep(0.05)
+        states = [r.snapshot() for r in g.replicas()]
+        raise TimeoutError(f'group {group_name}: {len(g.ready())}/{want} '
+                           f'ready after {timeout_s}s: {states}')
+
+    # --------------------------------------------------------- drain pieces
+    def _mark_draining(self, r: ReplicaProcess) -> None:
+        """State flip + grace deadline, cheap enough to run under the
+        scale lock. The router stops picking the replica the moment the
+        state reads 'draining' — no later than the replica itself stops
+        admitting."""
+        r.drain_deadline_at = time.monotonic() + self.drain_grace_s
+        r.set_state('draining')
+
+    def _drain_marked(self, g: ReplicaGroup, r: ReplicaProcess,
+                      reason: str) -> None:
+        """The I/O half of a drain (outside every lock): ask the replica
+        to flush + exit; an unreachable replica is reaped hard."""
+        acked = r.request_drain(exit_after=True)
+        _emit_fleet('drain', g.name, replica=r.replica_id, acked=acked,
+                    reason=reason)
+        if not acked:
+            r.terminate()
+            r.set_state('stopped')
+
+    # ------------------------------------------------------------- spawning
+    def _spawn_one(self, g: ReplicaGroup) -> ReplicaProcess:
+        rid = g.next_id()
+        r = ReplicaProcess(rid, argv=[], run_dir=self.run_dir, env=g.env)
+        r.argv = g.spawn_cmd(rid, r.port_file)
+        g.add(r)
+        r.spawn()
+        return r
+
+    # ------------------------------------------------------------- monitor
+    def _monitor_loop(self) -> None:
+        while not self._stop.is_set():
+            for g in self.groups.values():
+                for r in g.replicas():
+                    try:
+                        self._tick_replica(g, r)
+                    except Exception:   # noqa: BLE001 — monitor survives
+                        pass
+            self._stop.wait(self.poll_s)
+
+    def _tick_replica(self, g: ReplicaGroup, r: ReplicaProcess) -> None:
+        state = r.state
+        if state in ('stopped', 'failed'):
+            return
+        exit_code = r.poll_exit()
+        if state == 'draining':
+            if exit_code is not None:
+                r.set_state('stopped')
+                _emit_fleet('drain_complete', g.name,
+                            replica=r.replica_id, exit_code=exit_code)
+            elif time.monotonic() > r.drain_deadline_at:
+                r.terminate()
+                r.set_state('stopped')
+                _emit_fleet('drain_complete', g.name,
+                            replica=r.replica_id, exit_code=None,
+                            forced=True)
+            return
+        if state == 'dead':
+            # already mourned; (re)spawn once the backoff has elapsed —
+            # the stale exit code of the dead incarnation stays visible
+            # until spawn() replaces the process handle
+            if time.monotonic() >= r.next_spawn_at:
+                r.restarts += 1
+                r.argv = g.spawn_cmd(r.replica_id, r.port_file)
+                r.spawn()
+                _emit_fleet('restart', g.name, replica=r.replica_id,
+                            restarts=r.restarts)
+            return
+        if exit_code is not None:
+            # unexpected exit: death event, then restart with backoff
+            # unless this replica has burned its consecutive budget
+            r.set_state('dead')
+            r.failures += 1
+            _emit_fleet('replica_death', g.name, replica=r.replica_id,
+                        exit_code=exit_code, failures=r.failures)
+            if r.failures > self.max_restarts:
+                r.set_state('failed')
+                _emit_fleet('replica_failed', g.name,
+                            replica=r.replica_id, failures=r.failures)
+                return
+            backoff = min(self.restart_backoff_s
+                          * (2 ** (r.failures - 1)), 10.0)
+            r.next_spawn_at = time.monotonic() + backoff
+            return
+        if state == 'starting':
+            if r.discover_port() is None:
+                return
+            health = r.check_health(timeout_s=self.health_timeout_s)
+            if health is not None and health.get('state') == 'ready':
+                r.ready_s = time.monotonic() - r.t_spawn
+                r.failures = 0
+                r.set_state('ready')
+                _emit_fleet('replica_ready', g.name,
+                            replica=r.replica_id, port=r.port,
+                            ready_s=round(r.ready_s, 3))
+            return
+        # state == 'ready' and the process is alive: nothing to do
+
+    # ------------------------------------------------------------- reports
+    def stats(self) -> dict:
+        return {'run_dir': self.run_dir,
+                'groups': {name: g.stats()
+                           for name, g in self.groups.items()}}

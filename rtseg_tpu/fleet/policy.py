@@ -1,0 +1,80 @@
+"""Routing policies: which ready replica gets the next request.
+
+A policy sees only ``(replica_id, outstanding)`` pairs — the router owns
+the outstanding bookkeeping (fleet/router.py) and hands a consistent
+snapshot in; the policy is a pure choice function plus whatever private
+state it needs (the round-robin cursor). Both built-ins break ties by
+replica id so routing is deterministic under test.
+
+* **least-outstanding** (default) — pick the replica with the fewest
+  requests currently in flight through the router. Self-balancing under
+  heterogeneous request cost: a replica chewing on a slow batch
+  accumulates outstanding work and stops receiving new requests until it
+  catches up, which is exactly the behavior a latency SLO wants.
+* **round-robin** — strict rotation over the ready set. Simpler mental
+  model, useful as the A/B control and when request cost is uniform.
+
+Host-side only, pure stdlib.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Tuple
+
+#: (replica_id, outstanding-through-the-router) — the router's snapshot
+Candidate = Tuple[str, int]
+
+
+class RoutingPolicy:
+    """Choice function over the ready replica set."""
+
+    name = 'base'
+
+    def choose(self, candidates: List[Candidate]) -> str:
+        raise NotImplementedError
+
+    def __repr__(self) -> str:
+        return f'{type(self).__name__}()'
+
+
+class LeastOutstanding(RoutingPolicy):
+    """Fewest in-flight requests wins; ties break by replica id."""
+
+    name = 'least-outstanding'
+
+    def choose(self, candidates: List[Candidate]) -> str:
+        if not candidates:
+            raise ValueError('no candidates')
+        return min(candidates, key=lambda c: (c[1], c[0]))[0]
+
+
+class RoundRobin(RoutingPolicy):
+    """Strict rotation over the sorted candidate ids."""
+
+    name = 'round-robin'
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._i = 0
+
+    def choose(self, candidates: List[Candidate]) -> str:
+        if not candidates:
+            raise ValueError('no candidates')
+        with self._lock:
+            i = self._i
+            self._i += 1
+        ids = sorted(c[0] for c in candidates)
+        return ids[i % len(ids)]
+
+
+POLICIES = {p.name: p for p in (LeastOutstanding, RoundRobin)}
+
+
+def get_policy(name: str) -> RoutingPolicy:
+    """Instantiate a policy by its CLI name."""
+    try:
+        return POLICIES[name]()
+    except KeyError:
+        raise ValueError(f'unknown routing policy {name!r}; '
+                         f'choose from {sorted(POLICIES)}') from None

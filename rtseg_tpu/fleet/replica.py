@@ -1,0 +1,190 @@
+"""ReplicaProcess: one managed segserve replica subprocess.
+
+A replica is a separate OS process running the single-replica serving
+stack (tools/segserve.py serve — engine + pipeline + ThreadingHTTPServer),
+spawned with ``--port 0 --port-file <path>`` so the manager discovers the
+ephemeral port after bind, and ``--replica-id`` so every response it ever
+sends is attributable. The handle owns:
+
+  * **spawn** — launch the argv the owning group's ``spawn_cmd`` builds,
+    stdout/stderr appended to a per-replica log file (compile output and
+    crash tracebacks survive the process);
+  * **state** — ``starting -> ready -> draining -> stopped`` plus
+    ``dead`` (unexpected exit) and ``failed`` (restart budget exhausted),
+    every transition under the handle's own lock so router threads, the
+    manager's monitor thread and the autoscaler all read a consistent
+    lifecycle;
+  * **probes** — port-file poll, ``GET /healthz`` (ready / drained), and
+    ``POST /drain?exit=1`` for the graceful half of the lifecycle.
+
+The manager (fleet/manager.py) drives the transitions; the router
+(fleet/router.py) only ever reads ``state``/``url``. Pure stdlib.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import threading
+import time
+import urllib.request
+from typing import Dict, List, Optional
+
+#: lifecycle states a replica moves through
+STATES = ('starting', 'ready', 'draining', 'stopped', 'dead', 'failed')
+
+
+class ReplicaProcess:
+    """Handle on one replica subprocess and its lifecycle state."""
+
+    def __init__(self, replica_id: str, argv: List[str], run_dir: str,
+                 host: str = '127.0.0.1',
+                 env: Optional[Dict[str, str]] = None):
+        self.replica_id = replica_id
+        self.argv = list(argv)
+        self.host = host
+        self.env = env
+        self.port_file = os.path.join(run_dir, f'{replica_id}.port')
+        self.log_path = os.path.join(run_dir, f'{replica_id}.log')
+        self._lock = threading.Lock()
+        self._state = 'starting'
+        self._port: Optional[int] = None
+        self._proc: Optional[subprocess.Popen] = None
+        self._log_f = None
+        self.restarts = 0            # manager-owned, monitor thread only
+        self.failures = 0            # consecutive; resets on ready
+        self.next_spawn_at = 0.0     # backoff gate, monitor thread only
+        self.drain_deadline_at = float('inf')  # set when drain begins
+        self.t_spawn = 0.0
+        self.ready_s: Optional[float] = None   # spawn -> ready latency
+
+    # -------------------------------------------------------------- state
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def set_state(self, state: str) -> None:
+        assert state in STATES, state
+        with self._lock:
+            self._state = state
+
+    @property
+    def port(self) -> Optional[int]:
+        with self._lock:
+            return self._port
+
+    @property
+    def url(self) -> Optional[str]:
+        with self._lock:
+            port = self._port
+        return f'http://{self.host}:{port}' if port is not None else None
+
+    # ------------------------------------------------------------ process
+    def spawn(self) -> None:
+        """Launch the subprocess (monitor/manager thread only). Resets
+        port discovery; state goes back to ``starting``."""
+        if os.path.exists(self.port_file):
+            os.remove(self.port_file)
+        log_f = open(self.log_path, 'a')
+        proc = subprocess.Popen(self.argv, stdout=log_f,
+                                stderr=subprocess.STDOUT, env=self.env)
+        with self._lock:
+            self._proc = proc
+            # a restart replaces the dead incarnation's log handle:
+            # close it or every crash/restart cycle leaks one fd
+            prev_log = self._log_f
+            self._log_f = log_f
+            self._port = None
+            self._state = 'starting'
+        if prev_log is not None and not prev_log.closed:
+            prev_log.close()
+        self.t_spawn = time.monotonic()
+        self.ready_s = None
+
+    def poll_exit(self) -> Optional[int]:
+        """Exit code if the subprocess has exited, else None."""
+        with self._lock:
+            proc = self._proc
+        return proc.poll() if proc is not None else None
+
+    def terminate(self, kill: bool = False) -> None:
+        with self._lock:
+            proc, log_f = self._proc, self._log_f
+        if proc is not None and proc.poll() is None:
+            (proc.kill if kill else proc.terminate)()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait(timeout=10)
+        if log_f is not None and not log_f.closed:
+            log_f.close()
+
+    @property
+    def pid(self) -> Optional[int]:
+        with self._lock:
+            proc = self._proc
+        return proc.pid if proc is not None else None
+
+    # ------------------------------------------------------------- probes
+    def discover_port(self) -> Optional[int]:
+        """Read the --port-file once it exists (atomic rename on the
+        writer side, so a non-empty file is a complete port)."""
+        with self._lock:
+            if self._port is not None:
+                return self._port
+        try:
+            with open(self.port_file) as f:
+                text = f.read().strip()
+        except OSError:
+            return None
+        if not text:
+            return None
+        port = int(text)
+        with self._lock:
+            self._port = port
+        return port
+
+    def check_health(self, timeout_s: float = 2.0) -> Optional[dict]:
+        """GET /healthz; None when unreachable/unparseable."""
+        url = self.url
+        if url is None:
+            return None
+        try:
+            with urllib.request.urlopen(url + '/healthz',
+                                        timeout=timeout_s) as resp:
+                return json.loads(resp.read())
+        except Exception:   # noqa: BLE001 — a probe never raises
+            return None
+
+    def request_drain(self, exit_after: bool = True,
+                      timeout_s: float = 5.0) -> bool:
+        """POST /drain (optionally ?exit=1). True when the replica
+        acknowledged; the manager's monitor then watches for exit."""
+        url = self.url
+        if url is None:
+            return False
+        q = '?exit=1' if exit_after else ''
+        req = urllib.request.Request(url + f'/drain{q}', data=b'',
+                                     method='POST')
+        try:
+            with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+                resp.read()
+            return True
+        except Exception:   # noqa: BLE001 — a probe never raises
+            return False
+
+    # ------------------------------------------------------------ reports
+    def snapshot(self) -> dict:
+        with self._lock:
+            state, port = self._state, self._port
+        return {'replica': self.replica_id, 'state': state, 'port': port,
+                'pid': self.pid, 'restarts': self.restarts,
+                'ready_s': (round(self.ready_s, 3)
+                            if self.ready_s is not None else None)}
+
+    def __repr__(self) -> str:
+        return (f'ReplicaProcess({self.replica_id!r}, state={self.state},'
+                f' port={self.port})')

@@ -1,0 +1,442 @@
+"""Front router: one HTTP door over N replica processes and M models.
+
+Same house style as the single-replica front-end (serve/server.py): a
+stdlib ThreadingHTTPServer, one handler thread per connection — a handler
+blocks on its proxied replica call exactly like a replica handler blocks
+on its pipeline Future. What the router adds over one replica:
+
+  * **spreading** — ``POST /predict`` (or ``/predict/<model>``) picks a
+    ready replica of the target model's group through a pluggable policy
+    (fleet/policy.py; least-outstanding default, round-robin available);
+  * **fleet-level SLO admission** — a global per-group bound on requests
+    in flight through the router (503 ``unroutable`` when exceeded:
+    overload surfaces at the front door, not as queue growth inside every
+    replica), and **deadline propagation**: an inbound ``X-Deadline-Ms``
+    budget is decremented by time spent inside the router and handed to
+    the replica, which enforces it in its queue — 503/504 semantics are
+    the single-replica ones, end to end;
+  * **retry on replica death** — a connection-level failure (replica
+    died mid-request) is retried exactly once on a *different* ready
+    replica; /predict is idempotent so the retry is safe. HTTP error
+    answers (503/504/413/...) are passed through verbatim, never
+    retried — the replica already spoke;
+  * **tenancy** — the model name in the path (``/predict/<model>``) or
+    the ``X-Model`` header selects the replica group; one router fronts
+    several groups;
+  * **one trace** — the router mints (or honors) ``X-Trace-Id`` and
+    forwards it, the replica threads it through its pipeline and echoes
+    it back, the router echoes it to the client: one id spans
+    router -> replica -> response. ``X-Replica-Id`` on every proxied
+    response says who actually served it.
+
+Accounting: the router's registry counts ``fleet_requests_total{group,
+status}``. Statuses ``ok``/``rejected``/``dropped``/``error`` mirror a
+replica answer (200/503/504/other) one-to-one, so summing the replica
+scrapes must reconcile *exactly* with the router's totals; router-local
+outcomes get their own statuses (``unroutable`` — no capacity or no
+ready replica, ``expired`` — deadline or router wait budget spent
+before a replica answered (a wait timeout is never retried: the replica
+may still be computing, and re-executing would double the work),
+``unreachable`` — connection failed and the retry budget is gone) so
+they can never blur that reconciliation. ``GET /metrics`` renders it all
+as Prometheus text; ``GET /stats`` is the same registry as JSON plus
+per-replica lifecycle snapshots.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import math
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+from ..obs.metrics import MetricsRegistry, render_prometheus
+from ..obs.tracing import (TRACE_HEADER, new_trace_id, valid_trace_id)
+from ..serve.server import DEADLINE_HEADER, REPLICA_HEADER
+from .manager import ReplicaGroup
+from .policy import LeastOutstanding, RoutingPolicy
+from .replica import ReplicaProcess
+
+#: request header selecting the model group (the path segment wins)
+MODEL_HEADER = 'X-Model'
+
+#: replica-mirroring statuses (reconcile 1:1 with replica scrapes) ...
+_REPLICA_STATUSES = ('ok', 'rejected', 'dropped', 'error')
+#: ... plus router-local outcomes that never reached / never got an
+#: answer from a replica
+_ROUTER_STATUSES = ('unroutable', 'expired', 'unreachable')
+
+#: response headers copied verbatim from the replica to the client
+_PASS_HEADERS = ('X-Serve-Timing', 'X-Mask-Shape', 'X-Mask-Dtype')
+
+#: exceptions that mean "the replica connection died" — retryable
+#: (URLError wraps refused/reset sockets; HTTPException covers a torn
+#: response, e.g. RemoteDisconnected/BadStatusLine from a killed replica)
+_CONN_ERRORS = (urllib.error.URLError, ConnectionError,
+                http.client.HTTPException, socket.timeout)
+
+
+def _is_timeout(exc: BaseException) -> bool:
+    """A wait timeout is NOT a dead connection: the replica may still be
+    computing the answer, so re-executing elsewhere would double the
+    work and desynchronize the router-vs-replica accounting. Timeouts
+    answer 504 instead of retrying."""
+    if isinstance(exc, (socket.timeout, TimeoutError)):
+        return True
+    return (isinstance(exc, urllib.error.URLError)
+            and isinstance(getattr(exc, 'reason', None),
+                           (socket.timeout, TimeoutError)))
+
+
+class FleetRouter(ThreadingHTTPServer):
+    """The serving fleet's front door."""
+
+    daemon_threads = True
+
+    def __init__(self, addr, groups: Dict[str, ReplicaGroup],
+                 default_group: Optional[str] = None,
+                 policy: Optional[RoutingPolicy] = None,
+                 max_outstanding: int = 64,
+                 registry: Optional[MetricsRegistry] = None,
+                 request_timeout_s: float = 60.0):
+        if not groups:
+            raise ValueError('router needs at least one replica group')
+        self.groups = dict(groups)
+        if default_group is None and len(self.groups) == 1:
+            default_group = next(iter(self.groups))
+        if default_group is not None and default_group not in self.groups:
+            raise ValueError(f'default group {default_group!r} not in '
+                             f'{sorted(self.groups)}')
+        self.default_group = default_group
+        self.policy = policy if policy is not None else LeastOutstanding()
+        self.max_outstanding = int(max_outstanding)
+        self.request_timeout_s = request_timeout_s
+        self.registry = registry if registry is not None \
+            else MetricsRegistry()
+        reg = self.registry
+        # metrics are pre-created for the fixed (group, status) grid so
+        # handler threads only ever read this dict (no get-or-create
+        # check-then-act on the hot path)
+        self._c_req = {
+            (g, st): reg.counter(
+                'fleet_requests_total',
+                help='routed requests by terminal status (ok/rejected/'
+                     'dropped/error mirror the replica answer; '
+                     'unroutable/expired/unreachable are router-local)',
+                group=g, status=st)
+            for g in self.groups
+            for st in _REPLICA_STATUSES + _ROUTER_STATUSES}
+        self._c_retry = {
+            g: reg.counter('fleet_retries_total',
+                           help='requests retried on a different replica '
+                                'after a connection-level failure',
+                           group=g)
+            for g in self.groups}
+        self._h_e2e = {
+            g: reg.histogram('fleet_e2e_ms',
+                             help='router-side end-to-end latency (ms)',
+                             group=g)
+            for g in self.groups}
+        self._g_out = {
+            g: reg.gauge('fleet_outstanding',
+                         help='requests in flight through the router',
+                         group=g)
+            for g in self.groups}
+        self._g_ready = {
+            g: reg.gauge('fleet_ready_replicas',
+                         help='replicas in the ready state', group=g)
+            for g in self.groups}
+        self._lock = threading.Lock()
+        self._out_group: Dict[str, int] = {g: 0 for g in self.groups}
+        self._out_replica: Dict[str, int] = {}
+        super().__init__(addr, _RouterHandler)
+
+    # -------------------------------------------------- outstanding ledger
+    def try_admit(self, group: str) -> bool:
+        """Fleet-level admission: one slot of the group's global bound."""
+        with self._lock:
+            if self._out_group[group] >= self.max_outstanding:
+                return False
+            self._out_group[group] += 1
+            out = self._out_group[group]
+        self._g_out[group].set(out)
+        return True
+
+    def release(self, group: str) -> None:
+        with self._lock:
+            self._out_group[group] -= 1
+            out = self._out_group[group]
+        self._g_out[group].set(out)
+
+    def candidates(self, group: str,
+                   exclude: Tuple[str, ...] = ()
+                   ) -> List[Tuple[ReplicaProcess, int]]:
+        """(replica, outstanding) for every ready replica not excluded."""
+        ready = [r for r in self.groups[group].ready()
+                 if r.replica_id not in exclude]
+        with self._lock:
+            return [(r, self._out_replica.get(r.replica_id, 0))
+                    for r in ready]
+
+    def note_start(self, replica_id: str) -> None:
+        with self._lock:
+            self._out_replica[replica_id] = \
+                self._out_replica.get(replica_id, 0) + 1
+
+    def note_done(self, replica_id: str) -> None:
+        with self._lock:
+            self._out_replica[replica_id] = \
+                self._out_replica.get(replica_id, 0) - 1
+
+    # ------------------------------------------------------------- metrics
+    def count(self, group: str, status: str) -> None:
+        self._c_req[(group, status)].inc()
+
+    def refresh_gauges(self) -> None:
+        for g, grp in self.groups.items():
+            self._g_ready[g].set(len(grp.ready()))
+
+    def stats(self) -> dict:
+        self.refresh_gauges()
+        out = {'policy': self.policy.name,
+               'max_outstanding': self.max_outstanding,
+               'groups': {}}
+        for g, grp in self.groups.items():
+            with self._lock:
+                outstanding = self._out_group[g]
+            out['groups'][g] = {
+                **grp.stats(),
+                'outstanding': outstanding,
+                'requests': {st: self._c_req[(g, st)].value
+                             for st in (_REPLICA_STATUSES
+                                        + _ROUTER_STATUSES)},
+                'retries': self._c_retry[g].value,
+                'e2e_ms': {'count': self._h_e2e[g].count,
+                           **{f'p{int(q * 100)}': v for q, v in
+                              self._h_e2e[g].quantiles().items()}},
+            }
+        return out
+
+
+def _forward(url: str, data: bytes, headers: Dict[str, str],
+             timeout_s: float) -> Tuple[int, bytes, Dict[str, str]]:
+    """POST to a replica; returns (code, body, headers). HTTP error
+    answers come back as values (the replica spoke); connection-level
+    failures raise one of _CONN_ERRORS."""
+    req = urllib.request.Request(url, data=data, method='POST',
+                                 headers=headers)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            return resp.status, resp.read(), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        return e.code, body, dict(e.headers)
+
+
+class _RouterHandler(BaseHTTPRequestHandler):
+    server: FleetRouter
+    protocol_version = 'HTTP/1.1'
+
+    def log_message(self, *args) -> None:   # quiet: telemetry goes to obs
+        pass
+
+    def _send(self, code: int, body: bytes, ctype: str,
+              extra: Optional[dict] = None) -> None:
+        self.send_response(code)
+        self.send_header('Content-Type', ctype)
+        self.send_header('Content-Length', str(len(body)))
+        for k, v in (extra or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, code: int, obj,
+                   extra: Optional[dict] = None) -> None:
+        self._send(code, json.dumps(obj).encode(), 'application/json',
+                   extra)
+
+    # ---------------------------------------------------------------- GET
+    def do_GET(self) -> None:   # noqa: N802 — http.server API
+        path = self.path.split('?', 1)[0]
+        if path == '/healthz':
+            groups = {g: {'ready': len(grp.ready()),
+                          'replicas': len(grp.replicas())}
+                      for g, grp in self.server.groups.items()}
+            ok = all(v['ready'] > 0 for v in groups.values())
+            self._send_json(200 if ok else 503,
+                            {'ok': ok, 'role': 'router',
+                             'groups': groups})
+        elif path == '/stats':
+            self._send_json(200, self.server.stats())
+        elif path == '/metrics':
+            self.server.refresh_gauges()
+            text = render_prometheus(self.server.registry)
+            self._send(200, text.encode(),
+                       'text/plain; version=0.0.4; charset=utf-8')
+        else:
+            self._send_json(404, {'error': f'no route {path}'})
+
+    # --------------------------------------------------------------- POST
+    def do_POST(self) -> None:  # noqa: N802 — http.server API
+        # body first (HTTP/1.1 keep-alive: an unread body desyncs the
+        # connection — same rule as serve/server.py)
+        length = int(self.headers.get('Content-Length', 0))
+        data = self.rfile.read(length) if length > 0 else b''
+        path, _, query = self.path.partition('?')
+        inbound = self.headers.get(TRACE_HEADER)
+        tid = inbound if valid_trace_id(inbound) else new_trace_id()
+        trace_hdr = {TRACE_HEADER: tid}
+        group = self._resolve_group(path)
+        if group is None:
+            self._send_json(404, {'error': f'no route {path}; groups: '
+                                           + ','.join(sorted(
+                                               self.server.groups))},
+                            trace_hdr)
+            return
+        if not data:
+            self._send_json(400, {'error': 'empty body'}, trace_hdr)
+            return
+        deadline_at = None
+        dl_raw = self.headers.get(DEADLINE_HEADER)
+        if dl_raw is not None:
+            try:
+                budget_ms = float(dl_raw)
+            except ValueError:
+                budget_ms = float('nan')
+            if not math.isfinite(budget_ms):
+                # same validation as the replica (serve/server.py): a
+                # NaN/inf budget must die at ingress, not propagate as
+                # the literal string 'nan' to a downstream 400
+                self._send_json(400, {'error': f'{DEADLINE_HEADER} must '
+                                               f'be a finite number'},
+                                trace_hdr)
+                return
+            deadline_at = time.perf_counter() + budget_ms / 1e3
+        if not self.server.try_admit(group):
+            self.server.count(group, 'unroutable')
+            self._send_json(503, {'error': f'fleet queue full '
+                                           f'(group {group})'},
+                            trace_hdr)
+            return
+        try:
+            self._route(group, data, query, tid, trace_hdr, deadline_at)
+        finally:
+            self.server.release(group)
+
+    def _resolve_group(self, path: str) -> Optional[str]:
+        """/predict + X-Model header, or /predict/<model>; None when the
+        name (or the route itself) is unknown."""
+        if path in ('/', '/predict'):
+            name = self.headers.get(MODEL_HEADER) \
+                or self.server.default_group
+            return name if name in self.server.groups else None
+        if path.startswith('/predict/'):
+            name = path[len('/predict/'):]
+            return name if name in self.server.groups else None
+        return None
+
+    def _route(self, group: str, data: bytes, query: str, tid: str,
+               trace_hdr: dict, deadline_at: Optional[float]) -> None:
+        """Pick -> forward -> answer, with one retry on a different
+        replica when the connection to the first one died."""
+        srv = self.server
+        t0 = time.perf_counter()
+        tried: Tuple[str, ...] = ()
+        for attempt in (0, 1):
+            cands = srv.candidates(group, exclude=tried)
+            if not cands:
+                if attempt == 0:
+                    srv.count(group, 'unroutable')
+                    self._send_json(503, {'error': f'no ready replicas '
+                                                   f'in group {group}'},
+                                    trace_hdr)
+                    return
+                break   # first replica died, nobody left to retry on
+            rid = srv.policy.choose([(r.replica_id, out)
+                                     for r, out in cands])
+            replica = next(r for r, _ in cands if r.replica_id == rid)
+            base = replica.url
+            if base is None:
+                # restart raced the snapshot: its port is gone; treat as
+                # a dead connection and move on
+                tried = tried + (rid,)
+                continue
+            timeout_s = srv.request_timeout_s
+            fwd_headers = dict(trace_hdr)
+            if deadline_at is not None:
+                remaining_ms = (deadline_at - time.perf_counter()) * 1e3
+                if remaining_ms <= 0:
+                    srv.count(group, 'expired')
+                    self._send_json(504, {'error': 'deadline spent '
+                                                   'inside the fleet'},
+                                    trace_hdr)
+                    return
+                fwd_headers[DEADLINE_HEADER] = f'{remaining_ms:.3f}'
+                timeout_s = min(timeout_s, remaining_ms / 1e3 + 5.0)
+            ctype = self.headers.get('Content-Type')
+            if ctype:
+                fwd_headers['Content-Type'] = ctype
+            url = base + '/predict' + (f'?{query}' if query else '')
+            srv.note_start(rid)
+            try:
+                code, body, headers = _forward(url, data, fwd_headers,
+                                               timeout_s)
+            except _CONN_ERRORS as e:
+                if _is_timeout(e):
+                    # the replica may still answer this request — do NOT
+                    # re-execute it elsewhere (double compute, and the
+                    # late replica-side ok would break the exact
+                    # router-vs-replica reconciliation contract)
+                    srv.count(group, 'expired')
+                    self._send_json(504, {'error': 'replica wait timed '
+                                                   'out'}, trace_hdr)
+                    return
+                tried = tried + (rid,)
+                if attempt == 0:
+                    srv._c_retry[group].inc()
+                continue
+            finally:
+                srv.note_done(rid)
+            if code == 503 and headers.get('X-Replica-State') \
+                    == 'draining':
+                # lifecycle race, not backpressure: the replica was
+                # picked before its drain state propagated. It never
+                # admitted the request (no serve_requests_total entry),
+                # so re-picking keeps the reconciliation exact AND the
+                # zero-drops-during-drain guarantee
+                tried = tried + (rid,)
+                if attempt == 0:
+                    srv._c_retry[group].inc()
+                continue
+            status = {200: 'ok', 503: 'rejected', 504: 'dropped'}.get(
+                code, 'error')
+            srv.count(group, status)
+            if status == 'ok':
+                srv._h_e2e[group].observe(
+                    (time.perf_counter() - t0) * 1e3)
+            extra = {REPLICA_HEADER: rid, **trace_hdr}
+            for h in _PASS_HEADERS:
+                if headers.get(h):
+                    extra[h] = headers[h]
+            self._send(code, body,
+                       headers.get('Content-Type', 'application/json'),
+                       extra)
+            return
+        srv.count(group, 'unreachable')
+        self._send_json(502, {'error': 'replica connection failed and '
+                                       'the one-retry budget is spent'},
+                        trace_hdr)
+
+
+def make_router(groups: Dict[str, ReplicaGroup], host: str = '127.0.0.1',
+                port: int = 0, **kwargs) -> FleetRouter:
+    """Bind the front door (port 0 picks a free one; read
+    ``router.server_address``). Call ``serve_forever()`` on a thread,
+    then ``shutdown()``."""
+    return FleetRouter((host, port), groups, **kwargs)

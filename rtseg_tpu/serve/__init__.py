@@ -23,16 +23,19 @@ from .batcher import MicroBatcher, Request, ServeDrop, ServeReject
 from .engine import (Bucket, ServeEngine, UnknownBucket, assemble_batch,
                      parse_buckets, select_bucket)
 from .loadgen import (bench_http, bench_pipeline, bench_sequential,
-                      check_report, encode_png, format_report, synth_images)
+                      check_report, encode_png, format_report,
+                      replica_skew, synth_images)
 from .pipeline import ServePipeline, ServeResult
-from .server import ServeHTTPServer, make_preprocess, make_server
+from .server import (DEADLINE_HEADER, REPLICA_HEADER, ServeHTTPServer,
+                     make_preprocess, make_server)
 
 __all__ = [
     'Bucket', 'ServeEngine', 'UnknownBucket', 'assemble_batch',
     'parse_buckets', 'select_bucket',
     'MicroBatcher', 'Request', 'ServeDrop', 'ServeReject',
     'ServePipeline', 'ServeResult',
+    'DEADLINE_HEADER', 'REPLICA_HEADER',
     'ServeHTTPServer', 'make_preprocess', 'make_server',
     'bench_http', 'bench_pipeline', 'bench_sequential', 'check_report',
-    'encode_png', 'format_report', 'synth_images',
+    'encode_png', 'format_report', 'replica_skew', 'synth_images',
 ]

@@ -11,7 +11,13 @@ and the rejection count (BENCHMARKS.md "Serving latency methodology").
 
 Two targets: in-process (drives a ServePipeline directly) and HTTP
 (drives a running server; per-stage timing comes back in the
-X-Serve-Timing header). ``check_report`` is the CI gate.
+X-Serve-Timing header). HTTP mode also takes *several* URLs — client-side
+round-robin over a replica list, or one fleet-router URL — and
+attributes each response to the replica that served it via the
+``X-Replica-Id`` header the replicas/router set, so the report carries
+``per_replica`` counts and a ``replica_skew`` sanity field (0 = perfectly
+balanced, 1 = one replica took everything). ``check_report`` is the CI
+gate.
 """
 
 from __future__ import annotations
@@ -27,6 +33,7 @@ from ..obs.tracing import TRACE_HEADER, TRACE_KEY, new_trace_id
 from .batcher import ServeDrop, ServeReject
 from .engine import Bucket, ServeEngine, assemble_batch, select_bucket
 from .pipeline import ServePipeline
+from .server import REPLICA_HEADER
 
 _STAGES = ('queue_ms', 'assemble_ms', 'device_ms', 'post_ms', 'decode_ms')
 
@@ -138,24 +145,27 @@ def bench_pipeline(pipeline: ServePipeline, images: Sequence[np.ndarray],
                      wall)
 
 
-def bench_http(url: str, payloads: Sequence[bytes], requests: int,
+def bench_http(url, payloads: Sequence[bytes], requests: int,
                rps: float, seed: int = 0, timeout_s: float = 60.0,
                workers: int = 32) -> dict:
-    """Open-loop drive of a running segserve HTTP server. Client-side e2e
-    latency; the server's own stage decomposition comes back in
-    X-Serve-Timing."""
+    """Open-loop drive of one or more running segserve HTTP servers.
+    ``url`` is a single URL (a replica, or a fleet router) or a sequence
+    of URLs (client-side round-robin over a replica list). Client-side
+    e2e latency; the server's own stage decomposition comes back in
+    X-Serve-Timing, per-replica attribution in X-Replica-Id."""
     from urllib import error, request as urlreq
 
     arrivals = _open_loop_schedule(requests, rps, seed)
     order = np.random.default_rng(seed + 1).integers(
         0, len(payloads), requests)
-    url = url.rstrip('/') + '/predict'
+    urls = [url] if isinstance(url, str) else list(url)
+    targets = [u.rstrip('/') + '/predict' for u in urls]
 
     def one(i: int, t_sched: float) -> dict:
         body = payloads[int(order[i])]
         tid = new_trace_id()
-        req = urlreq.Request(url, data=body, method='POST',
-                             headers={TRACE_HEADER: tid})
+        req = urlreq.Request(targets[i % len(targets)], data=body,
+                             method='POST', headers={TRACE_HEADER: tid})
         try:
             with urlreq.urlopen(req, timeout=timeout_s) as resp:
                 resp.read()
@@ -169,12 +179,14 @@ def bench_http(url: str, payloads: Sequence[bytes], requests: int,
                 return {'status': 'ok',
                         'e2e_ms': (time.perf_counter() - t_sched) * 1e3,
                         'timing': timing,
+                        'replica': resp.headers.get(REPLICA_HEADER),
                         'trace_ok': (resp.headers.get(TRACE_HEADER) == tid
                                      and timing.get(TRACE_KEY) == tid)}
         except error.HTTPError as e:
             e.read()
             return {'status': {503: 'rejected', 504: 'dropped'}.get(
                 e.code, 'error'),
+                'replica': e.headers.get(REPLICA_HEADER),
                 'trace_ok': e.headers.get(TRACE_HEADER) == tid}
         except Exception:   # noqa: BLE001 — connection-level failure
             return {'status': 'error'}
@@ -197,14 +209,36 @@ def bench_http(url: str, payloads: Sequence[bytes], requests: int,
                 stages[k].append(r['timing'][k])
     counts = {s: sum(1 for r in results if r['status'] == s)
               for s in ('ok', 'dropped', 'rejected', 'error')}
-    report = {'mode': 'http', 'url': url, 'requests': requests,
+    per_replica: Dict[str, int] = {}
+    for r in results:
+        if r['status'] == 'ok' and r.get('replica'):
+            per_replica[r['replica']] = per_replica.get(r['replica'],
+                                                        0) + 1
+    report = {'mode': 'http',
+              'url': targets[0] if len(targets) == 1 else targets,
+              'requests': requests,
               'rps_target': rps,
               # every response must echo the trace id the client minted
               # (in X-Trace-Id; for 200s also inside X-Serve-Timing)
               'trace_mismatch': sum(
-                  1 for r in results if r.get('trace_ok') is False)}
+                  1 for r in results if r.get('trace_ok') is False),
+              'per_replica': per_replica,
+              'replica_skew': replica_skew(per_replica)}
     return _finalize(report, e2e, stages, counts['ok'], counts['dropped'],
                      counts['rejected'], counts['error'], wall)
+
+
+def replica_skew(per_replica: Dict[str, int]) -> Optional[float]:
+    """Imbalance of per-replica ok counts: (max - min) / total, so 0 is
+    perfectly balanced and 1 is one replica taking everything. None when
+    no response carried a replica id (bare single server)."""
+    if not per_replica:
+        return None
+    counts = list(per_replica.values())
+    total = sum(counts)
+    if total <= 0:
+        return None
+    return round((max(counts) - min(counts)) / total, 4)
 
 
 def bench_sequential(engine: ServeEngine, images: Sequence[np.ndarray],
@@ -227,9 +261,21 @@ def bench_sequential(engine: ServeEngine, images: Sequence[np.ndarray],
 
 
 def check_report(report: dict, p95_ms: float,
-                 expect_buckets: Optional[int] = None) -> List[str]:
+                 expect_buckets: Optional[int] = None,
+                 max_replica_skew: Optional[float] = None,
+                 expect_replicas: Optional[int] = None) -> List[str]:
     """CI gate: the list of violated conditions (empty == pass)."""
     problems = []
+    if expect_replicas is not None:
+        seen = len(report.get('per_replica') or {})
+        if seen != expect_replicas:
+            problems.append(f'{seen} replicas served traffic, expected '
+                            f'{expect_replicas}')
+    if max_replica_skew is not None:
+        skew = report.get('replica_skew')
+        if skew is None or skew > max_replica_skew:
+            problems.append(f'replica skew {skew} > max '
+                            f'{max_replica_skew} (unbalanced routing)')
     if report.get('dropped', 0):
         problems.append(f"{report['dropped']} deadline drops (want 0)")
     if report.get('rejected', 0):
@@ -276,6 +322,11 @@ def format_report(report: dict) -> str:
     parts = [f'{k[:-3]} {v:.1f}' for k, v in st.items() if v is not None]
     if parts:
         lines.append('  stage means ms : ' + ' | '.join(parts))
+    per = report.get('per_replica')
+    if per:
+        dist = ' | '.join(f'{rid} {n}' for rid, n in sorted(per.items()))
+        lines.append(f'  per replica    : {dist} '
+                     f'(skew {report.get("replica_skew")})')
     eng = report.get('engine')
     if eng:
         lines.append(

@@ -12,7 +12,16 @@ API:
     latency decomposition rides in the ``X-Serve-Timing`` header as JSON
     (trace id included). 503 = admission rejected (queue full: back off),
     504 = deadline dropped, 413 = no bucket fits the decoded image.
-  * ``GET /healthz`` — liveness (200 once the engine is compiled).
+  * ``GET /healthz`` — liveness + lifecycle: 200 once the engine is
+    compiled, JSON ``state`` is ``ready`` or ``draining``, ``inflight``
+    counts admitted-but-unanswered predicts, ``drained`` flips true when
+    a drain has flushed every in-flight request (what a fleet manager
+    polls before reaping the process).
+  * ``POST /drain`` — graceful drain: stop admitting (``/predict``
+    answers 503 from here on), let in-flight requests finish, report
+    progress in the response and in ``/healthz``. ``?exit=1`` also shuts
+    the server down once drained, so ``serve_forever`` returns and the
+    process exits cleanly with zero dropped requests. Idempotent.
   * ``GET /stats`` — live JSON straight off the pipeline's metrics
     registry (counters + online request percentiles + engine state).
   * ``GET /metrics`` — the same registry as Prometheus text exposition
@@ -33,6 +42,14 @@ callers can stitch their own traces through, otherwise one is minted
 here. The id rides the request through every pipeline stage and segscope
 event and comes back in the ``X-Trace-Id`` response header on every
 response, including rejects/drops/errors.
+
+Fleet integration (rtseg_tpu/fleet): when the server is given a
+``replica_id`` every response carries it in ``X-Replica-Id`` (per-replica
+attribution in the load-gen report and the router's routing decisions),
+and an inbound ``X-Deadline-Ms`` header becomes the request's queue
+deadline — the router propagates its remaining latency budget downstream
+so a request that already blew its fleet-level SLO is dropped here (504)
+instead of computing an answer nobody is waiting for.
 """
 
 from __future__ import annotations
@@ -41,6 +58,8 @@ import concurrent.futures
 import io
 import json
 import math
+import threading
+import time
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
@@ -57,18 +76,86 @@ from .batcher import ServeDrop, ServeReject
 from .engine import UnknownBucket
 from .pipeline import ServePipeline
 
+#: response header attributing a response to the replica that served it
+REPLICA_HEADER = 'X-Replica-Id'
+
+#: request header carrying the caller's remaining latency budget in ms;
+#: becomes the request's queue deadline (504 when it expires in queue)
+DEADLINE_HEADER = 'X-Deadline-Ms'
+
 
 class ServeHTTPServer(ThreadingHTTPServer):
     daemon_threads = True
 
     def __init__(self, addr, pipeline: ServePipeline,
                  colormap: Optional[np.ndarray] = None,
-                 request_timeout_s: float = 30.0):
+                 request_timeout_s: float = 30.0,
+                 replica_id: Optional[str] = None):
         self.pipeline = pipeline
         self.colormap = colormap
         self.request_timeout_s = request_timeout_s
+        self.replica_id = replica_id
         self._http_counters: dict = {}
+        # drain lifecycle: _draining stops /predict admission, _inflight
+        # counts admitted-but-unanswered predicts; both only ever move
+        # under _state_lock so /healthz snapshots are consistent
+        self._state_lock = threading.Lock()
+        self._draining = False
+        self._exit_waiter = False
+        self._inflight = 0
         super().__init__(addr, _Handler)
+
+    # ------------------------------------------------------------ lifecycle
+    def try_admit(self) -> bool:
+        """One admission token for a /predict: False once draining (the
+        handler answers 503), else the in-flight count is incremented —
+        the caller must pair it with :meth:`release`."""
+        with self._state_lock:
+            if self._draining:
+                return False
+            self._inflight += 1
+            return True
+
+    def release(self) -> None:
+        with self._state_lock:
+            self._inflight -= 1
+
+    def begin_drain(self, exit_after: bool = False) -> None:
+        """Stop admitting; in-flight requests keep running to completion.
+        With ``exit_after`` a waiter thread shuts the accept loop down
+        once the last in-flight request has been answered, so the serving
+        process can exit with zero dropped work. Idempotent — and a
+        plain drain can be upgraded to drain-and-exit by a second call."""
+        with self._state_lock:
+            self._draining = True
+            spawn = exit_after and not self._exit_waiter
+            if spawn:
+                self._exit_waiter = True
+        if spawn:
+            threading.Thread(target=self._drain_exit, daemon=True,
+                             name='segserve-drain').start()
+
+    def _drain_exit(self) -> None:
+        # small grace so the /drain response itself flushes before the
+        # accept loop stops; then wait for the in-flight count to hit 0
+        time.sleep(0.05)
+        while True:
+            with self._state_lock:
+                if self._inflight == 0:
+                    break
+            time.sleep(0.02)
+        self.shutdown()
+
+    def health(self) -> dict:
+        with self._state_lock:
+            draining, inflight = self._draining, self._inflight
+        out = {'ok': True,
+               'state': 'draining' if draining else 'ready',
+               'inflight': inflight,
+               'drained': draining and inflight == 0}
+        if self.replica_id is not None:
+            out['replica'] = self.replica_id
+        return out
 
     def count_response(self, code: int) -> None:
         c = self._http_counters.get(code)
@@ -98,6 +185,10 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header('Content-Type', ctype)
         self.send_header('Content-Length', str(len(body)))
+        if self.server.replica_id is not None:
+            # every response — success or error — attributes itself, so
+            # the load-gen report and the router can count per replica
+            self.send_header(REPLICA_HEADER, self.server.replica_id)
         for k, v in (extra or {}).items():
             self.send_header(k, v)
         self.end_headers()
@@ -111,7 +202,7 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self) -> None:   # noqa: N802 — http.server API
         path = self.path.split('?', 1)[0]
         if path == '/healthz':
-            self._send_json(200, {'ok': True})
+            self._send_json(200, self.server.health())
         elif path == '/stats':
             update_memory_gauges(self.server.pipeline.registry)
             self._send_json(200, self.server.pipeline.stats())
@@ -141,6 +232,14 @@ class _Handler(BaseHTTPRequestHandler):
         if path == '/debug/profile':
             self._debug_profile(trace_hdr)
             return
+        if path == '/drain':
+            query = urllib.parse.parse_qs(
+                urllib.parse.urlsplit(self.path).query)
+            exit_after = query.get('exit', ['0'])[0] not in ('0', '',
+                                                             'false')
+            self.server.begin_drain(exit_after=exit_after)
+            self._send_json(200, self.server.health(), trace_hdr)
+            return
         if path not in ('/', '/predict'):
             self._send_json(404, {'error': f'no route {path}'},
                             trace_hdr)
@@ -148,9 +247,46 @@ class _Handler(BaseHTTPRequestHandler):
         if not data:
             self._send_json(400, {'error': 'empty body'}, trace_hdr)
             return
+        # deadline propagation: an upstream router hands down its
+        # remaining latency budget; it becomes this request's queue
+        # deadline so fleet-level 504 semantics hold end to end
+        deadline_ms = None
+        dl_raw = self.headers.get(DEADLINE_HEADER)
+        if dl_raw is not None:
+            try:
+                deadline_ms = float(dl_raw)
+            except ValueError:
+                deadline_ms = float('nan')
+            if not math.isfinite(deadline_ms):
+                self._send_json(400, {'error': f'{DEADLINE_HEADER} must '
+                                               f'be a finite number'},
+                                trace_hdr)
+                return
+            if deadline_ms <= 0:
+                self._send_json(504, {'error': 'deadline already '
+                                               'expired at ingress'},
+                                trace_hdr)
+                return
+        if not self.server.try_admit():
+            # the X-Replica-State header lets a fleet router distinguish
+            # this 503 (lifecycle: replica chosen before the drain state
+            # propagated — safe to retry elsewhere, never entered the
+            # pipeline so no serve_requests_total entry) from the
+            # batcher's queue-full 503 (backpressure: must surface)
+            self._send_json(503, {'error': 'replica draining'},
+                            {**trace_hdr,
+                             'X-Replica-State': 'draining'})
+            return
+        try:
+            self._predict(data, deadline_ms, tid, trace_hdr)
+        finally:
+            self.server.release()
+
+    def _predict(self, data: bytes, deadline_ms: Optional[float],
+                 tid: str, trace_hdr: dict) -> None:
         try:
             fut = self.server.pipeline.submit_bytes(
-                data, meta={TRACE_KEY: tid})
+                data, deadline_ms=deadline_ms, meta={TRACE_KEY: tid})
             res = fut.result(timeout=self.server.request_timeout_s)
         except ServeReject as e:
             self._send_json(503, {'error': str(e)}, trace_hdr)
@@ -240,12 +376,14 @@ class _Handler(BaseHTTPRequestHandler):
 
 def make_server(pipeline: ServePipeline, host: str = '127.0.0.1',
                 port: int = 8080, colormap: Optional[np.ndarray] = None,
-                request_timeout_s: float = 30.0) -> ServeHTTPServer:
+                request_timeout_s: float = 30.0,
+                replica_id: Optional[str] = None) -> ServeHTTPServer:
     """Bind (port 0 picks a free one; read ``server.server_address``).
     Call ``serve_forever()`` — typically on a thread — then ``shutdown()``
     + ``pipeline.close()``."""
     return ServeHTTPServer((host, port), pipeline, colormap=colormap,
-                           request_timeout_s=request_timeout_s)
+                           request_timeout_s=request_timeout_s,
+                           replica_id=replica_id)
 
 
 def make_preprocess(config):

@@ -1,0 +1,123 @@
+"""Stub replica for segfleet tests: the REAL serving front-end
+(rtseg_tpu/serve/server.py — /predict, /healthz, /drain, /metrics,
+X-Replica-Id, X-Trace-Id, X-Deadline-Ms) over a fake pipeline instead of
+a jax engine, so fleet lifecycle tests exercise genuine subprocess
+spawn/port-discovery/kill/drain semantics in ~0.3s per replica instead
+of an XLA compile.
+
+The fake pipeline resolves every predict with a 4x4 zero mask after
+``--delay-ms`` of simulated work and keeps the same live-plane metrics a
+real pipeline keeps (serve_requests_total{status=ok}, the e2e histogram,
+the serve_queue_depth gauge), so router-vs-replica /metrics
+reconciliation is the real thing. A ``--ctl-file`` (JSON
+``{"delay_ms": .., "queue_depth": ..}``) is re-read continuously so a
+test can turn a live replica slow/hot without restarting it — that is
+how the autoscaler test seeds its scale-up/scale-down signals.
+
+Run: python tests/_fleet_stub.py --port-file P --replica-id ID
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from concurrent.futures import Future
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np                                             # noqa: E402
+
+from rtseg_tpu.obs.metrics import MetricsRegistry              # noqa: E402
+from rtseg_tpu.serve.pipeline import ServeResult               # noqa: E402
+from rtseg_tpu.serve.server import make_server                 # noqa: E402
+
+
+class FakePipeline:
+    """Just enough ServePipeline surface for the HTTP front-end."""
+
+    def __init__(self, delay_ms: float, ctl_file=None):
+        self.registry = MetricsRegistry()
+        self._ok = self.registry.counter('serve_requests_total',
+                                         status='ok')
+        self._h_e2e = self.registry.histogram('serve_request_e2e_ms')
+        self._g_depth = self.registry.gauge('serve_queue_depth')
+        self._delay_ms = delay_ms
+        self._ctl_file = ctl_file
+        self._lock = threading.Lock()
+        if ctl_file:
+            threading.Thread(target=self._ctl_loop, daemon=True).start()
+
+    def _ctl_loop(self):
+        while True:
+            try:
+                with open(self._ctl_file) as f:
+                    ctl = json.load(f)
+                with self._lock:
+                    self._delay_ms = float(ctl.get('delay_ms',
+                                                   self._delay_ms))
+                self._g_depth.set(float(ctl.get('queue_depth', 0.0)))
+            except Exception:   # noqa: BLE001 — absent/torn file is fine
+                pass
+            time.sleep(0.05)
+
+    def submit_bytes(self, data, deadline_ms=None, meta=None):
+        fut = Future()
+        with self._lock:
+            delay_s = self._delay_ms / 1e3
+        t0 = time.perf_counter()
+
+        def run():
+            time.sleep(delay_s)
+            e2e = (time.perf_counter() - t0) * 1e3
+            self._ok.inc()
+            self._h_e2e.observe(e2e)
+            fut.set_result(ServeResult(
+                mask=np.zeros((4, 4), np.int8),
+                timings={'e2e_ms': round(e2e, 3),
+                         'device_ms': round(delay_s * 1e3, 3)},
+                meta=meta or {}))
+
+        threading.Thread(target=run, daemon=True).start()
+        return fut
+
+    def stats(self):
+        return {'ok': self._ok.value, 'fake': True}
+
+    def close(self):
+        pass
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--host', default='127.0.0.1')
+    ap.add_argument('--port', type=int, default=0)
+    ap.add_argument('--port-file', default=None)
+    ap.add_argument('--replica-id', default=None)
+    ap.add_argument('--delay-ms', type=float, default=5.0)
+    ap.add_argument('--ctl-file', default=None)
+    ap.add_argument('--start-delay-s', type=float, default=0.0,
+                    help='sleep before binding (slow-compile simulation)')
+    args = ap.parse_args()
+    if args.start_delay_s > 0:
+        time.sleep(args.start_delay_s)
+    pipe = FakePipeline(args.delay_ms, ctl_file=args.ctl_file)
+    cmap = np.zeros((256, 3), np.uint8)
+    server = make_server(pipe, host=args.host, port=args.port,
+                         colormap=cmap, replica_id=args.replica_id)
+    port = server.server_address[1]
+    if args.port_file:
+        tmp = args.port_file + '.tmp'
+        with open(tmp, 'w') as f:
+            f.write(f'{port}\n')
+        os.replace(tmp, args.port_file)
+    print(f'fleet-stub {args.replica_id} on {args.host}:{port}',
+          flush=True)
+    server.serve_forever()     # returns after /drain?exit=1 completes
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,614 @@
+"""segfleet (rtseg_tpu/fleet/): routing policies, replica lifecycle
+(spawn/ready/kill/restart/drain over real subprocesses), the front
+router (spreading, retry-on-death, SLO admission, deadline propagation,
+multi-model tenancy, exact /metrics reconciliation, trace spanning
+router->replica), the metrics-driven autoscaler (pure decide() on seeded
+frames AND the live polling loop), the /drain satellite on the real
+serving front-end, and the load-gen's multi-target / per-replica
+attribution.
+
+Subprocess tests use tests/_fleet_stub.py — the REAL serve/server.py
+front-end over a fake pipeline — so lifecycle semantics are genuine
+(ephemeral ports, port files, SIGKILL, exit codes) at ~0.3s per replica.
+One test compiles the real fastscnn 32x32 engine to pin drain-with-
+in-flight on the full stack.
+"""
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from rtseg_tpu import obs
+from rtseg_tpu.fleet import (Autoscaler, AutoscalePolicy, FleetManager,
+                             LeastOutstanding, ReplicaGroup,
+                             ReplicaProcess, RoundRobin, decide,
+                             get_policy, make_router, serving_signals)
+from rtseg_tpu.obs.live import parse_prometheus
+from rtseg_tpu.obs.tracing import TRACE_HEADER, valid_trace_id
+from rtseg_tpu.serve import (DEADLINE_HEADER, REPLICA_HEADER, bench_http,
+                             check_report, replica_skew)
+
+STUB = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                    '_fleet_stub.py')
+
+
+def stub_cmd(*extra):
+    """spawn_cmd building a stub-replica argv (plus extra stub flags)."""
+    def cmd(rid, port_file):
+        return [sys.executable, STUB, '--port-file', port_file,
+                '--replica-id', rid, *extra]
+    return cmd
+
+
+def make_manager(groups, tmp_path, **kw):
+    kw.setdefault('poll_s', 0.05)
+    kw.setdefault('restart_backoff_s', 0.05)
+    kw.setdefault('health_timeout_s', 2.0)
+    return FleetManager(groups, run_dir=str(tmp_path / 'fleet'), **kw)
+
+
+def http_json(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def http_post(url, data=b'x', headers=None, timeout=30):
+    req = urllib.request.Request(url, data=data, method='POST',
+                                 headers=headers or {})
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def scrape(url):
+    with urllib.request.urlopen(url + '/metrics', timeout=10) as r:
+        return parse_prometheus(r.read().decode())
+
+
+def start_router(groups, **kw):
+    router = make_router(groups, **kw)
+    threading.Thread(target=router.serve_forever, daemon=True).start()
+    return router, f'http://127.0.0.1:{router.server_address[1]}'
+
+
+def fleet_events(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f
+                if '"fleet"' in line]
+
+
+@pytest.fixture()
+def sink(tmp_path):
+    path = str(tmp_path / 'events-000.jsonl')
+    s = obs.EventSink(path)
+    obs.set_sink(s)
+    yield path
+    obs.set_sink(None)
+    s.close()
+
+
+# ----------------------------------------------------------------- policies
+def test_routing_policies_deterministic():
+    lo = LeastOutstanding()
+    assert lo.choose([('b', 3), ('a', 1), ('c', 2)]) == 'a'
+    assert lo.choose([('b', 1), ('a', 1)]) == 'a'       # tie -> id order
+    rr = RoundRobin()
+    seq = [rr.choose([('r2', 9), ('r1', 0)]) for _ in range(5)]
+    assert seq == ['r1', 'r2', 'r1', 'r2', 'r1']        # outstanding-blind
+    with pytest.raises(ValueError):
+        lo.choose([])
+    assert get_policy('least-outstanding').name == 'least-outstanding'
+    assert get_policy('round-robin').name == 'round-robin'
+    with pytest.raises(ValueError):
+        get_policy('nope')
+
+
+# --------------------------------------------------------------- autoscaler
+def _frame(p99=None, queue=0.0):
+    return {'serving': {'p99_ms': p99, 'queue_depth': queue}}
+
+
+def test_autoscaler_decide_on_seeded_frames():
+    pol = AutoscalePolicy(p99_high_ms=500, p99_low_ms=100, queue_high=4,
+                          queue_low=0.5, up_consecutive=2,
+                          down_consecutive=3)
+    # a single hot poll is noise, a streak is load
+    d, reason, s = decide([_frame(p99=900)], 1, pol, (0, 0))
+    assert (d, s) == (0, (1, 0))
+    d, reason, s = decide([_frame(p99=900)], 1, pol, s)
+    assert d == 1 and 'p99' in reason and s == (0, 0)
+    # queue depth alone also drives up (worst replica picked out of many)
+    d, _, s = decide([_frame(queue=1), _frame(queue=9)], 2, pol, (1, 0))
+    assert d == 1
+    # down needs a longer idle streak
+    s = (0, 0)
+    for i in range(3):
+        d, reason, s = decide([_frame(p99=50, queue=0)], 2, pol, s)
+    assert d == -1 and 'idle' in reason
+    # mixed signals reset both streaks
+    d, _, s = decide([_frame(p99=300, queue=2)], 1, pol, (1, 2))
+    assert (d, s) == (0, (0, 0))
+    # no serving section at all -> no decision, streaks reset
+    d, reason, s = decide([{'serving': None}], 1, pol, (5, 5))
+    assert (d, reason, s) == (0, 'no signal', (0, 0))
+    assert serving_signals([]) is None
+    sig = serving_signals([_frame(p99=10, queue=1), _frame(p99=70)])
+    assert sig['worst_p99_ms'] == 70 and sig['replicas_reporting'] == 2
+
+
+# ------------------------------------------------------------------ loadgen
+def test_replica_skew_field():
+    assert replica_skew({}) is None
+    assert replica_skew({'a': 10, 'b': 10}) == 0.0
+    assert replica_skew({'a': 20}) == 0.0
+    assert replica_skew({'a': 30, 'b': 10}) == 0.5
+    problems = check_report(
+        {'ok': 4, 'requests': 4, 'dropped': 0, 'rejected': 0,
+         'errors': 0, 'e2e_p95_ms': 1.0, 'trace_mismatch': 0,
+         'per_replica': {'a': 4}, 'replica_skew': 0.0},
+        p95_ms=10, max_replica_skew=0.5, expect_replicas=2)
+    assert any('replicas served traffic' in p for p in problems)
+    assert not any('skew' in p for p in problems)
+
+
+# ----------------------------------------------------- replica lifecycle
+def test_manager_spawn_ready_kill_restart_drain(tmp_path, sink):
+    g = ReplicaGroup('m', stub_cmd(), min_replicas=2, max_replicas=3)
+    mgr = make_manager([g], tmp_path)
+    try:
+        mgr.start()
+        ready = mgr.wait_ready('m', 2, timeout_s=30)
+        assert [r.replica_id for r in ready] == ['m-1', 'm-2']
+        assert all(r.ready_s is not None for r in ready)
+        # healthz through the handle
+        h = ready[0].check_health()
+        assert h['state'] == 'ready' and h['replica'] == 'm-1'
+
+        # SIGKILL one replica: death is detected, restarted, ready again
+        victim = ready[1]
+        os.kill(victim.pid, signal.SIGKILL)
+        deadline = time.monotonic() + 30
+        while victim.restarts == 0 or victim.state != 'ready':
+            assert time.monotonic() < deadline, victim.snapshot()
+            time.sleep(0.05)
+        assert victim.restarts == 1 and victim.state == 'ready'
+
+        # graceful drain: stops admitting, exits 0, reaped as stopped
+        assert mgr.drain_replica('m', 'm-1')
+        deadline = time.monotonic() + 30
+        while ready[0].state != 'stopped':
+            assert time.monotonic() < deadline, ready[0].snapshot()
+            time.sleep(0.05)
+        assert ready[0].poll_exit() == 0      # clean exit, nothing lost
+    finally:
+        mgr.stop(drain=False)
+    actions = [e['action'] for e in fleet_events(sink)]
+    assert actions.count('scale_up') == 1          # 0 -> 2 at startup
+    assert 'replica_death' in actions and 'restart' in actions
+    assert actions.count('replica_ready') == 3     # 2 startup + 1 restart
+    assert 'drain' in actions and 'drain_complete' in actions
+    ev_death = next(e for e in fleet_events(sink)
+                    if e['action'] == 'replica_death')
+    assert ev_death['replica'] == 'm-2' and ev_death['group'] == 'm'
+
+
+def test_manager_restart_budget_exhausts_to_failed(tmp_path, sink):
+    # a spawn_cmd that dies instantly: python -c 'raise SystemExit(3)'
+    def cmd(rid, port_file):
+        return [sys.executable, '-c', 'raise SystemExit(3)']
+    g = ReplicaGroup('bad', cmd, min_replicas=1, max_replicas=1)
+    mgr = make_manager([g], tmp_path, max_restarts=2,
+                       restart_backoff_s=0.02)
+    try:
+        mgr.start()
+        deadline = time.monotonic() + 30
+        while not any(r.state == 'failed' for r in g.replicas()):
+            assert time.monotonic() < deadline, g.stats()
+            time.sleep(0.05)
+    finally:
+        mgr.stop(drain=False)
+    actions = [e['action'] for e in fleet_events(sink)]
+    assert actions.count('replica_death') == 3     # initial + 2 restarts
+    assert actions.count('restart') == 2
+    assert 'replica_failed' in actions
+
+
+# ------------------------------------------------------------------- router
+def test_router_spread_reconcile_and_trace(tmp_path, sink):
+    g = ReplicaGroup('m', stub_cmd('--delay-ms', '10'), min_replicas=2,
+                     max_replicas=2)
+    mgr = make_manager([g], tmp_path)
+    router = None
+    try:
+        mgr.start()
+        replicas = mgr.wait_ready('m', 2, timeout_s=30)
+        router, base = start_router({'m': g})
+        # health + a traced single request through the fleet
+        h = http_json(base + '/healthz')
+        assert h['ok'] and h['groups']['m']['ready'] == 2
+        tid = 'abcd1234' * 2
+        with http_post(base + '/predict', headers={TRACE_HEADER: tid}) \
+                as resp:
+            assert resp.status == 200
+            assert resp.headers[TRACE_HEADER] == tid
+            rid = resp.headers[REPLICA_HEADER]
+            assert rid in ('m-1', 'm-2')
+            timing = json.loads(resp.headers['X-Serve-Timing'])
+            # ONE id spans router -> replica -> response: the replica's
+            # own pipeline timing carries the id the client minted
+            assert timing['trace_id'] == tid
+            resp.read()
+        # open-loop bench through the router: all ok, both replicas used
+        report = bench_http(base, [b'img'], requests=40, rps=300, seed=0)
+        assert report['ok'] == 40 and report['errors'] == 0
+        assert report['trace_mismatch'] == 0
+        assert set(report['per_replica']) == {'m-1', 'm-2'}
+        assert report['replica_skew'] is not None
+        # exact reconciliation: router totals == sum of replica scrapes
+        # == the load-gen's view (+1 for the traced request above)
+        parsed = scrape(base)
+        by_status = {lab['status']: int(v) for lab, v in
+                     parsed['fleet_requests_total']}
+        assert by_status['ok'] == 41
+        assert by_status['rejected'] == by_status['dropped'] == 0
+        assert by_status['error'] == by_status['unreachable'] == 0
+        replica_ok = 0
+        for r in replicas:
+            rp = scrape(r.url)
+            replica_ok += int(next(
+                v for lab, v in rp['serve_requests_total']
+                if lab.get('status') == 'ok'))
+        assert replica_ok == 41
+        hist = int(sum(v for _, v in parsed['fleet_e2e_ms_count']))
+        assert hist == 41
+        # /stats reads the same registry objects
+        stats = router.stats()
+        assert stats['groups']['m']['requests']['ok'] == 41
+        assert stats['groups']['m']['retries'] == 0
+    finally:
+        if router is not None:
+            router.shutdown()
+        mgr.stop(drain=False)
+
+
+def test_router_retries_once_on_dead_replica(tmp_path, sink):
+    g = ReplicaGroup('m', stub_cmd(), min_replicas=1, max_replicas=2)
+    mgr = make_manager([g], tmp_path)
+    router = None
+    try:
+        mgr.start()
+        mgr.wait_ready('m', 1, timeout_s=30)
+        # inject a "ready" replica whose port nobody listens on, with an
+        # id sorting FIRST so least-outstanding deterministically picks
+        # the dead one before the live one
+        import socket as socklib
+        s = socklib.socket()
+        s.bind(('127.0.0.1', 0))
+        dead_port = s.getsockname()[1]
+        s.close()
+        dead = ReplicaProcess('m-0-dead', argv=[],
+                              run_dir=str(tmp_path / 'fleet'))
+        with open(dead.port_file, 'w') as f:
+            f.write(f'{dead_port}\n')
+        assert dead.discover_port() == dead_port
+        dead.set_state('ready')
+        g.add(dead)
+        router, base = start_router({'m': g})
+        with http_post(base + '/predict') as resp:
+            assert resp.status == 200
+            # the retry landed on the live replica
+            assert resp.headers[REPLICA_HEADER] == 'm-1'
+            resp.read()
+        parsed = scrape(base)
+        retries = next(v for lab, v in parsed['fleet_retries_total']
+                       if lab.get('group') == 'm')
+        assert int(retries) == 1
+        # kill the live one too: retry budget exhausts to 502
+        dead2_live = [r for r in g.ready() if r.replica_id == 'm-1']
+        os.kill(dead2_live[0].pid, signal.SIGKILL)
+        mgr.stop(drain=False)   # monitor off: both stay "ready", dead
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            http_post(base + '/predict').read()
+        assert ei.value.code == 502
+        ei.value.read()
+    finally:
+        if router is not None:
+            router.shutdown()
+        mgr.stop(drain=False)
+
+
+def test_router_repicks_on_draining_replica_503(tmp_path, sink):
+    """The drain-ordering race: a replica picked before its drain state
+    propagated answers 503 + X-Replica-State: draining — the router
+    must re-pick another replica instead of surfacing the 503, keeping
+    the zero-drops-during-drain guarantee. A draining replica never
+    admits the request, so accounting stays exact."""
+    g = ReplicaGroup('m', stub_cmd(), min_replicas=2, max_replicas=2)
+    mgr = make_manager([g], tmp_path)
+    router = None
+    try:
+        mgr.start()
+        replicas = mgr.wait_ready('m', 2, timeout_s=30)
+        # drain m-1 BEHIND the manager's back: the router still sees it
+        # 'ready' (the race window), and least-outstanding's id
+        # tie-break picks m-1 first
+        with http_post(replicas[0].url + '/drain') as r:
+            assert json.loads(r.read())['state'] == 'draining'
+        router, base = start_router({'m': g})
+        with http_post(base + '/predict') as resp:
+            assert resp.status == 200
+            assert resp.headers[REPLICA_HEADER] == 'm-2'
+            resp.read()
+        parsed = scrape(base)
+        by = {lab['status']: int(v) for lab, v in
+              parsed['fleet_requests_total']}
+        assert by['ok'] == 1 and by['rejected'] == 0
+        retries = next(v for lab, v in parsed['fleet_retries_total']
+                       if lab.get('group') == 'm')
+        assert int(retries) == 1
+    finally:
+        if router is not None:
+            router.shutdown()
+        mgr.stop(drain=False)
+
+
+def test_router_kill_mid_bench_zero_errors_and_restart(tmp_path, sink):
+    g = ReplicaGroup('m', stub_cmd('--delay-ms', '40'), min_replicas=2,
+                     max_replicas=2)
+    mgr = make_manager([g], tmp_path)
+    router = None
+    try:
+        mgr.start()
+        replicas = mgr.wait_ready('m', 2, timeout_s=30)
+        router, base = start_router({'m': g}, max_outstanding=256)
+        report_box = {}
+
+        def bench():
+            report_box['r'] = bench_http(base, [b'img'], requests=90,
+                                         rps=120, seed=1)
+
+        t = threading.Thread(target=bench)
+        t.start()
+        time.sleep(0.30)                     # ~1/3 through the schedule
+        os.kill(replicas[1].pid, signal.SIGKILL)
+        t.join(timeout=120)
+        report = report_box['r']
+        # the kill is absorbed: every request answered, zero errors —
+        # in-flight casualties were retried on the surviving replica
+        assert report['errors'] == 0, report
+        assert report['ok'] == 90, report
+        # the manager restarted the dead replica
+        deadline = time.monotonic() + 30
+        while replicas[1].state != 'ready':
+            assert time.monotonic() < deadline, replicas[1].snapshot()
+            time.sleep(0.05)
+        assert replicas[1].restarts >= 1
+    finally:
+        if router is not None:
+            router.shutdown()
+        mgr.stop(drain=False)
+    actions = [e['action'] for e in fleet_events(sink)]
+    assert 'replica_death' in actions and 'restart' in actions
+
+
+def test_router_multi_model_admission_deadline(tmp_path, sink):
+    ga = ReplicaGroup('alpha', stub_cmd(), min_replicas=1, max_replicas=1)
+    gb = ReplicaGroup('beta', stub_cmd('--delay-ms', '300'),
+                      min_replicas=1, max_replicas=1)
+    mgr = make_manager([ga, gb], tmp_path)
+    router = None
+    try:
+        mgr.start()
+        mgr.wait_ready('alpha', 1, timeout_s=30)
+        mgr.wait_ready('beta', 1, timeout_s=30)
+        router, base = start_router({'alpha': ga, 'beta': gb},
+                                    default_group='alpha',
+                                    max_outstanding=1)
+        # dispatch by path segment and by X-Model header; default group
+        with http_post(base + '/predict/beta') as r:
+            assert r.headers[REPLICA_HEADER].startswith('beta-')
+            r.read()
+        with http_post(base + '/predict', headers={'X-Model': 'beta'}) \
+                as r:
+            assert r.headers[REPLICA_HEADER].startswith('beta-')
+            r.read()
+        with http_post(base + '/predict') as r:
+            assert r.headers[REPLICA_HEADER].startswith('alpha-')
+            r.read()
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            http_post(base + '/predict/nope').read()
+        assert ei.value.code == 404
+        ei.value.read()
+        # fleet-level admission: beta is slow (300ms); with a global
+        # bound of 1 a concurrent second request is 503'd at the door
+        codes = []
+
+        def fire():
+            try:
+                with http_post(base + '/predict/beta') as r:
+                    r.read()
+                    codes.append(r.status)
+            except urllib.error.HTTPError as e:
+                e.read()
+                codes.append(e.code)
+
+        threads = [threading.Thread(target=fire) for _ in range(2)]
+        threads[0].start()
+        time.sleep(0.1)
+        threads[1].start()
+        for th in threads:
+            th.join(timeout=30)
+        assert sorted(codes) == [200, 503], codes
+        # deadline propagation: a spent budget 504s at the router...
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            http_post(base + '/predict', headers={DEADLINE_HEADER: '0'})
+        assert ei.value.code == 504
+        ei.value.read()
+        # ...and errors still carry a minted trace id
+        assert valid_trace_id(ei.value.headers[TRACE_HEADER])
+        parsed = scrape(base)
+        by = {(lab['group'], lab['status']): int(v) for lab, v in
+              parsed['fleet_requests_total']}
+        assert by[('beta', 'rejected')] == 0      # replica never saw it
+        assert by[('beta', 'unroutable')] == 1    # the fleet bound did
+        assert by[('alpha', 'expired')] == 1
+    finally:
+        if router is not None:
+            router.shutdown()
+        mgr.stop(drain=False)
+
+
+# --------------------------------------------------------- autoscaler loop
+def test_autoscaler_loop_scales_up_then_down(tmp_path, sink):
+    ctl = str(tmp_path / 'ctl.json')
+    with open(ctl, 'w') as f:
+        json.dump({'queue_depth': 0.0}, f)
+    g = ReplicaGroup('m', stub_cmd('--ctl-file', ctl), min_replicas=1,
+                     max_replicas=2)
+    mgr = make_manager([g], tmp_path)
+    scaler = None
+    try:
+        mgr.start()
+        mgr.wait_ready('m', 1, timeout_s=30)
+        pol = AutoscalePolicy(queue_high=5, queue_low=0.5,
+                              p99_high_ms=1e9, p99_low_ms=1e9,
+                              up_consecutive=2, down_consecutive=3,
+                              cooldown_s=0.1)
+        scaler = Autoscaler(mgr, 'm', policy=pol, poll_s=0.05)
+        scaler.start()
+        # seed a hot signal through the stub's live /metrics plane
+        with open(ctl, 'w') as f:
+            json.dump({'queue_depth': 50.0}, f)
+        mgr.wait_ready('m', 2, timeout_s=30)       # scaled up
+        # back to idle: scales down, the drained replica exits cleanly
+        with open(ctl, 'w') as f:
+            json.dump({'queue_depth': 0.0}, f)
+        deadline = time.monotonic() + 30
+        while len(g.ready()) != 1 or not any(
+                r.state == 'stopped' for r in g.replicas()):
+            assert time.monotonic() < deadline, g.stats()
+            time.sleep(0.05)
+        stopped = [r for r in g.replicas() if r.state == 'stopped']
+        assert stopped and stopped[0].poll_exit() == 0
+    finally:
+        if scaler is not None:
+            scaler.stop()
+        mgr.stop(drain=False)
+    evs = fleet_events(sink)
+    ups = [e for e in evs if e['action'] == 'scale_up'
+           and 'autoscale' in e.get('reason', '')]
+    downs = [e for e in evs if e['action'] == 'scale_down'
+             and 'autoscale' in e.get('reason', '')]
+    assert ups and downs
+
+
+# --------------------------------------------- loadgen multi-target mode
+def test_loadgen_multi_target_round_robin(tmp_path):
+    g = ReplicaGroup('m', stub_cmd(), min_replicas=2, max_replicas=2)
+    mgr = make_manager([g], tmp_path)
+    try:
+        mgr.start()
+        replicas = mgr.wait_ready('m', 2, timeout_s=30)
+        urls = [r.url for r in replicas]
+        report = bench_http(urls, [b'img'], requests=20, rps=400, seed=0)
+        assert report['ok'] == 20 and report['errors'] == 0
+        # strict client-side round-robin over 2 targets: 10 + 10
+        assert report['per_replica'] == {'m-1': 10, 'm-2': 10}
+        assert report['replica_skew'] == 0.0
+        assert check_report(report, p95_ms=10000, max_replica_skew=0.1,
+                            expect_replicas=2) == []
+    finally:
+        mgr.stop(drain=False)
+
+
+# ------------------------------------- drain on the real serving stack
+BUCKETS = [(32, 32)]
+
+
+@pytest.fixture(scope='module')
+def engine():
+    import jax
+    import jax.numpy as jnp
+    from rtseg_tpu.config import SegConfig
+    from rtseg_tpu.models import get_model
+    from rtseg_tpu.serve import ServeEngine
+    cfg = SegConfig(dataset='synthetic', model='fastscnn', num_class=5,
+                    colormap='custom', compute_dtype='float32',
+                    save_dir='/tmp/rtseg_segfleet_test', use_tb=False)
+    cfg.resolve(num_devices=1)
+    model = get_model(cfg)
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, 32, 32, 3), jnp.float32), False)
+    return ServeEngine.from_config(cfg, BUCKETS, 4, variables=variables)
+
+
+def test_real_server_drain_completes_inflight_zero_drops(engine):
+    """The /drain satellite on the full stack: in-flight requests
+    admitted before the drain complete OK (zero drops), new ones are
+    503'd, /healthz walks ready -> draining -> drained, and ?exit=1
+    returns serve_forever."""
+    from rtseg_tpu.serve import ServePipeline, make_server
+    pipe = ServePipeline(engine, max_wait_ms=400, max_queue=32)
+
+    # bytes -> f32 image without PIL: the stub preprocess keeps this
+    # test about drain, not decoding
+    def preprocess(data):
+        return np.zeros((32, 32, 3), np.float32)
+
+    pipe.preprocess = preprocess
+    server = make_server(pipe, port=0, replica_id='solo',
+                         colormap=np.zeros((256, 3), np.uint8))
+    base = f'http://127.0.0.1:{server.server_address[1]}'
+    t = threading.Thread(target=server.serve_forever)
+    t.start()
+    try:
+        assert http_json(base + '/healthz')['state'] == 'ready'
+        # two requests sit in the 400ms coalescing window -> in flight
+        results = []
+
+        def fire():
+            with http_post(base + '/predict?raw=1', data=b'img') as r:
+                r.read()
+                results.append(r.status)
+
+        threads = [threading.Thread(target=fire) for _ in range(2)]
+        for th in threads:
+            th.start()
+        time.sleep(0.12)
+        with http_post(base + '/drain') as r:
+            drain_state = json.loads(r.read())
+        assert drain_state['state'] == 'draining'
+        assert drain_state['inflight'] == 2
+        # draining replica refuses new work with the 503 the router and
+        # load balancers already understand
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            http_post(base + '/predict?raw=1', data=b'img').read()
+        assert ei.value.code == 503
+        ei.value.read()
+        for th in threads:
+            th.join(timeout=60)
+        assert results == [200, 200]          # zero drops through drain
+        h = http_json(base + '/healthz')
+        assert h['state'] == 'draining' and h['drained'] is True
+        assert h['replica'] == 'solo'
+        # nothing was dropped or errored on the pipeline either
+        snap = pipe.registry.snapshot()
+        assert snap['serve_requests_total{status="ok"}'] == 2
+        assert 'serve_requests_total{status="dropped"}' not in snap \
+            or snap['serve_requests_total{status="dropped"}'] == 0
+        # upgrade to drain-and-exit: serve_forever returns
+        with http_post(base + '/drain?exit=1') as r:
+            r.read()
+        t.join(timeout=30)
+        assert not t.is_alive()
+    finally:
+        if t.is_alive():
+            server.shutdown()
+            t.join(timeout=10)
+        pipe.close()

@@ -1,0 +1,492 @@
+#!/usr/bin/env python
+"""segfleet — multi-replica serving fleet CLI (rtseg_tpu/fleet/).
+
+Usage:
+    # one front door over N warm-started replica processes per model
+    python tools/segfleet.py serve --models seg=fastscnn:2 \
+        --num_class 19 --buckets 512x1024,256x512 --batch 8 \
+        --compile-cache /var/cache/segwarm --port 8080
+
+    # multi-model tenancy: several groups behind one router
+    python tools/segfleet.py serve \
+        --models fast=fastscnn:2,bise=bisenetv2:1 ...
+
+    # metrics-driven autoscaling between --models N and --max-replicas
+    python tools/segfleet.py serve --models seg=fastscnn:1 \
+        --autoscale --max-replicas 4 --p99-high-ms 500 ...
+
+    # the fleet e2e gate (CI + BENCHMARKS.md "Fleet serving
+    # methodology"): 2 warm replicas behind the router; baseline one
+    # replica's capacity, drive the fleet open-loop, SIGKILL a replica
+    # mid-bench (retries must absorb it: 0 errors), drain one mid-burst
+    # (0 drops), reconcile router-vs-replica /metrics exactly
+    python tools/segfleet.py bench --replicas 2 --buckets 64x64 \
+        --batch 4 --check
+
+Replicas are real `tools/segserve.py serve` subprocesses (ephemeral
+ports via --port-file, every response tagged X-Replica-Id), spawned
+through a shared segwarm compile cache so the second-and-later replicas
+start without compiling. The router exposes /predict (+ /predict/<model>
+and X-Model), /healthz, /stats, /metrics; replica lifecycle and scaling
+land as `fleet` events in the segscope sink (--obs-dir).
+
+Exit codes: 0 ok, 1 --check failed, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from rtseg_tpu import obs                                      # noqa: E402
+from rtseg_tpu.fleet import (Autoscaler, AutoscalePolicy,      # noqa: E402
+                             FleetManager, ReplicaGroup, get_policy,
+                             make_router)
+from rtseg_tpu.obs.live import parse_prometheus                # noqa: E402
+from rtseg_tpu.serve import (bench_http, check_report,         # noqa: E402
+                             encode_png, format_report, parse_buckets,
+                             synth_images)
+
+_SEGSERVE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         'segserve.py')
+
+
+# ------------------------------------------------------------------ plumbing
+def parse_models(spec: str) -> list:
+    """'fast=fastscnn:2,bise=bisenetv2:1' -> [(alias, model, n), ...].
+    The replica count defaults to 1; the alias defaults to the model."""
+    out = []
+    for part in spec.split(','):
+        part = part.strip()
+        if not part:
+            continue
+        alias, eq, rest = part.partition('=')
+        if not eq:
+            alias, rest = part, part
+        model, colon, n = rest.partition(':')
+        out.append((alias.strip(), model.strip(),
+                    int(n) if colon else 1))
+    if not out:
+        raise ValueError(f'no models in spec {spec!r}')
+    return out
+
+
+def make_spawn_cmd(args, model: str, obs_root=None):
+    """argv builder handed to the ReplicaGroup: each replica is a real
+    segserve process on an ephemeral port, warm through the shared
+    compile cache."""
+    def cmd(rid: str, port_file: str):
+        argv = [sys.executable, _SEGSERVE, 'serve',
+                '--model', model,
+                '--num_class', str(args.num_class),
+                '--buckets', args.buckets,
+                '--batch', str(args.batch),
+                '--max-wait-ms', str(args.max_wait_ms),
+                '--max-queue', str(args.max_queue),
+                '--workers', str(args.workers),
+                '--host', '127.0.0.1', '--port', '0',
+                '--port-file', port_file,
+                '--replica-id', rid]
+        if args.compute_dtype:
+            argv += ['--compute_dtype', args.compute_dtype]
+        if args.compile_cache:
+            argv += ['--compile-cache', args.compile_cache]
+        if args.ckpt:
+            argv += ['--ckpt', args.ckpt]
+        if obs_root:
+            argv += ['--obs-dir', os.path.join(obs_root,
+                                               f'replica-{rid}')]
+        return argv
+    return cmd
+
+
+def _scrape(url: str) -> dict:
+    import urllib.request
+    with urllib.request.urlopen(url + '/metrics', timeout=10) as r:
+        return parse_prometheus(r.read().decode())
+
+
+def _replica_ok_sum(replicas) -> int:
+    total = 0
+    for r in replicas:
+        parsed = _scrape(r.url)
+        total += int(next(
+            (v for lab, v in parsed.get('serve_requests_total', ())
+             if lab.get('status') == 'ok'), 0))
+    return total
+
+
+def _router_counts(url: str, group: str) -> dict:
+    parsed = _scrape(url)
+    return {lab['status']: int(v)
+            for lab, v in parsed.get('fleet_requests_total', ())
+            if lab.get('group') == group}
+
+
+def _start_router(groups, args):
+    router = make_router(groups, host=args.host, port=args.port,
+                         policy=get_policy(args.policy),
+                         max_outstanding=args.max_outstanding)
+    threading.Thread(target=router.serve_forever, daemon=True).start()
+    host, port = router.server_address[:2]
+    return router, f'http://{host}:{port}'
+
+
+# -------------------------------------------------------------------- serve
+def cmd_serve(args) -> int:
+    sink = None
+    if args.obs_dir:
+        sink = obs.init_run(args.obs_dir, meta={
+            'fleet': True, 'models': args.models,
+            'buckets': args.buckets, 'batch': args.batch})
+        obs.set_sink(sink)
+    specs = parse_models(args.models)
+    groups = []
+    for alias, model, n in specs:
+        groups.append(ReplicaGroup(
+            alias, make_spawn_cmd(args, model, obs_root=args.obs_dir),
+            min_replicas=n,
+            max_replicas=max(n, args.max_replicas or n)))
+    manager = FleetManager(groups, run_dir=args.run_dir,
+                           max_restarts=args.max_restarts,
+                           drain_grace_s=args.drain_grace_s)
+    manager.start()
+    scalers = []
+    router = None
+    try:
+        for g in groups:
+            reps = manager.wait_ready(g.name,
+                                      timeout_s=args.ready_timeout_s)
+            times = ', '.join(f'{r.replica_id} {r.ready_s:.2f}s'
+                              for r in reps)
+            print(f'segfleet: group {g.name} ready ({times})',
+                  flush=True)
+        router, url = _start_router({g.name: g for g in groups}, args)
+        if args.autoscale:
+            policy = AutoscalePolicy(
+                p99_high_ms=args.p99_high_ms,
+                p99_low_ms=args.p99_low_ms,
+                queue_high=args.queue_high,
+                cooldown_s=args.cooldown_s)
+            for g in groups:
+                s = Autoscaler(manager, g.name, policy=policy,
+                               poll_s=args.autoscale_poll_s)
+                s.start()
+                scalers.append(s)
+        names = ','.join(g.name for g in groups)
+        print(f'segfleet: router on {url} | groups {names} | policy '
+              f'{args.policy} | POST /predict[/<model>], GET /healthz '
+              f'/stats /metrics'
+              + (' | autoscaling' if scalers else ''), flush=True)
+        # serve until SIGTERM/SIGINT, then drain the whole fleet
+        done = threading.Event()
+        signal.signal(signal.SIGTERM, lambda *a: done.set())
+        try:
+            done.wait()
+        except KeyboardInterrupt:
+            pass
+        print('segfleet: draining fleet...', flush=True)
+    finally:
+        for s in scalers:
+            s.stop()
+        if router is not None:
+            router.shutdown()
+        manager.stop(drain=True, timeout_s=args.drain_grace_s)
+        if sink is not None:
+            sink.emit({'event': 'run_end'})
+            sink.close()
+            if obs.get_sink() is sink:
+                obs.set_sink(None)
+    return 0
+
+
+# -------------------------------------------------------------------- bench
+def _bench_thread(url, payloads, requests, rps, seed, box, key):
+    box[key] = bench_http(url, payloads, requests, rps, seed=seed)
+
+
+def cmd_bench(args) -> int:
+    obs_dir = args.obs_dir or '/tmp/segfleet_bench/segscope'
+    sink = obs.init_run(obs_dir, meta={
+        'fleet': True, 'bench': True, 'model': args.model,
+        'buckets': args.buckets, 'batch': args.batch,
+        'replicas': args.replicas})
+    obs.set_sink(sink)
+    args.models = f'fleet={args.model}:{args.replicas}'
+    # min starts at 1: the first replica populates the shared segwarm
+    # cache, then scale_to fans out the rest as warm starts — the
+    # spin-up numbers in the report show the cold/warm split honestly
+    group = ReplicaGroup('fleet', make_spawn_cmd(args, args.model),
+                         min_replicas=1,
+                         max_replicas=args.replicas)
+    manager = FleetManager([group], run_dir=args.run_dir,
+                           drain_grace_s=args.drain_grace_s)
+    buckets = parse_buckets(args.buckets)
+    payloads = [encode_png(im)
+                for im in synth_images(buckets, seed=args.seed)]
+    problems = []
+    report = {'buckets': args.buckets, 'batch': args.batch,
+              'replicas': args.replicas}
+    router = None
+    t_start = time.perf_counter()
+    try:
+        # ---- spin-up: first replica fills the shared compile cache,
+        # the rest warm-start from it
+        manager.start()
+        manager.wait_ready('fleet', 1, timeout_s=args.ready_timeout_s)
+        if args.replicas > 1:
+            manager.scale_to('fleet', args.replicas,
+                             reason='bench spin-up')
+        replicas = manager.wait_ready('fleet', args.replicas,
+                                      timeout_s=args.ready_timeout_s)
+        report['spinup'] = {r.replica_id: round(r.ready_s, 2)
+                            for r in replicas}
+        print(f'segfleet bench — {args.replicas}x {args.model} '
+              f'{args.buckets} batch {args.batch} | spin-up '
+              + ' '.join(f'{k}={v}s'
+                         for k, v in report['spinup'].items()),
+              flush=True)
+        router, url = _start_router({'fleet': group}, args)
+        print(f'  router         : {url} | policy {args.policy}',
+              flush=True)
+
+        # ---- phase 0: single-replica capacity (closed gate not applied;
+        # overload on purpose so ok/wall measures capacity, not the
+        # arrival schedule)
+        base = bench_http(replicas[0].url, payloads,
+                          args.baseline_requests, args.overload_rps,
+                          seed=args.seed)
+        c1 = base['rps_achieved']
+        report['baseline'] = base
+        print(f'  baseline       : 1 replica serves {c1:.1f} rps at '
+              f'saturation ({base["ok"]}/{base["requests"]} ok under '
+              f'{args.overload_rps} rps overload)', flush=True)
+
+        # ---- phase A: the fleet sustains > 1x single-replica capacity
+        # with zero losses; reconcile router vs replicas vs client
+        fleet_rps = args.fleet_rps or round(
+            max(8.0, args.target_speedup * c1), 1)
+        before_rep = _replica_ok_sum(replicas)
+        before_rtr = _router_counts(url, 'fleet').get('ok', 0)
+        phase_a = bench_http(url, payloads, args.requests, fleet_rps,
+                             seed=args.seed + 1)
+        report['fleet'] = phase_a
+        speedup = (phase_a['rps_achieved'] / c1) if c1 else 0.0
+        report['speedup_vs_single'] = round(speedup, 2)
+        print(format_report(phase_a), flush=True)
+        print(f'  vs 1 replica   : {phase_a["rps_achieved"]:.1f} rps '
+              f'over {c1:.1f} -> {speedup:.2f}x', flush=True)
+        problems += check_report(phase_a, args.p95_ms,
+                                 expect_replicas=args.replicas)
+        if speedup < args.min_speedup:
+            problems.append(f'fleet speedup {speedup:.2f}x < '
+                            f'--min-speedup {args.min_speedup}x')
+        after_rep = _replica_ok_sum(replicas)
+        after_rtr = _router_counts(url, 'fleet').get('ok', 0)
+        recon = {'loadgen_ok': phase_a['ok'],
+                 'router_ok_delta': after_rtr - before_rtr,
+                 'replica_ok_delta': after_rep - before_rep}
+        report['reconciliation'] = recon
+        if len(set(recon.values())) != 1:
+            problems.append(f'/metrics reconciliation mismatch: {recon}')
+        print(f'  reconciliation : loadgen {recon["loadgen_ok"]} == '
+              f'router {recon["router_ok_delta"]} == replicas '
+              f'{recon["replica_ok_delta"]}', flush=True)
+
+        # ---- phase B: SIGKILL a replica mid-bench; the router's retry
+        # absorbs the in-flight casualties and the manager restarts it
+        kill_rps = args.kill_rps or round(max(4.0, 0.5 * c1), 1)
+        box = {}
+        t = threading.Thread(target=_bench_thread, args=(
+            url, payloads, args.kill_requests, kill_rps,
+            args.seed + 2, box, 'r'))
+        t.start()
+        time.sleep((args.kill_requests / kill_rps) / 3)
+        victim = replicas[1]
+        os.kill(victim.pid, signal.SIGKILL)
+        t.join(timeout=300)
+        phase_b = box['r']
+        report['kill'] = phase_b
+        print(f'  kill mid-bench : SIGKILL {victim.replica_id} at 1/3 '
+              f'of {args.kill_requests} reqs @ {kill_rps} rps -> '
+              f'{phase_b["ok"]} ok | {phase_b["errors"]} errors | '
+              f'{phase_b.get("rejected", 0)} rejected', flush=True)
+        if phase_b['errors'] or phase_b['ok'] != args.kill_requests:
+            problems.append(
+                f'kill phase lost requests: {phase_b["ok"]}/'
+                f'{args.kill_requests} ok, {phase_b["errors"]} errors')
+        deadline = time.monotonic() + args.ready_timeout_s
+        while victim.state != 'ready' and time.monotonic() < deadline:
+            time.sleep(0.1)
+        report['victim_restarted'] = victim.state == 'ready'
+        print(f'  restart        : {victim.replica_id} '
+              f'{"back ready" if report["victim_restarted"] else "NOT ready"}'
+              f' (restarts={victim.restarts})', flush=True)
+        if not report['victim_restarted']:
+            problems.append('killed replica was not restarted in time')
+
+        # ---- phase C: drain a replica mid-burst; zero in-flight drops
+        drain_rps = args.drain_rps or round(max(4.0, 0.4 * c1), 1)
+        box = {}
+        t = threading.Thread(target=_bench_thread, args=(
+            url, payloads, args.drain_requests, drain_rps,
+            args.seed + 3, box, 'r'))
+        t.start()
+        time.sleep((args.drain_requests / drain_rps) / 3)
+        drained = replicas[0]
+        manager.drain_replica('fleet', drained.replica_id,
+                              reason='bench drain phase')
+        t.join(timeout=300)
+        phase_c = box['r']
+        report['drain'] = phase_c
+        deadline = time.monotonic() + args.drain_grace_s + 10
+        while drained.state != 'stopped' \
+                and time.monotonic() < deadline:
+            time.sleep(0.1)
+        exit_code = drained.poll_exit()
+        report['drain_exit_code'] = exit_code
+        print(f'  drain mid-burst: {drained.replica_id} drained -> '
+              f'exit {exit_code} | burst {phase_c["ok"]}/'
+              f'{args.drain_requests} ok | {phase_c["errors"]} errors',
+              flush=True)
+        if phase_c['errors'] or phase_c['ok'] != args.drain_requests:
+            problems.append(
+                f'drain phase dropped in-flight work: {phase_c["ok"]}/'
+                f'{args.drain_requests} ok, {phase_c["errors"]} errors')
+        if exit_code != 0:
+            problems.append(f'drained replica exit code {exit_code} '
+                            f'(want 0)')
+    finally:
+        if router is not None:
+            router.shutdown()
+        manager.stop(drain=False)
+        sink.emit({'event': 'run_end'})
+        sink.close()
+        if obs.get_sink() is sink:
+            obs.set_sink(None)
+
+    # ---- fleet events: the sink must carry the scaling/lifecycle story
+    events = []
+    for name in sorted(os.listdir(obs_dir)):
+        if name.startswith('events-') and name.endswith('.jsonl'):
+            with open(os.path.join(obs_dir, name)) as f:
+                events += [json.loads(line) for line in f if line.strip()]
+    actions = [e['action'] for e in events if e.get('event') == 'fleet']
+    report['fleet_events'] = {a: actions.count(a) for a in sorted(set(
+        actions))}
+    report['wall_s'] = round(time.perf_counter() - t_start, 1)
+    print(f'  fleet events   : {report["fleet_events"]} '
+          f'(sink {obs_dir})', flush=True)
+    if not any(a in actions for a in ('scale_up', 'scale_down',
+                                      'replica_death')):
+        problems.append('no fleet scale/death event reached the sink')
+    if args.report_json:
+        with open(args.report_json, 'w') as f:
+            json.dump(report, f, indent=2)
+    if args.check:
+        if problems:
+            print('segfleet check FAILED: ' + '; '.join(problems),
+                  file=sys.stderr, flush=True)
+            return 1
+        print(f'segfleet check OK: {args.replicas} replicas | phase A '
+              f'{report["fleet"]["ok"]}/{args.requests} ok at '
+              f'{report["speedup_vs_single"]}x single-replica | kill '
+              f'absorbed {report["kill"]["ok"]}/{args.kill_requests} | '
+              f'drain clean {report["drain"]["ok"]}/'
+              f'{args.drain_requests}, exit 0 | exact /metrics '
+              f'reconciliation | {report["wall_s"]}s', flush=True)
+    return 0
+
+
+# --------------------------------------------------------------------- main
+def _add_engine_args(p) -> None:
+    p.add_argument('--model', default='fastscnn')
+    p.add_argument('--num_class', type=int, default=19)
+    p.add_argument('--compute_dtype', default=None)
+    p.add_argument('--ckpt', default=None)
+    p.add_argument('--buckets', default='512x1024')
+    p.add_argument('--batch', type=int, default=8)
+    p.add_argument('--max-wait-ms', type=float, default=5.0)
+    p.add_argument('--max-queue', type=int, default=128)
+    p.add_argument('--workers', type=int, default=2)
+    p.add_argument('--compile-cache', default=None, metavar='DIR',
+                   help='shared segwarm cache: replica 1 compiles, '
+                        'every later spawn deserializes')
+
+
+def _add_fleet_args(p) -> None:
+    p.add_argument('--host', default='127.0.0.1')
+    p.add_argument('--port', type=int, default=8080)
+    p.add_argument('--policy', default='least-outstanding',
+                   choices=('least-outstanding', 'round-robin'))
+    p.add_argument('--max-outstanding', type=int, default=256,
+                   help='fleet-level admission bound per group')
+    p.add_argument('--run-dir', default=None,
+                   help='port files + per-replica logs land here')
+    p.add_argument('--ready-timeout-s', type=float, default=600.0)
+    p.add_argument('--drain-grace-s', type=float, default=60.0)
+    p.add_argument('--max-restarts', type=int, default=5)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog='segfleet', description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = ap.add_subparsers(dest='cmd', required=True)
+
+    sp = sub.add_parser('serve', help='run the fleet behind one router')
+    _add_engine_args(sp)
+    _add_fleet_args(sp)
+    sp.add_argument('--models', default='seg=fastscnn:1',
+                    help='alias=model:replicas[,alias=model:replicas...]')
+    sp.add_argument('--autoscale', action='store_true')
+    sp.add_argument('--max-replicas', type=int, default=None,
+                    help='autoscale ceiling (default: the --models count)')
+    sp.add_argument('--p99-high-ms', type=float, default=1000.0)
+    sp.add_argument('--p99-low-ms', type=float, default=200.0)
+    sp.add_argument('--queue-high', type=float, default=4.0)
+    sp.add_argument('--cooldown-s', type=float, default=10.0)
+    sp.add_argument('--autoscale-poll-s', type=float, default=2.0)
+    sp.add_argument('--obs-dir', default=None)
+
+    bp = sub.add_parser('bench', help='the fleet e2e gate (see docstring)')
+    _add_engine_args(bp)
+    _add_fleet_args(bp)
+    bp.add_argument('--replicas', type=int, default=2)
+    bp.add_argument('--requests', type=int, default=192,
+                    help='phase A open-loop request count')
+    bp.add_argument('--baseline-requests', type=int, default=128)
+    bp.add_argument('--overload-rps', type=float, default=300.0,
+                    help='baseline saturation rate (capacity probe)')
+    bp.add_argument('--fleet-rps', type=float, default=None,
+                    help='phase A arrival rate (default: '
+                         '--target-speedup x measured single capacity)')
+    bp.add_argument('--target-speedup', type=float, default=1.6)
+    bp.add_argument('--min-speedup', type=float, default=1.5,
+                    help='--check gate on fleet throughput vs one '
+                         'replica')
+    bp.add_argument('--kill-requests', type=int, default=96)
+    bp.add_argument('--kill-rps', type=float, default=None,
+                    help='phase B rate (default: 0.5 x probed capacity)')
+    bp.add_argument('--drain-requests', type=int, default=64)
+    bp.add_argument('--drain-rps', type=float, default=None,
+                    help='phase C rate (default: 0.4 x probed capacity)')
+    bp.add_argument('--p95-ms', type=float, default=5000.0)
+    bp.add_argument('--seed', type=int, default=0)
+    bp.add_argument('--obs-dir', default=None)
+    bp.add_argument('--report-json', default=None, metavar='PATH')
+    bp.add_argument('--check', action='store_true')
+
+    args = ap.parse_args(argv)
+    return cmd_serve(args) if args.cmd == 'serve' else cmd_bench(args)
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -140,10 +140,20 @@ def cmd_serve(args) -> int:
     engine = _build_engine(args, cfg)
     pipeline = _build_pipeline(args, cfg, engine)
     server = make_server(pipeline, host=args.host, port=args.port,
-                         colormap=get_colormap(cfg))
+                         colormap=get_colormap(cfg),
+                         replica_id=args.replica_id)
     host, port = server.server_address[:2]
-    print(f'segserve: {cfg.model} on http://{host}:{port} | buckets '
-          f'{args.buckets} x batch {engine.batch} | POST /predict '
+    if args.port_file:
+        # --port 0 binds an ephemeral port; a fleet manager discovers it
+        # here (write-then-rename so a concurrent reader never sees a
+        # half-written file)
+        tmp = args.port_file + '.tmp'
+        with open(tmp, 'w') as f:
+            f.write(f'{port}\n')
+        os.replace(tmp, args.port_file)
+    rid = f' | replica {args.replica_id}' if args.replica_id else ''
+    print(f'segserve: {cfg.model} on http://{host}:{port}{rid} | buckets '
+          f'{args.buckets} x batch {engine.batch} | POST /predict /drain '
           f'/debug/profile?ms=, GET /healthz /stats /metrics', flush=True)
     try:
         server.serve_forever()
@@ -167,13 +177,18 @@ def cmd_bench(args) -> int:
             'serve': True, 'model': args.model, 'buckets': args.buckets,
             'batch': args.batch, 'rps_target': args.rps})
         obs.set_sink(sink)
+    targets = list(args.urls or [])
     if args.http:
-        # external server: pure urllib client — no local engine and no
-        # model/config machinery; the server's buckets do the fitting
+        targets.append(args.http)
+    if targets:
+        # external server(s): pure urllib client — no local engine and no
+        # model/config machinery; the server's buckets do the fitting.
+        # Several --url targets round-robin client-side (replica list);
+        # one target is a single replica or a segfleet router.
         buckets = parse_buckets(args.buckets)
         images = synth_images(buckets, seed=args.seed)
         payloads = [encode_png(im) for im in images]
-        report = bench_http(args.http, payloads, args.requests, args.rps,
+        report = bench_http(targets, payloads, args.requests, args.rps,
                             seed=args.seed)
         try:
             if args.report_json:
@@ -182,7 +197,10 @@ def cmd_bench(args) -> int:
             print(json.dumps(report, indent=2) if args.json
                   else format_report(report), flush=True)
             if args.check:
-                problems = check_report(report, args.p95_ms)
+                problems = check_report(
+                    report, args.p95_ms,
+                    max_replica_skew=args.max_replica_skew,
+                    expect_replicas=args.expect_replicas)
                 if problems:
                     print('segserve check FAILED: ' + '; '.join(problems),
                           file=sys.stderr)
@@ -262,7 +280,16 @@ def main(argv=None) -> int:
     sp = sub.add_parser('serve', help='run the HTTP serving front-end')
     _add_engine_args(sp)
     sp.add_argument('--host', default='0.0.0.0')
-    sp.add_argument('--port', type=int, default=8080)
+    sp.add_argument('--port', type=int, default=8080,
+                    help='0 binds an ephemeral port (printed, and '
+                         'written to --port-file) — what the segfleet '
+                         'replica manager spawns with')
+    sp.add_argument('--port-file', default=None, metavar='PATH',
+                    help='write the bound port here once listening '
+                         '(atomic rename; fleet/CI port discovery)')
+    sp.add_argument('--replica-id', default=None,
+                    help='identity stamped into every response as '
+                         'X-Replica-Id (per-replica attribution)')
     sp.add_argument('--obs-dir', default=None,
                     help='stream segscope ingress/request/batch events '
                          'here (tail with `segscope.py live`)')
@@ -275,6 +302,17 @@ def main(argv=None) -> int:
     bp.add_argument('--seed', type=int, default=0)
     bp.add_argument('--http', default=None,
                     help='drive an already-running server at this URL')
+    bp.add_argument('--url', action='append', dest='urls', default=None,
+                    metavar='URL',
+                    help='repeatable: drive several already-running '
+                         'replicas round-robin (or point once at a '
+                         'segfleet router); implies HTTP mode')
+    bp.add_argument('--max-replica-skew', type=float, default=None,
+                    help='--check also gates the per-replica balance '
+                         '(report replica_skew <= this)')
+    bp.add_argument('--expect-replicas', type=int, default=None,
+                    help='--check also gates how many distinct '
+                         'X-Replica-Id values served traffic')
     bp.add_argument('--via-http', action='store_true',
                     help='start a localhost server in-process and drive '
                          'it over real HTTP')
