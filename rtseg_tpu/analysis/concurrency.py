@@ -70,7 +70,7 @@ from .walker import dotted_name, index_functions
 TARGET_PREFIXES = (
     'rtseg_tpu/serve/', 'rtseg_tpu/obs/', 'rtseg_tpu/warm/',
     'rtseg_tpu/data/', 'rtseg_tpu/train/checkpoint.py',
-    'rtseg_tpu/native/', 'rtseg_tpu/fleet/',
+    'rtseg_tpu/native/', 'rtseg_tpu/fleet/', 'rtseg_tpu/registry/',
 )
 
 #: constructor names (last dotted segment) that create a lock object;

@@ -15,9 +15,13 @@ Layers (each its own module, composable and separately testable):
     segscope sink for every lifecycle action;
   * :mod:`policy`     — routing policies (least-outstanding default,
     round-robin);
+  * :mod:`split`      — TrafficSplit: the segship versioned target
+    behind one group name (stable arm + weighted sticky-hash canary arm
+    + mirrored shadow arm; rtseg_tpu/registry owns the rollout logic);
   * :mod:`router`     — FleetRouter: spreads ``POST /predict`` across
     ready replicas, fleet-level SLO admission + deadline propagation,
-    one retry on a different replica when one dies mid-request,
+    bounded retries on different replicas when one dies mid-request
+    (and a canary arm that runs dry falls back to stable),
     multi-model tenancy via path or ``X-Model``, aggregate
     ``/stats`` + ``/metrics`` that reconcile exactly with the replica
     scrapes;
@@ -25,8 +29,9 @@ Layers (each its own module, composable and separately testable):
     MetricsPoller frames (obs/live.py) -> pure ``decide()`` ->
     ``FleetManager.scale_to``.
 
-Everything here is host-side pure stdlib — replicas own the jax engines
-in their own processes; the fleet plane never imports jax. The segrace
+Everything here is host-side pure stdlib (plus a lazy numpy import for
+the shadow mirror's vectorized mask compare) — replicas own the jax
+engines in their own processes; the fleet plane never imports jax. The segrace
 ``concurrency`` lint audits this package (analysis/concurrency.py
 TARGET_PREFIXES) and its lock orderings are pinned in SEGRACE.json.
 CLI: ``tools/segfleet.py``.
@@ -39,6 +44,7 @@ from .policy import (POLICIES, LeastOutstanding, RoundRobin,
                      RoutingPolicy, get_policy)
 from .replica import ReplicaProcess
 from .router import MODEL_HEADER, FleetRouter, make_router
+from .split import UNVERSIONED, Arm, TrafficSplit, trace_share
 
 __all__ = [
     'Autoscaler', 'AutoscalePolicy', 'decide', 'serving_signals',
@@ -47,4 +53,5 @@ __all__ = [
     'get_policy',
     'ReplicaProcess',
     'MODEL_HEADER', 'FleetRouter', 'make_router',
+    'UNVERSIONED', 'Arm', 'TrafficSplit', 'trace_share',
 ]

@@ -173,6 +173,54 @@ class FleetManager:
             for r in g.replicas():
                 r.terminate(kill=True)
 
+    # ------------------------------------------------------- version groups
+    def add_group(self, group: ReplicaGroup, start: bool = True,
+                  reason: str = 'rollout') -> ReplicaGroup:
+        """Attach a new replica group at runtime — how segship spins up a
+        canary/shadow version group next to the stable one. The monitor
+        picks it up on its next tick; ``start`` spawns it to its
+        min_replicas immediately."""
+        with self._scale_lock:
+            if group.name in self.groups:
+                raise ValueError(f'group {group.name!r} already exists')
+            self.groups[group.name] = group
+        _emit_fleet('group_added', group.name, reason=reason)
+        if start:
+            self.scale_to(group.name, group.min_replicas, reason=reason)
+        return group
+
+    def remove_group(self, group_name: str, drain: bool = True,
+                     reason: str = 'rollout') -> None:
+        """Detach a replica group — drain (or terminate) its replicas,
+        then drop it from monitoring. The rollback half of a segship
+        canary: the canary group leaves without a client-visible error
+        because the router stopped picking it first."""
+        with self._scale_lock:
+            g = self.groups.pop(group_name, None)
+        if g is None:
+            return
+        victims = []
+        with self._scale_lock:
+            for r in g.ready():
+                self._mark_draining(r)
+                victims.append(r)
+        if drain:
+            for r in victims:
+                self._drain_marked(g, r, reason=reason)
+        # ONE grace window for the whole group (like stop()): N hung
+        # replicas must not serialize into N x drain_grace_s — the
+        # rollout controller blocks on this call
+        deadline = time.monotonic() + self.drain_grace_s
+        for r in g.replicas():
+            if r.state not in ('stopped', 'failed'):
+                if drain and r.state == 'draining':
+                    while r.poll_exit() is None \
+                            and time.monotonic() < deadline:
+                        time.sleep(0.05)
+                r.terminate()
+                r.set_state('stopped')
+        _emit_fleet('group_removed', group_name, reason=reason)
+
     # ------------------------------------------------------------- scaling
     def scale_to(self, group_name: str, n: int, reason: str = '') -> int:
         """Grow (spawn) or shrink (drain youngest-first) ``group_name``
@@ -270,7 +318,8 @@ class FleetManager:
     # ------------------------------------------------------------- monitor
     def _monitor_loop(self) -> None:
         while not self._stop.is_set():
-            for g in self.groups.values():
+            # snapshot: add_group/remove_group mutate the dict mid-run
+            for g in list(self.groups.values()):
                 for r in g.replicas():
                     try:
                         self._tick_replica(g, r)

@@ -8,6 +8,17 @@ on its pipeline Future. What the router adds over one replica:
   * **spreading** — ``POST /predict`` (or ``/predict/<model>``) picks a
     ready replica of the target model's group through a pluggable policy
     (fleet/policy.py; least-outstanding default, round-robin available);
+  * **versioned splitting (segship)** — each group name resolves to a
+    :class:`TrafficSplit` (fleet/split.py): a stable arm, an optional
+    weighted *canary* arm picked by a sticky trace-id hash (the same id
+    always lands on the same artifact version, and the observed share
+    converges to the configured weight), and an optional *shadow* arm
+    that receives mirrored samples of stable traffic — the user response
+    always comes from a serving arm, never the shadow. Every response
+    carries ``X-Artifact-Version``; every counter and latency histogram
+    carries a ``version`` label. A canary arm with no ready replica
+    (draining after a rollback, crashed) falls back to stable, so a
+    rollback is invisible to clients;
   * **fleet-level SLO admission** — a global per-group bound on requests
     in flight through the router (503 ``unroutable`` when exceeded:
     overload surfaces at the front door, not as queue growth inside every
@@ -16,10 +27,12 @@ on its pipeline Future. What the router adds over one replica:
     the replica, which enforces it in its queue — 503/504 semantics are
     the single-replica ones, end to end;
   * **retry on replica death** — a connection-level failure (replica
-    died mid-request) is retried exactly once on a *different* ready
-    replica; /predict is idempotent so the retry is safe. HTTP error
-    answers (503/504/413/...) are passed through verbatim, never
-    retried — the replica already spoke;
+    died mid-request) is retried on a *different* ready replica of the
+    same arm; /predict is idempotent so the retry is safe. A canary arm
+    with nobody left to retry on falls back to the stable arm instead of
+    surfacing a 502 (the answer is then counted under the version that
+    actually served). HTTP error answers (503/504/413/...) are passed
+    through verbatim, never retried — the replica already spoke;
   * **tenancy** — the model name in the path (``/predict/<model>``) or
     the ``X-Model`` header selects the replica group; one router fronts
     several groups;
@@ -30,16 +43,20 @@ on its pipeline Future. What the router adds over one replica:
     response says who actually served it.
 
 Accounting: the router's registry counts ``fleet_requests_total{group,
-status}``. Statuses ``ok``/``rejected``/``dropped``/``error`` mirror a
-replica answer (200/503/504/other) one-to-one, so summing the replica
-scrapes must reconcile *exactly* with the router's totals; router-local
-outcomes get their own statuses (``unroutable`` — no capacity or no
-ready replica, ``expired`` — deadline or router wait budget spent
-before a replica answered (a wait timeout is never retried: the replica
-may still be computing, and re-executing would double the work),
-``unreachable`` — connection failed and the retry budget is gone) so
-they can never blur that reconciliation. ``GET /metrics`` renders it all
-as Prometheus text; ``GET /stats`` is the same registry as JSON plus
+version, status}``. Statuses ``ok``/``rejected``/``dropped``/
+``client_error``/``error`` mirror a replica answer (200/503/504/
+other-4xx/5xx) one-to-one, so summing each
+version's replica scrapes must reconcile *exactly* with the router's
+per-version totals; router-local outcomes get their own statuses
+(``unroutable`` — no capacity or no ready replica, ``expired`` — deadline
+or router wait budget spent before a replica answered (a wait timeout is
+never retried: the replica may still be computing, and re-executing
+would double the work), ``unreachable`` — connection failed and the
+retry budget is gone) so they can never blur that reconciliation.
+Shadow mirrors are accounted separately (``fleet_shadow_total{group,
+result}`` with agree/disagree/error results and their own e2e histogram)
+and never touch ``fleet_requests_total``. ``GET /metrics`` renders it
+all as Prometheus text; ``GET /stats`` is the same registry as JSON plus
 per-replica lifecycle snapshots.
 """
 
@@ -54,23 +71,39 @@ import time
 import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple, Union
 
-from ..obs.metrics import MetricsRegistry, render_prometheus
+from ..obs.metrics import Histogram, MetricsRegistry, render_prometheus
 from ..obs.tracing import (TRACE_HEADER, new_trace_id, valid_trace_id)
-from ..serve.server import DEADLINE_HEADER, REPLICA_HEADER
+from ..serve.server import DEADLINE_HEADER, REPLICA_HEADER, VERSION_HEADER
 from .manager import ReplicaGroup
 from .policy import LeastOutstanding, RoutingPolicy
 from .replica import ReplicaProcess
+from .split import Arm, TrafficSplit
 
 #: request header selecting the model group (the path segment wins)
 MODEL_HEADER = 'X-Model'
 
-#: replica-mirroring statuses (reconcile 1:1 with replica scrapes) ...
-_REPLICA_STATUSES = ('ok', 'rejected', 'dropped', 'error')
+#: replica-mirroring statuses (reconcile 1:1 with replica scrapes).
+#: `client_error` is a replica-spoken 4xx (bad payload, no bucket fits —
+#: the CLIENT's fault): kept apart from `error` (5xx, the VERSION's
+#: fault) so a single malformed request hashing into the canary slice
+#: can never read as a canary regression and trip an auto-rollback.
+_REPLICA_STATUSES = ('ok', 'rejected', 'dropped', 'client_error',
+                     'error')
 #: ... plus router-local outcomes that never reached / never got an
 #: answer from a replica
 _ROUTER_STATUSES = ('unroutable', 'expired', 'unreachable')
+
+#: shadow-compare outcomes (fleet_shadow_total{result}); `skipped` =
+#: sampled but not mirrored because the concurrency cap was full (never
+#: reached the shadow replica, so it stays out of the mirror-vs-replica
+#: reconciliation on both sides)
+_SHADOW_RESULTS = ('agree', 'disagree', 'error', 'skipped')
+
+#: concurrent in-flight shadow mirrors per router — a slow/hung shadow
+#: arm must back up into skipped samples, not into unbounded threads
+_MAX_MIRRORS = 8
 
 #: response headers copied verbatim from the replica to the client
 _PASS_HEADERS = ('X-Serve-Timing', 'X-Mask-Shape', 'X-Mask-Dtype')
@@ -98,8 +131,14 @@ class FleetRouter(ThreadingHTTPServer):
     """The serving fleet's front door."""
 
     daemon_threads = True
+    # socketserver's default listen backlog (5) drops connections under
+    # an open-loop burst before a handler thread ever sees them — the
+    # front door must absorb arrival spikes at the TCP layer and answer
+    # overload with its admission 503, not with connection resets
+    request_queue_size = 128
 
-    def __init__(self, addr, groups: Dict[str, ReplicaGroup],
+    def __init__(self, addr,
+                 groups: Dict[str, Union[ReplicaGroup, TrafficSplit]],
                  default_group: Optional[str] = None,
                  policy: Optional[RoutingPolicy] = None,
                  max_outstanding: int = 64,
@@ -107,7 +146,8 @@ class FleetRouter(ThreadingHTTPServer):
                  request_timeout_s: float = 60.0):
         if not groups:
             raise ValueError('router needs at least one replica group')
-        self.groups = dict(groups)
+        self.groups: Dict[str, TrafficSplit] = {
+            name: TrafficSplit.of(g) for name, g in groups.items()}
         if default_group is None and len(self.groups) == 1:
             default_group = next(iter(self.groups))
         if default_group is not None and default_group not in self.groups:
@@ -119,43 +159,136 @@ class FleetRouter(ThreadingHTTPServer):
         self.request_timeout_s = request_timeout_s
         self.registry = registry if registry is not None \
             else MetricsRegistry()
-        reg = self.registry
-        # metrics are pre-created for the fixed (group, status) grid so
-        # handler threads only ever read this dict (no get-or-create
-        # check-then-act on the hot path)
-        self._c_req = {
-            (g, st): reg.counter(
-                'fleet_requests_total',
-                help='routed requests by terminal status (ok/rejected/'
-                     'dropped/error mirror the replica answer; '
-                     'unroutable/expired/unreachable are router-local)',
-                group=g, status=st)
-            for g in self.groups
-            for st in _REPLICA_STATUSES + _ROUTER_STATUSES}
+        self._lock = threading.Lock()
+        # (group, version, status) -> Counter; (group, version) ->
+        # Histogram. Versions arrive at runtime (configure_canary), so
+        # the maps are get-or-create under the router lock, while the
+        # hot path reads a copy-on-write snapshot (_metrics_view) with
+        # no lock at all — the ensured stable-arm grid below means a
+        # request almost never sees a miss, and zero-valued statuses
+        # stay visible to scrapes from the first request on.
+        self._c_req: Dict[Tuple[str, str, str], object] = {}
+        self._h_e2e: Dict[Tuple[str, str], Histogram] = {}
+        # lock-free read snapshot over all three maps, keyed by tagged
+        # tuples; replaced wholesale (never mutated) under _lock
+        self._metrics_view: Dict[Tuple, object] = {}
         self._c_retry = {
-            g: reg.counter('fleet_retries_total',
-                           help='requests retried on a different replica '
-                                'after a connection-level failure',
-                           group=g)
-            for g in self.groups}
-        self._h_e2e = {
-            g: reg.histogram('fleet_e2e_ms',
-                             help='router-side end-to-end latency (ms)',
-                             group=g)
+            g: self.registry.counter(
+                'fleet_retries_total',
+                help='requests retried on a different replica after a '
+                     'connection-level failure', group=g)
             for g in self.groups}
         self._g_out = {
-            g: reg.gauge('fleet_outstanding',
-                         help='requests in flight through the router',
-                         group=g)
+            g: self.registry.gauge('fleet_outstanding',
+                                   help='requests in flight through the '
+                                        'router', group=g)
             for g in self.groups}
         self._g_ready = {
-            g: reg.gauge('fleet_ready_replicas',
-                         help='replicas in the ready state', group=g)
+            g: self.registry.gauge('fleet_ready_replicas',
+                                   help='replicas in the ready state '
+                                        '(serving arms)', group=g)
             for g in self.groups}
-        self._lock = threading.Lock()
+        self._c_shadow: Dict[Tuple[str, str], object] = {}
+        self._h_shadow = {
+            g: self.registry.histogram(
+                'fleet_shadow_e2e_ms',
+                help='shadow-arm end-to-end latency (ms, mirrored '
+                     'samples)', group=g)
+            for g in self.groups}
+        self._g_shadow_agree = {
+            g: self.registry.gauge(
+                'fleet_shadow_agree_frac',
+                help='byte-agreement fraction of the last mirrored '
+                     'raw compare (1.0 = bit-identical masks)', group=g)
+            for g in self.groups}
+        for g, split in self.groups.items():
+            self.ensure_version(g, split.stable_arm().version)
+        self._mirror_slots = threading.BoundedSemaphore(_MAX_MIRRORS)
         self._out_group: Dict[str, int] = {g: 0 for g in self.groups}
         self._out_replica: Dict[str, int] = {}
         super().__init__(addr, _RouterHandler)
+
+    # ------------------------------------------------ versioned metrics
+    def ensure_version(self, group: str, version: str) -> None:
+        """Pre-create the (group, version) counter grid + histogram so a
+        scrape sees every status at zero from the moment an arm exists."""
+        for st in _REPLICA_STATUSES + _ROUTER_STATUSES:
+            self._counter(group, version, st)
+        self._hist(group, version)
+
+    def _counter(self, group: str, version: str, status: str):
+        m = self._metrics_view.get(('req', group, version, status))
+        return m if m is not None \
+            else self._create_metric(('req', group, version, status))
+
+    def _hist(self, group: str, version: str) -> Histogram:
+        m = self._metrics_view.get(('e2e', group, version))
+        return m if m is not None \
+            else self._create_metric(('e2e', group, version))
+
+    def _shadow_counter(self, group: str, result: str):
+        m = self._metrics_view.get(('shadow', group, result))
+        return m if m is not None \
+            else self._create_metric(('shadow', group, result))
+
+    def _create_metric(self, key: Tuple):
+        """The miss path: create (or find) the metric under the router
+        lock and publish a REPLACED snapshot dict — readers keep their
+        lock-free path, and ensure_version pre-warms the grid so a
+        request only lands here when a brand-new arm appears."""
+        with self._lock:
+            if key[0] == 'req':
+                _, group, version, status = key
+                m = self._c_req.get((group, version, status))
+                if m is None:
+                    m = self.registry.counter(
+                        'fleet_requests_total',
+                        help='routed requests by artifact version and '
+                             'terminal status (ok/rejected/dropped/'
+                             'client_error/error mirror the replica '
+                             'answer; unroutable/expired/unreachable '
+                             'are router-local)',
+                        group=group, version=version, status=status)
+                    self._c_req[(group, version, status)] = m
+            elif key[0] == 'e2e':
+                _, group, version = key
+                m = self._h_e2e.get((group, version))
+                if m is None:
+                    m = self.registry.histogram(
+                        'fleet_e2e_ms',
+                        help='router-side end-to-end latency (ms) by '
+                             'artifact version',
+                        group=group, version=version)
+                    self._h_e2e[(group, version)] = m
+            else:
+                _, group, result = key
+                m = self._c_shadow.get((group, result))
+                if m is None:
+                    m = self.registry.counter(
+                        'fleet_shadow_total',
+                        help='mirrored shadow requests by compare '
+                             'result (never part of '
+                             'fleet_requests_total)',
+                        group=group, result=result)
+                    self._c_shadow[(group, result)] = m
+            view = dict(self._metrics_view)
+            view[key] = m
+            self._metrics_view = view
+        return m
+
+    # --------------------------------------------------- split plumbing
+    def configure_canary(self, group: str, canary: ReplicaGroup,
+                         version: str, weight: float) -> None:
+        """Attach a canary arm and pre-create its metric grid (off the
+        hot path, so request handlers only ever look metrics up)."""
+        self.groups[group].set_canary(canary, version, weight)
+        self.ensure_version(group, version)
+
+    def configure_shadow(self, group: str, shadow: ReplicaGroup,
+                         version: str, sample: float) -> None:
+        self.groups[group].set_shadow(shadow, version, sample)
+        for res in _SHADOW_RESULTS:
+            self._shadow_counter(group, res)
 
     # -------------------------------------------------- outstanding ledger
     def try_admit(self, group: str) -> bool:
@@ -174,12 +307,12 @@ class FleetRouter(ThreadingHTTPServer):
             out = self._out_group[group]
         self._g_out[group].set(out)
 
-    def candidates(self, group: str,
+    def candidates(self, rg: ReplicaGroup,
                    exclude: Tuple[str, ...] = ()
                    ) -> List[Tuple[ReplicaProcess, int]]:
-        """(replica, outstanding) for every ready replica not excluded."""
-        ready = [r for r in self.groups[group].ready()
-                 if r.replica_id not in exclude]
+        """(replica, outstanding) for every ready replica of one arm's
+        group, minus the excluded ids."""
+        ready = [r for r in rg.ready() if r.replica_id not in exclude]
         with self._lock:
             return [(r, self._out_replica.get(r.replica_id, 0))
                     for r in ready]
@@ -195,33 +328,142 @@ class FleetRouter(ThreadingHTTPServer):
                 self._out_replica.get(replica_id, 0) - 1
 
     # ------------------------------------------------------------- metrics
-    def count(self, group: str, status: str) -> None:
-        self._c_req[(group, status)].inc()
+    def count(self, group: str, version: str, status: str) -> None:
+        self._counter(group, version, status).inc()
 
     def refresh_gauges(self) -> None:
-        for g, grp in self.groups.items():
-            self._g_ready[g].set(len(grp.ready()))
+        for g, split in self.groups.items():
+            self._g_ready[g].set(len(split.ready()))
+
+    def version_stats(self, group: str) -> Dict[str, Dict[str, object]]:
+        """Per-version request totals + windowed p99 — the observation
+        the rollout controller's pure decide() consumes. The 'shadow'
+        entry (present once mirrors ran) carries the compare results."""
+        with self._lock:
+            versions = sorted({v for (g, v) in self._h_e2e if g == group})
+        out: Dict[str, Dict[str, object]] = {}
+        for v in versions:
+            h = self._hist(group, v)
+            out[v] = {
+                **{st: self._counter(group, v, st).value
+                   for st in _REPLICA_STATUSES + _ROUTER_STATUSES},
+                'p99_ms': h.quantiles().get(0.99),
+                'count': h.count,
+            }
+        shadow = {res: self._shadow_counter(group, res).value
+                  for res in _SHADOW_RESULTS}
+        if sum(shadow.values()):
+            shadow['p99_ms'] = \
+                self._h_shadow[group].quantiles().get(0.99)
+            shadow['agree_frac'] = self._g_shadow_agree[group].value
+            out['shadow'] = shadow
+        return out
 
     def stats(self) -> dict:
         self.refresh_gauges()
         out = {'policy': self.policy.name,
                'max_outstanding': self.max_outstanding,
                'groups': {}}
-        for g, grp in self.groups.items():
+        for g, split in self.groups.items():
             with self._lock:
                 outstanding = self._out_group[g]
+                per_version = {}
+                for (gg, v, st), c in self._c_req.items():
+                    if gg == g:
+                        per_version.setdefault(v, {})[st] = c.value
+            requests = {st: sum(vs.get(st, 0)
+                                for vs in per_version.values())
+                        for st in _REPLICA_STATUSES + _ROUTER_STATUSES}
             out['groups'][g] = {
-                **grp.stats(),
+                **split.stats(),
                 'outstanding': outstanding,
-                'requests': {st: self._c_req[(g, st)].value
-                             for st in (_REPLICA_STATUSES
-                                        + _ROUTER_STATUSES)},
+                'requests': requests,
+                'by_version': per_version,
                 'retries': self._c_retry[g].value,
-                'e2e_ms': {'count': self._h_e2e[g].count,
-                           **{f'p{int(q * 100)}': v for q, v in
-                              self._h_e2e[g].quantiles().items()}},
+                'e2e_ms': self._group_e2e(g),
             }
         return out
+
+    def _group_e2e(self, group: str) -> dict:
+        """Cross-version e2e summary: counts sum; percentiles come from
+        the merged sliding windows (raw values, so merging is sound)."""
+        with self._lock:
+            hists = [h for (g, _), h in self._h_e2e.items()
+                     if g == group]
+        vals: List[float] = []
+        count = 0
+        for h in hists:
+            snap = h.snapshot()
+            count += snap['count']
+            vals.extend(snap['window'])
+        vals.sort()
+
+        def _pct(q: float) -> Optional[float]:
+            if not vals:
+                return None
+            return vals[min(len(vals) - 1,
+                            max(0, round(q * (len(vals) - 1))))]
+
+        return {'count': count, 'p50': _pct(0.5), 'p95': _pct(0.95),
+                'p99': _pct(0.99)}
+
+    # ---------------------------------------------------------- shadowing
+    def mirror_async(self, group: str, arm: Arm, data: bytes, query: str,
+                     headers: Dict[str, str], stable_code: int,
+                     stable_body: bytes, raw: bool) -> None:
+        """Fire one mirrored request at the shadow arm on a daemon
+        thread (sampled traffic only — fleet/split.py mirror()); the
+        user already has the stable answer in hand. In-flight mirrors
+        are capped: a slow shadow arm turns excess samples into
+        ``skipped`` counts instead of an unbounded thread pile-up."""
+        if not self._mirror_slots.acquire(blocking=False):
+            self._shadow_counter(group, 'skipped').inc()
+            return
+        threading.Thread(
+            target=self._mirror_one,
+            args=(group, arm, data, query, headers, stable_code,
+                  stable_body, raw),
+            daemon=True, name='segship-shadow').start()
+
+    def _mirror_one(self, group: str, arm: Arm, data: bytes, query: str,
+                    headers: Dict[str, str], stable_code: int,
+                    stable_body: bytes, raw: bool) -> None:
+        try:
+            ready = arm.group.ready()
+            if not ready or ready[0].url is None:
+                self._shadow_counter(group, 'error').inc()
+                return
+            url = ready[0].url
+            t0 = time.perf_counter()
+            try:
+                code, body, _ = _forward(
+                    url + '/predict' + (f'?{query}' if query else ''),
+                    data, headers, self.request_timeout_s)
+            except Exception:   # noqa: BLE001 — a mirror never raises
+                #                 into the serving path; it is its own
+                #                 experiment
+                self._shadow_counter(group, 'error').inc()
+                return
+            self._h_shadow[group].observe(
+                (time.perf_counter() - t0) * 1e3)
+            if code != 200 or stable_code != 200:
+                self._shadow_counter(group, 'error').inc()
+                return
+            if raw and len(body) == len(stable_body) and len(body) > 0:
+                # raw masks are int8 argmax per pixel: byte-agreement IS
+                # argmax-agreement. Record the fraction (vectorized — a
+                # 512x1024 mask is half a megabyte, a Python byte loop
+                # here would stall the serving handlers), gate on
+                # equality.
+                import numpy as np
+                same = (np.frombuffer(body, np.uint8)
+                        == np.frombuffer(stable_body, np.uint8)).mean()
+                self._g_shadow_agree[group].set(float(same))
+            agree = body == stable_body
+            self._shadow_counter(
+                group, 'agree' if agree else 'disagree').inc()
+        finally:
+            self._mirror_slots.release()
 
 
 def _forward(url: str, data: bytes, headers: Dict[str, str],
@@ -265,9 +507,10 @@ class _RouterHandler(BaseHTTPRequestHandler):
     def do_GET(self) -> None:   # noqa: N802 — http.server API
         path = self.path.split('?', 1)[0]
         if path == '/healthz':
-            groups = {g: {'ready': len(grp.ready()),
-                          'replicas': len(grp.replicas())}
-                      for g, grp in self.server.groups.items()}
+            groups = {g: {'ready': len(split.ready()),
+                          'replicas': len(split.replicas()),
+                          'versions': split.versions()}
+                      for g, split in self.server.groups.items()}
             ok = all(v['ready'] > 0 for v in groups.values())
             self._send_json(200 if ok else 503,
                             {'ok': ok, 'role': 'router',
@@ -319,7 +562,9 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 return
             deadline_at = time.perf_counter() + budget_ms / 1e3
         if not self.server.try_admit(group):
-            self.server.count(group, 'unroutable')
+            split = self.server.groups[group]
+            self.server.count(group, split.stable_arm().version,
+                              'unroutable')
             self._send_json(503, {'error': f'fleet queue full '
                                            f'(group {group})'},
                             trace_hdr)
@@ -343,21 +588,68 @@ class _RouterHandler(BaseHTTPRequestHandler):
 
     def _route(self, group: str, data: bytes, query: str, tid: str,
                trace_hdr: dict, deadline_at: Optional[float]) -> None:
-        """Pick -> forward -> answer, with one retry on a different
-        replica when the connection to the first one died."""
+        """Pick an arm (sticky by trace hash) -> pick a replica ->
+        forward -> answer, with retries on a different replica when the
+        connection died. A canary pick carries the stable arm as its
+        fallback: whether the canary runs out of ready replicas (drained
+        by a rollback, crashed) or burns its whole retry budget, the
+        request is still answered by stable — a rollback must never cost
+        a client an error. The answer counts under the version that
+        actually served it."""
         srv = self.server
+        split = srv.groups[group]
+        first = split.pick(tid)
+        arm_chain = [first] if first.name == 'stable' \
+            else [first, split.stable_arm()]
         t0 = time.perf_counter()
+        tried_any = False
+        arm = first
+        for arm in arm_chain:
+            sent, tried = self._route_arm(group, arm, data, query,
+                                          trace_hdr, deadline_at, t0,
+                                          first_arm=not tried_any)
+            if sent:
+                return
+            tried_any = tried_any or tried
+        # nothing answered: 503 when no replica was ever reachable to
+        # try, 502 when we tried and the retry budget is spent; either
+        # way counted under the last arm attempted (stable, for a
+        # canary chain)
+        if tried_any:
+            srv.count(group, arm.version, 'unreachable')
+            self._send_json(502, {'error': 'replica connection failed '
+                                           'and the retry budget is '
+                                           'spent'}, trace_hdr)
+        else:
+            srv.count(group, arm.version, 'unroutable')
+            self._send_json(503, {'error': f'no ready replicas in '
+                                           f'group {group}'}, trace_hdr)
+
+    def _route_arm(self, group: str, arm: Arm, data: bytes, query: str,
+                   trace_hdr: dict, deadline_at: Optional[float],
+                   t0: float, first_arm: bool) -> Tuple[bool, bool]:
+        """Try to answer from one arm, retrying on a different replica
+        of the same arm when a connection dies. Returns (sent,
+        tried_any): ``sent`` True when a response went out (ok, error
+        passthrough, expired — anything); ``tried_any`` True when at
+        least one forward was attempted (distinguishes the caller's 502
+        from its 503)."""
+        srv = self.server
+        split = srv.groups[group]
+        tid = trace_hdr[TRACE_HEADER]
         tried: Tuple[str, ...] = ()
-        for attempt in (0, 1):
-            cands = srv.candidates(group, exclude=tried)
+        attempts = 0
+
+        def note_retry():
+            # the retry counter records requests that needed a second
+            # replica — once per request, on its first failure
+            if first_arm and attempts == 1:
+                srv._c_retry[group].inc()
+
+        while attempts < 4:
+            cands = srv.candidates(arm.group, exclude=tried)
             if not cands:
-                if attempt == 0:
-                    srv.count(group, 'unroutable')
-                    self._send_json(503, {'error': f'no ready replicas '
-                                                   f'in group {group}'},
-                                    trace_hdr)
-                    return
-                break   # first replica died, nobody left to retry on
+                return False, bool(tried)
             rid = srv.policy.choose([(r.replica_id, out)
                                      for r, out in cands])
             replica = next(r for r, _ in cands if r.replica_id == rid)
@@ -366,17 +658,18 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 # restart raced the snapshot: its port is gone; treat as
                 # a dead connection and move on
                 tried = tried + (rid,)
+                attempts += 1
                 continue
             timeout_s = srv.request_timeout_s
             fwd_headers = dict(trace_hdr)
             if deadline_at is not None:
                 remaining_ms = (deadline_at - time.perf_counter()) * 1e3
                 if remaining_ms <= 0:
-                    srv.count(group, 'expired')
+                    srv.count(group, arm.version, 'expired')
                     self._send_json(504, {'error': 'deadline spent '
                                                    'inside the fleet'},
                                     trace_hdr)
-                    return
+                    return True, True
                 fwd_headers[DEADLINE_HEADER] = f'{remaining_ms:.3f}'
                 timeout_s = min(timeout_s, remaining_ms / 1e3 + 5.0)
             ctype = self.headers.get('Content-Type')
@@ -393,13 +686,13 @@ class _RouterHandler(BaseHTTPRequestHandler):
                     # re-execute it elsewhere (double compute, and the
                     # late replica-side ok would break the exact
                     # router-vs-replica reconciliation contract)
-                    srv.count(group, 'expired')
+                    srv.count(group, arm.version, 'expired')
                     self._send_json(504, {'error': 'replica wait timed '
                                                    'out'}, trace_hdr)
-                    return
+                    return True, True
                 tried = tried + (rid,)
-                if attempt == 0:
-                    srv._c_retry[group].inc()
+                attempts += 1
+                note_retry()
                 continue
             finally:
                 srv.note_done(rid)
@@ -411,30 +704,48 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 # so re-picking keeps the reconciliation exact AND the
                 # zero-drops-during-drain guarantee
                 tried = tried + (rid,)
-                if attempt == 0:
-                    srv._c_retry[group].inc()
+                attempts += 1
+                note_retry()
                 continue
             status = {200: 'ok', 503: 'rejected', 504: 'dropped'}.get(
-                code, 'error')
-            srv.count(group, status)
+                code, 'client_error' if 400 <= code < 500 else 'error')
+            srv.count(group, arm.version, status)
             if status == 'ok':
-                srv._h_e2e[group].observe(
+                srv._hist(group, arm.version).observe(
                     (time.perf_counter() - t0) * 1e3)
-            extra = {REPLICA_HEADER: rid, **trace_hdr}
+            extra = {REPLICA_HEADER: rid,
+                     VERSION_HEADER: headers.get(VERSION_HEADER,
+                                                 arm.version),
+                     **trace_hdr}
             for h in _PASS_HEADERS:
                 if headers.get(h):
                     extra[h] = headers[h]
             self._send(code, body,
                        headers.get('Content-Type', 'application/json'),
                        extra)
-            return
-        srv.count(group, 'unreachable')
-        self._send_json(502, {'error': 'replica connection failed and '
-                                       'the one-retry budget is spent'},
-                        trace_hdr)
+            if status == 'ok' and arm.name == 'stable':
+                # shadow compare: mirror a sample of *stable* traffic
+                # (comparing the new version against the answers users
+                # actually got); canary-served requests are already the
+                # new version
+                mirror = split.mirror(tid)
+                if mirror is not None:
+                    raw = 'raw=1' in query
+                    # the mirror keeps the trace id (one id spans the
+                    # stable answer AND its shadow compare) but not the
+                    # client's remaining deadline — an expired budget
+                    # would 504 the mirror and masquerade as a shadow
+                    # error when the question is output agreement
+                    mh = {k: v for k, v in fwd_headers.items()
+                          if k != DEADLINE_HEADER}
+                    srv.mirror_async(group, mirror, data, query, mh,
+                                     code, body, raw)
+            return True, True
+        return False, True
 
 
-def make_router(groups: Dict[str, ReplicaGroup], host: str = '127.0.0.1',
+def make_router(groups: Dict[str, Union[ReplicaGroup, TrafficSplit]],
+                host: str = '127.0.0.1',
                 port: int = 0, **kwargs) -> FleetRouter:
     """Bind the front door (port 0 picks a free one; read
     ``router.server_address``). Call ``serve_forever()`` on a thread,

@@ -1,0 +1,229 @@
+"""Versioned traffic split: the routing target behind one tenancy name.
+
+segship (rtseg_tpu/registry) teaches the fleet to hold two artifact
+versions of one model at once. The router used to map a group name to a
+single :class:`ReplicaGroup`; it now maps it to a :class:`TrafficSplit` —
+one *stable* arm that always exists, plus an optional *canary* arm
+(weighted share of live traffic) and an optional *shadow* arm (mirrored
+samples, user responses never come from it). A bare ReplicaGroup wraps
+into a degenerate single-arm split (:meth:`TrafficSplit.of`), so every
+pre-segship call site keeps working unchanged.
+
+Splitting is **sticky and reproducible**: the arm is a pure function of
+the request's trace id (:func:`trace_share` — the first 8 hex chars of
+``sha256(trace_id)`` mapped to [0, 1)), so a given id always lands on the
+same arm, a replayed id reproduces its routing decision exactly, and the
+observed canary share converges to the configured weight without any
+shared mutable cursor on the hot path. Shadow sampling draws from the
+*complementary* end of the same hash, so a request can be canary-routed
+or shadow-mirrored but the two decisions stay independent of each other's
+thresholds.
+
+Arm changes (set/clear/promote) are serialized by the split's lock and
+swap one immutable :class:`Arm` tuple at a time; the router reads a
+consistent arm snapshot per request and never holds the lock across I/O.
+Pure stdlib, host-side only (segrace's ``concurrency`` lint audits this
+module; the lock order is pinned in SEGRACE.json).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Dict, List, NamedTuple, Optional, Union
+
+from .manager import ReplicaGroup
+
+#: version label stamped when a replica group carries no artifact version
+#: (pre-segship fleets, bare load-gen groups)
+UNVERSIONED = 'unversioned'
+
+
+def trace_share(trace_id: str) -> float:
+    """Deterministic [0, 1) share for one trace id: first 8 hex chars of
+    sha256 over the id. Pure — two processes (the router and a replayed
+    CI gate) always agree on where an id lands."""
+    h = hashlib.sha256(trace_id.encode()).hexdigest()
+    return int(h[:8], 16) / float(0x100000000)
+
+
+class Arm(NamedTuple):
+    """One routing target: which replicas, published as which version."""
+    name: str                    # 'stable' | 'canary' | 'shadow'
+    group: ReplicaGroup
+    version: str
+
+
+class TrafficSplit:
+    """Stable + optional canary/shadow arms behind one group name."""
+
+    def __init__(self, stable: ReplicaGroup,
+                 stable_version: Optional[str] = None):
+        self.name = stable.name
+        self._lock = threading.Lock()
+        self._stable = Arm('stable', stable, stable_version or UNVERSIONED)
+        self._canary: Optional[Arm] = None
+        self._weight = 0.0
+        self._shadow: Optional[Arm] = None
+        self._sample = 0.0
+
+    @classmethod
+    def of(cls, target: Union[ReplicaGroup, 'TrafficSplit'],
+           ) -> 'TrafficSplit':
+        """Normalize a router target: a bare ReplicaGroup becomes a
+        degenerate single-arm split, a split passes through."""
+        return target if isinstance(target, TrafficSplit) else cls(target)
+
+    # ------------------------------------------------------------- arms
+    def stable_arm(self) -> Arm:
+        with self._lock:
+            return self._stable
+
+    def canary_arm(self) -> Optional[Arm]:
+        with self._lock:
+            return self._canary
+
+    def shadow_arm(self) -> Optional[Arm]:
+        with self._lock:
+            return self._shadow
+
+    def versions(self) -> List[str]:
+        """Serving-arm versions (stable first; shadow excluded — it never
+        answers users)."""
+        with self._lock:
+            out = [self._stable.version]
+            if self._canary is not None:
+                out.append(self._canary.version)
+            return out
+
+    def set_canary(self, group: ReplicaGroup, version: str,
+                   weight: float) -> Arm:
+        if not 0.0 <= weight <= 1.0:
+            raise ValueError(f'canary weight must be in [0, 1], '
+                             f'got {weight}')
+        arm = Arm('canary', group, version)
+        with self._lock:
+            self._canary = arm
+            self._weight = float(weight)
+        return arm
+
+    def set_weight(self, weight: float) -> None:
+        if not 0.0 <= weight <= 1.0:
+            raise ValueError(f'canary weight must be in [0, 1], '
+                             f'got {weight}')
+        with self._lock:
+            if self._canary is None:
+                raise ValueError('no canary arm to weight')
+            self._weight = float(weight)
+
+    def clear_canary(self) -> Optional[Arm]:
+        """Rollback: stop routing to the canary arm. Returns the removed
+        arm (the caller drains its replicas)."""
+        with self._lock:
+            arm, self._canary, self._weight = self._canary, None, 0.0
+            return arm
+
+    def promote_canary(self) -> Arm:
+        """The canary arm becomes the stable arm (the registry channel
+        pointer flip is the store's job — registry/store.py). Returns the
+        *previous* stable arm so the caller can drain it."""
+        with self._lock:
+            if self._canary is None:
+                raise ValueError('no canary arm to promote')
+            prev = self._stable
+            self._stable = Arm('stable', self._canary.group,
+                               self._canary.version)
+            self._canary, self._weight = None, 0.0
+            return prev
+
+    def set_shadow(self, group: ReplicaGroup, version: str,
+                   sample: float) -> Arm:
+        if not 0.0 <= sample <= 1.0:
+            raise ValueError(f'shadow sample must be in [0, 1], '
+                             f'got {sample}')
+        arm = Arm('shadow', group, version)
+        with self._lock:
+            self._shadow = arm
+            self._sample = float(sample)
+        return arm
+
+    def clear_shadow(self) -> Optional[Arm]:
+        with self._lock:
+            arm, self._shadow, self._sample = self._shadow, None, 0.0
+            return arm
+
+    @property
+    def canary_weight(self) -> float:
+        with self._lock:
+            return self._weight
+
+    @property
+    def shadow_sample(self) -> float:
+        with self._lock:
+            return self._sample
+
+    # --------------------------------------------------------- decisions
+    def pick(self, trace_id: str) -> Arm:
+        """The serving arm for one request — sticky by trace-id hash.
+        The canary arm only receives traffic while it has a ready
+        replica: a draining/dead canary falls back to stable instead of
+        surfacing errors for its hash slice."""
+        with self._lock:
+            canary, weight, stable = self._canary, self._weight, \
+                self._stable
+        if canary is not None and weight > 0.0 \
+                and trace_share(trace_id) < weight \
+                and canary.group.ready():
+            return canary
+        return stable
+
+    def mirror(self, trace_id: str) -> Optional[Arm]:
+        """The shadow arm when this request is sampled for mirroring
+        (None otherwise). Samples from the top of the hash range so the
+        mirror decision is independent of the canary threshold at the
+        bottom."""
+        with self._lock:
+            shadow, sample = self._shadow, self._sample
+        if shadow is None or sample <= 0.0:
+            return None
+        if trace_share(trace_id) >= 1.0 - sample and shadow.group.ready():
+            return shadow
+        return None
+
+    # ------------------------------------- ReplicaGroup-compatible views
+    def ready(self) -> List:
+        """Ready replicas across the serving arms (stable + canary) —
+        what the router's /healthz and gauge refresh count."""
+        with self._lock:
+            arms = [self._stable] + ([self._canary] if self._canary
+                                     else [])
+        out = []
+        for arm in arms:
+            out.extend(arm.group.ready())
+        return out
+
+    def replicas(self) -> List:
+        with self._lock:
+            arms = [a for a in (self._stable, self._canary, self._shadow)
+                    if a is not None]
+        out = []
+        for arm in arms:
+            out.extend(arm.group.replicas())
+        return out
+
+    def stats(self) -> dict:
+        with self._lock:
+            stable, canary, weight = self._stable, self._canary, \
+                self._weight
+            shadow, sample = self._shadow, self._sample
+        out = {
+            **stable.group.stats(),
+            'stable_version': stable.version,
+        }
+        if canary is not None:
+            out['canary'] = {'version': canary.version, 'weight': weight,
+                             **canary.group.stats()}
+        if shadow is not None:
+            out['shadow'] = {'version': shadow.version, 'sample': sample,
+                             **shadow.group.stats()}
+        return out

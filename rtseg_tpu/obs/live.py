@@ -78,6 +78,26 @@ def _family_sum(parsed: Dict, name: str) -> float:
     return sum(v for _, v in parsed.get(name, ()))
 
 
+def scrape_counter_sum(urls, family: str, timeout_s: float = 10.0,
+                       **labels: str) -> int:
+    """Scrape ``<url>/metrics`` for each url and sum one counter family
+    across them, keeping only series whose labels match ``labels`` —
+    the replica-side leg of the router-vs-replica reconciliation gates
+    (tools/segfleet.py, tools/segship.py share this one implementation
+    so the two CLIs' gates cannot drift)."""
+    total = 0
+    for url in ([urls] if isinstance(urls, str) else urls):
+        if url is None:
+            continue
+        with urllib.request.urlopen(url.rstrip('/') + '/metrics',
+                                    timeout=timeout_s) as resp:
+            parsed = parse_prometheus(resp.read().decode())
+        total += int(sum(
+            v for lab, v in parsed.get(family, ())
+            if all(lab.get(k) == want for k, want in labels.items())))
+    return total
+
+
 class MetricsPoller:
     """Scrape ``<url>/metrics`` and derive the live frame; counter deltas
     between consecutive polls become rates."""
@@ -212,6 +232,9 @@ class SinkTailer:
         # segprof: last non-retraced profile capture + peak HBM seen
         self._busy_frac: Optional[float] = None
         self._peak_hbm: Optional[float] = None
+        # segship: rollout transition tally + the latest one seen
+        self._rollout_actions: Dict[str, int] = {}
+        self._rollout_last: Optional[Dict[str, Any]] = None
 
     def _paths(self) -> List[str]:
         if self.files is not None:
@@ -274,6 +297,13 @@ class SinkTailer:
                 if isinstance(peak, (int, float)):
                     self._peak_hbm = max(self._peak_hbm or 0.0,
                                          float(peak))
+            elif kind == 'rollout':
+                a = e.get('action', '?')
+                self._rollout_actions[a] = \
+                    self._rollout_actions.get(a, 0) + 1
+                self._rollout_last = {
+                    'action': a, 'version': e.get('version'),
+                    'reason': e.get('reason')}
         cutoff = now_ts - self.window_s
         self._recent = [e for e in self._recent
                         if e.get('ts', now_ts) >= cutoff]
@@ -296,6 +326,9 @@ class SinkTailer:
             'source': self.dir or self.files[0], 'mode': 'sink',
             'run': self.run_meta, 'stalls': self.totals['stalls'],
             'serving': None, 'train': None, 'device': None,
+            'rollout': ({'actions': dict(self._rollout_actions),
+                         'last': self._rollout_last}
+                        if self._rollout_actions else None),
         }
         if self._busy_frac is not None or self._peak_hbm is not None:
             frame['device'] = {
@@ -370,6 +403,13 @@ def format_frame(frame: Dict[str, Any]) -> str:
         if tr.get('goodput') is not None:
             lines.append(f'  goodput        : '
                          f'{100 * tr["goodput"]:.1f}%')
+    ro = frame.get('rollout')
+    if ro:
+        acts = ' | '.join(f'{a} x{n}'
+                          for a, n in sorted(ro['actions'].items()))
+        last = ro.get('last') or {}
+        lines.append(f'  rollout        : {acts} — last '
+                     f'{last.get("action")} {last.get("version")}')
     dv = frame.get('device')
     if dv:
         busy = (f'{100 * dv["busy_frac"]:.1f}%'

@@ -265,6 +265,22 @@ def summarize(events: List[dict],
                           else 0.0),
         }
 
+    # segship: rollout transitions (registry/rollout.py emit_rollout) —
+    # the deploy story next to the run it happened during. Counts come
+    # from every host (one rollout spans router + controller processes).
+    rollouts = [e for e in events if e.get('event') == 'rollout']
+    rollout: Optional[Dict[str, Any]] = None
+    if rollouts:
+        acts = [e.get('action', '?') for e in rollouts]
+        last = rollouts[-1]
+        rollout = {
+            'events': len(rollouts),
+            'actions': {a: acts.count(a) for a in sorted(set(acts))},
+            'last_action': last.get('action'),
+            'last_version': last.get('version'),
+            'last_reason': last.get('reason'),
+        }
+
     spans: Dict[str, Dict[str, float]] = {}
     for e in events:
         if e.get('event') != 'span' or not mine(e):
@@ -344,6 +360,7 @@ def summarize(events: List[dict],
         'epochs': len([e for e in events if e.get('event') == 'epoch'
                        and e.get('kind') == 'train' and mine(e)]),
         'serving': serving,
+        'rollout': rollout,
         # flattened for diff_table's flat-key rows
         'serve_p99_ms': serving['e2e_p99_ms'] if serving else None,
         'serve_rps': serving['rps'] if serving else None,
@@ -422,6 +439,14 @@ def format_summary(s: Dict[str, Any], path: str = '') -> str:
                 f'  batching       : {sv["batches"]} batches | mean size '
                 f'{sv["mean_batch"]:.1f} | occupancy '
                 f'{100 * sv["occupancy"]:.0f}%')
+    if s.get('rollout'):
+        ro = s['rollout']
+        acts = ' | '.join(f'{a} x{n}' for a, n in ro['actions'].items())
+        lines.append(
+            f'  rollout        : {acts} — last {ro["last_action"]} '
+            f'{ro["last_version"]}'
+            + (f' ({ro["last_reason"]})' if ro.get('last_reason')
+               else ''))
     if s.get('device'):
         dv = s['device']
         per_iter = (f' | {dv["ms_per_iter"]:.1f} device-ms/iter'

@@ -1,0 +1,51 @@
+"""segship — the versioned artifact registry + rollout plane.
+
+Before this package, a deploy was "point segserve at a ckpt or StableHLO
+file": no versioned unit, no way for the fleet to hold two model
+versions at once, no safe path from "new weights" to "serving everyone".
+segship closes that loop:
+
+  * :mod:`bundle`  — ArtifactBundle: one ``segship bake`` produces a
+    content-hashed, self-describing deploy unit (per-bucket StableHLO
+    exports, serialized AOT executables through the segwarm ExeCache,
+    golden input/output pairs, quality metadata, SEGAUDIT/SEGRACE
+    provenance pins, a fingerprinted MANIFEST); ``verify_bundle``
+    re-hashes every member;
+  * :mod:`engine`  — bundle -> sealed multi-bucket ServeEngine, shared
+    by the bake (golden masks) and the serving CLI (``--bundle``) so the
+    two paths are bit-identical by construction;
+  * :mod:`store`   — the Registry: ``versions/<hash>`` published with
+    one atomic rename, ``channels/<name>.json`` pointer files
+    (``stable``/``canary``) updated tmp+rename, prefix/channel ref
+    resolution, per-bundle verify;
+  * :mod:`rollout` — RolloutPolicy + pure ``decide()`` (promote / hold /
+    rollback from per-version p99, error rate, shadow disagreement and
+    the golden-replay verdict) and the RolloutController loop that acts
+    through the FleetRouter's TrafficSplit (fleet/split.py) and the
+    FleetManager's runtime version groups, emitting a structured
+    ``rollout`` event for every transition.
+
+The shadow/canary traffic mechanics live in :mod:`rtseg_tpu.fleet`
+(split.py + router.py); this package owns the artifact and the judgment.
+Everything except the bake itself is jax-free (verify/list/channel ops
+run on machines without an accelerator stack). CLI: ``tools/segship.py``.
+"""
+
+from .bundle import (MANIFEST, VOLATILE_SIDECAR_KEYS, bake_model,
+                     bundle_version, iter_golden, load_manifest,
+                     member_fingerprint, replay_golden_http,
+                     verify_bundle, write_manifest)
+from .engine import build_bundle_engine, bundle_serve_config, load_engine
+from .rollout import (RolloutController, RolloutObs, RolloutPolicy,
+                      decide, emit_rollout, obs_from_version_stats)
+from .store import CANARY, STABLE, Registry, RegistryError
+
+__all__ = [
+    'MANIFEST', 'VOLATILE_SIDECAR_KEYS', 'bake_model', 'bundle_version',
+    'iter_golden', 'load_manifest', 'member_fingerprint',
+    'replay_golden_http', 'verify_bundle', 'write_manifest',
+    'build_bundle_engine', 'bundle_serve_config', 'load_engine',
+    'RolloutController', 'RolloutObs', 'RolloutPolicy', 'decide',
+    'emit_rollout', 'obs_from_version_stats',
+    'CANARY', 'STABLE', 'Registry', 'RegistryError',
+]

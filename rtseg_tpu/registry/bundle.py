@@ -1,0 +1,388 @@
+"""ArtifactBundle: one content-hashed, self-describing deploy unit.
+
+A bundle is everything a serving replica needs to run one model version,
+in one directory, fingerprinted so corruption or drift is detectable:
+
+  * ``hlo/<H>x<W>.stablehlo`` — a portable ``jax.export`` artifact per
+    serving bucket (weights baked in as constants, int8-argmax head —
+    rtseg_tpu/export.py);
+  * ``exe/<key>.exe`` + ``<key>.json`` — serialized AOT executables and
+    their provenance sidecars, produced through the segwarm ExeCache at
+    bake time so a replica on the baking topology deserializes in
+    milliseconds instead of compiling;
+  * ``golden/g<i>.png`` + ``g<i>.mask.npy`` — golden input payloads and
+    the masks this exact bundle produced for them at bake time; a serving
+    replica replayed against them must answer bit-identically (the
+    promote gate);
+  * ``quality.json`` — expected-quality metadata (golden-pair count,
+    class histogram, optional held-out mIoU supplied by the baker);
+  * ``pins/SEGAUDIT.json`` + ``pins/SEGRACE.json`` — the repo's audited
+    collective budgets and lock-order pins at bake time (provenance: what
+    invariants the artifact was built under);
+  * ``MANIFEST.json`` — the member table: sha256 + byte size per file,
+    bake metadata (model, buckets, batch, compute dtype, jax versions),
+    and the bundle ``version`` — a hash over the member fingerprints, so
+    the version IS the content.
+
+Fingerprinting detail: ExeCache provenance sidecars carry *volatile*
+usage fields (``hits``, ``last_used``) that serving replicas update (an
+atomic, lock-guarded RMW — warm/exe_cache.py). Those fields are stripped
+before hashing (:func:`member_fingerprint`), so a bundle stays
+``verify``-green after serving from it while any real mutation —
+payload bytes, provenance, weights — still reads as corruption.
+
+Everything below except :func:`bake_model` is pure stdlib+numpy (verify
+runs on machines without jax); the bake imports jax inside the function,
+same contract as warm/exe_cache.py.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+MANIFEST = 'MANIFEST.json'
+
+#: usage-bookkeeping fields serving replicas rewrite inside ExeCache
+#: sidecars; stripped before fingerprinting so use != corruption
+VOLATILE_SIDECAR_KEYS = ('hits', 'last_used')
+
+#: bundle-relative files verify ignores entirely (created by serving:
+#: advisory hit-counter locks, ExeCache fallback records)
+_IGNORED_SUFFIXES = ('.lock', 'fallbacks.jsonl')
+
+
+def _is_sidecar(relpath: str) -> bool:
+    rel = relpath.replace('\\', '/')
+    return rel.startswith('exe/') and rel.endswith('.json')
+
+
+def member_fingerprint(path: str, relpath: str) -> Tuple[str, int]:
+    """(sha256-hex, size-bytes) for one member. ExeCache sidecars hash a
+    canonical JSON with the volatile usage fields removed; every other
+    member hashes its raw bytes. An unparseable sidecar falls back to
+    raw bytes — a torn/corrupt file must mismatch, not pass."""
+    with open(path, 'rb') as f:
+        blob = f.read()
+    if _is_sidecar(relpath):
+        try:
+            meta = json.loads(blob)
+            for key in VOLATILE_SIDECAR_KEYS:
+                meta.pop(key, None)
+            canon = json.dumps(meta, sort_keys=True).encode()
+            return hashlib.sha256(canon).hexdigest(), len(blob)
+        except (json.JSONDecodeError, UnicodeDecodeError, AttributeError):
+            pass
+    return hashlib.sha256(blob).hexdigest(), len(blob)
+
+
+def _iter_members(bundle_dir: str) -> List[str]:
+    out = []
+    for dirpath, _, filenames in os.walk(bundle_dir):
+        for fn in sorted(filenames):
+            full = os.path.join(dirpath, fn)
+            rel = os.path.relpath(full, bundle_dir).replace('\\', '/')
+            if rel == MANIFEST or rel.endswith(_IGNORED_SUFFIXES):
+                continue
+            out.append(rel)
+    return sorted(out)
+
+
+def bundle_version(members: Dict[str, Dict[str, Any]], model: str) -> str:
+    """The bundle's version string: 12 hex chars of a sha256 over the
+    model name and every member's fingerprint — the version IS the
+    content, so two bakes of identical inputs collide on purpose and any
+    changed byte is a new version."""
+    h = hashlib.sha256()
+    h.update(model.encode())
+    for rel in sorted(members):
+        h.update(b'\x00')
+        h.update(rel.encode())
+        h.update(b'\x00')
+        h.update(members[rel]['sha256'].encode())
+    return h.hexdigest()[:12]
+
+
+def write_manifest(bundle_dir: str, model: str,
+                   meta: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Fingerprint every member of ``bundle_dir`` and write MANIFEST.json
+    (atomic tmp+rename). Returns the manifest dict (with 'version')."""
+    members: Dict[str, Dict[str, Any]] = {}
+    for rel in _iter_members(bundle_dir):
+        digest, size = member_fingerprint(os.path.join(bundle_dir, rel),
+                                          rel)
+        members[rel] = {'sha256': digest, 'bytes': size}
+    manifest = {
+        'model': model,
+        'version': bundle_version(members, model),
+        'members': members,
+        'meta': dict(meta or {}),
+    }
+    tmp = os.path.join(bundle_dir, MANIFEST + f'.tmp.{os.getpid()}')
+    with open(tmp, 'w') as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+    os.replace(tmp, os.path.join(bundle_dir, MANIFEST))
+    return manifest
+
+
+def load_manifest(bundle_dir: str) -> Dict[str, Any]:
+    with open(os.path.join(bundle_dir, MANIFEST)) as f:
+        return json.load(f)
+
+
+def verify_bundle(bundle_dir: str) -> List[str]:
+    """Re-hash every manifest member; returns the list of problems
+    (empty == intact). Catches missing members, changed bytes, a version
+    that no longer matches the member fingerprints, and a manifest that
+    does not parse — anything a deploy should refuse to serve."""
+    problems: List[str] = []
+    try:
+        manifest = load_manifest(bundle_dir)
+    except FileNotFoundError:
+        return [f'no {MANIFEST} in {bundle_dir}']
+    except json.JSONDecodeError as e:
+        return [f'unparseable {MANIFEST}: {e}']
+    members = manifest.get('members', {})
+    if not members:
+        problems.append('manifest lists no members')
+    for rel, want in sorted(members.items()):
+        path = os.path.join(bundle_dir, rel)
+        if not os.path.exists(path):
+            problems.append(f'missing member {rel}')
+            continue
+        digest, size = member_fingerprint(path, rel)
+        if digest != want.get('sha256'):
+            problems.append(f'member {rel} hash mismatch '
+                            f'({digest[:12]} != '
+                            f'{str(want.get("sha256"))[:12]})')
+    want_version = bundle_version(members, manifest.get('model', ''))
+    if manifest.get('version') != want_version:
+        problems.append(f'manifest version {manifest.get("version")} '
+                        f'does not match member fingerprints '
+                        f'({want_version})')
+    return problems
+
+
+# ----------------------------------------------------------------- goldens
+def iter_golden(bundle_dir: str) -> List[Tuple[bytes, 'Any']]:
+    """[(payload_bytes, expected_mask int8 array)] from the bundle's
+    golden pairs, in index order."""
+    import numpy as np
+    gdir = os.path.join(bundle_dir, 'golden')
+    out = []
+    if not os.path.isdir(gdir):
+        return out
+    for fn in sorted(os.listdir(gdir)):
+        if not fn.endswith('.png'):
+            continue
+        stem = fn[:-len('.png')]
+        mask_path = os.path.join(gdir, stem + '.mask.npy')
+        if not os.path.exists(mask_path):
+            continue
+        with open(os.path.join(gdir, fn), 'rb') as f:
+            payload = f.read()
+        out.append((payload, np.load(mask_path)))
+    return out
+
+
+def replay_golden_http(url: str, bundle_dir: str,
+                       timeout_s: float = 60.0) -> Dict[str, Any]:
+    """POST every golden payload to ``url``/predict?raw=1 and compare the
+    raw int8 mask against the bundle's expected output. The promote gate:
+    ``bit_identical`` means every pixel of every pair matched — the
+    serving replica reproduces the bake exactly."""
+    import urllib.request
+    import numpy as np
+    pairs = iter_golden(bundle_dir)
+    agree = 0
+    mismatches: List[str] = []
+    for i, (payload, want) in enumerate(pairs):
+        req = urllib.request.Request(url.rstrip('/') + '/predict?raw=1',
+                                     data=payload, method='POST')
+        try:
+            with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+                body = resp.read()
+                shape = resp.headers.get('X-Mask-Shape', '')
+        except Exception as e:   # noqa: BLE001 — reported, gated on
+            mismatches.append(f'pair {i}: {type(e).__name__}: {e}')
+            continue
+        got = np.frombuffer(body, np.int8)
+        if shape:
+            try:
+                h, w = (int(x) for x in shape.split(','))
+                got = got.reshape(h, w)
+            except ValueError:
+                pass
+        if got.shape == want.shape and bool((got == want).all()):
+            agree += 1
+        else:
+            frac = (float((got.reshape(-1)[:want.size]
+                           == want.reshape(-1)[:got.size]).mean())
+                    if got.size and want.size else 0.0)
+            mismatches.append(f'pair {i}: agreement {frac:.4f}')
+    return {'pairs': len(pairs), 'agree': agree,
+            'bit_identical': bool(pairs) and agree == len(pairs),
+            'mismatches': mismatches}
+
+
+# -------------------------------------------------------------------- bake
+def bake_model(staging_dir: str, model: str, num_class: int,
+               buckets: Sequence[Tuple[int, int]], batch: int,
+               compute_dtype: Optional[str] = None,
+               ckpt_path: Optional[str] = None,
+               golden: int = 4, seed: int = 0,
+               perturb: float = 0.0, perturb_seed: int = 0,
+               miou: Optional[float] = None,
+               pins_root: Optional[str] = None) -> Dict[str, Any]:
+    """Build one bundle's members under ``staging_dir`` (the store
+    publishes it atomically — registry/store.py).
+
+    Steps: init (or restore) the weights, export one StableHLO artifact
+    per bucket, AOT-compile the bucket table through an ExeCache rooted
+    in the bundle (serialized executables become members), push seeded
+    golden payloads through the exact serving path (preprocess ->
+    bucket -> padded batch -> engine) and record the masks, write
+    quality metadata + the repo's SEGAUDIT/SEGRACE pins, and fingerprint
+    it all into MANIFEST.json.
+
+    ``perturb`` adds seeded gaussian noise to every param leaf — a
+    rollout-drill knob (CI bakes a deliberately-different "bad" version
+    with it; the shadow compare must notice). Returns the manifest.
+    """
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from ..config import SegConfig
+    from ..export import build_inference_fn, save_exported
+    from ..models import get_model
+    from ..nn import set_bn_axis, set_stem_packing
+    from ..ops import set_defer_final_upsample
+    from ..serve import (assemble_batch, encode_png, make_preprocess,
+                         select_bucket, synth_images)
+    from jax import export as jex
+    from .engine import build_bundle_engine
+
+    cfg = SegConfig(dataset='synthetic', model=model,
+                    num_class=num_class, compute_dtype=compute_dtype,
+                    save_dir='/tmp/segship_bake', use_tb=False)
+    cfg.resolve(num_devices=1)
+    net = get_model(cfg)
+    variables = net.init(jax.random.PRNGKey(0),
+                         jnp.zeros((1, 64, 64, 3), jnp.float32), False)
+    if ckpt_path:
+        from ..train.checkpoint import restore_weights
+        p, bs = restore_weights(ckpt_path, variables['params'],
+                                variables.get('batch_stats', {}))
+        variables = dict(variables, params=p, batch_stats=bs)
+    if perturb:
+        # the rollout-drill knob: a seeded, reproducible "different
+        # model" whose outputs genuinely diverge from the base bake
+        key = jax.random.PRNGKey(perturb_seed)
+        leaves, treedef = jax.tree_util.tree_flatten(variables['params'])
+        keys = jax.random.split(key, len(leaves))
+        leaves = [leaf + perturb * jax.random.normal(k, leaf.shape,
+                                                     leaf.dtype)
+                  if jnp.issubdtype(leaf.dtype, jnp.floating) else leaf
+                  for leaf, k in zip(leaves, keys)]
+        variables = dict(variables, params=jax.tree_util.tree_unflatten(
+            treedef, leaves))
+
+    fn = build_inference_fn(net, variables, cfg.compute_dtype,
+                            argmax=True)
+    buckets = sorted({(int(h), int(w)) for h, w in buckets})
+    os.makedirs(os.path.join(staging_dir, 'hlo'), exist_ok=True)
+    for (h, w) in buckets:
+        # trace-time globals are this bake's for every lowering (same
+        # contract as ServeEngine.from_config's pin)
+        set_bn_axis(None)
+        set_stem_packing(bool(getattr(cfg, 's2d_stem', False)))
+        set_defer_final_upsample(False)
+        spec = jax.ShapeDtypeStruct((batch, h, w, 3), jnp.float32)
+        exported = jex.export(jax.jit(fn), platforms=('cpu', 'tpu'))(spec)
+        save_exported(exported, os.path.join(staging_dir, 'hlo',
+                                             f'{h}x{w}.stablehlo'))
+
+    # AOT bucket table over the artifacts just written — RELOADED from
+    # disk, through the bundle's own exe/ ExeCache, so the serialized
+    # executables (and their provenance sidecars) become members and the
+    # golden masks below come from byte-for-byte the same path a serving
+    # replica will run (registry/engine.py)
+    engine = build_bundle_engine(staging_dir, buckets, batch,
+                                 name=f'segship:{model}')
+
+    # golden pairs through the exact serving path the replica will run
+    preprocess = make_preprocess(cfg)
+    images = synth_images(buckets, seed=seed,
+                          per_shape=max(1, golden // len(buckets)))
+    gdir = os.path.join(staging_dir, 'golden')
+    os.makedirs(gdir, exist_ok=True)
+    hist: Dict[int, int] = {}
+    n_pairs = 0
+    for i, img in enumerate(images[:golden]):
+        payload = encode_png(img)
+        pre = preprocess(payload)
+        bucket = select_bucket(engine.buckets, *pre.shape[:2])
+        if bucket is None:
+            continue
+        mask = engine.run(bucket, assemble_batch([pre], bucket, batch))[0]
+        h, w = pre.shape[:2]
+        mask = np.asarray(mask[:h, :w], np.int8)
+        with open(os.path.join(gdir, f'g{n_pairs:03d}.png'), 'wb') as f:
+            f.write(payload)
+        np.save(os.path.join(gdir, f'g{n_pairs:03d}.mask.npy'), mask)
+        vals, counts = np.unique(mask, return_counts=True)
+        for v, c in zip(vals.tolist(), counts.tolist()):
+            hist[int(v)] = hist.get(int(v), 0) + int(c)
+        n_pairs += 1
+
+    quality = {
+        'golden_pairs': n_pairs,
+        'class_histogram': {str(k): v for k, v in sorted(hist.items())},
+        'miou': miou,       # held-out mIoU when the baker supplies one
+    }
+    with open(os.path.join(staging_dir, 'quality.json'), 'w') as f:
+        json.dump(quality, f, indent=1, sort_keys=True)
+
+    # provenance pins: the audited invariants this artifact was built
+    # under (collective budgets, lock order) travel with it
+    root = pins_root or os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    pdir = os.path.join(staging_dir, 'pins')
+    os.makedirs(pdir, exist_ok=True)
+    for name in ('SEGAUDIT.json', 'SEGRACE.json'):
+        src = os.path.join(root, name)
+        if os.path.exists(src):
+            with open(src, 'rb') as f:
+                blob = f.read()
+            with open(os.path.join(pdir, name), 'wb') as f:
+                f.write(blob)
+
+    import jaxlib
+    meta = {
+        'model': model, 'num_class': num_class,
+        'compute_dtype': str(cfg.compute_dtype),
+        'buckets': [f'{h}x{w}' for h, w in buckets],
+        'batch': int(batch),
+        'ckpt': os.path.abspath(ckpt_path) if ckpt_path else None,
+        'perturb': perturb, 'perturb_seed': perturb_seed,
+        'golden_seed': seed,
+        'jax': jax.__version__, 'jaxlib': jaxlib.__version__,
+        'platform': jax.devices()[0].platform,
+    }
+    return write_manifest(staging_dir, model, meta=meta)
+
+
+def _f32_payloads(bundle_dir: str) -> List[bytes]:
+    """Golden payloads only (no masks) — handy as load-gen traffic that
+    is guaranteed to fit the bundle's buckets."""
+    out = []
+    gdir = os.path.join(bundle_dir, 'golden')
+    if not os.path.isdir(gdir):
+        return out
+    for fn in sorted(os.listdir(gdir)):
+        if fn.endswith('.png'):
+            with open(os.path.join(gdir, fn), 'rb') as f:
+                out.append(f.read())
+    return out

@@ -1,0 +1,94 @@
+"""Bundle -> ServeEngine: run a registry artifact, buckets and all.
+
+A bundle ships one ``jax.export`` StableHLO artifact per serving bucket
+(weights baked in as constants). This module turns that set into the
+sealed multi-bucket :class:`~rtseg_tpu.serve.engine.ServeEngine` the
+serving stack expects: a single dispatch closure picks the exported
+artifact matching the (already padded) input shape — the pick happens at
+trace time, so each bucket's executable embeds exactly its artifact —
+and the bundle's own ``exe/`` ExeCache backs the AOT table, so a replica
+on the baking topology deserializes the compiled executables in
+milliseconds instead of re-running XLA over the StableHLO.
+
+Used from both ends of the artifact's life so the two are bit-identical
+by construction: ``bake_model`` builds its golden masks through this
+exact path (reloading the just-saved artifacts from disk, not the
+in-memory export), and ``tools/segserve.py serve --bundle`` serves
+through it.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from .bundle import load_manifest
+
+Bucket = Tuple[int, int]
+
+
+def parse_bucket_names(names) -> List[Bucket]:
+    """['64x64', ...] (manifest meta) -> [(64, 64), ...]."""
+    out = []
+    for name in names:
+        h, _, w = str(name).partition('x')
+        out.append((int(h), int(w)))
+    return sorted(set(out))
+
+
+def build_bundle_engine(bundle_dir: str, buckets: List[Bucket],
+                        batch: int, name: str = 'bundle',
+                        compile_workers: int = 0):
+    """ServeEngine over the bundle's per-bucket StableHLO artifacts,
+    compiled (or deserialized) through the bundle's own exe/ cache."""
+    from ..export import SUFFIX, load_exported
+    from ..serve.engine import ServeEngine
+    from ..warm.exe_cache import ExeCache
+
+    exports: Dict[Bucket, Any] = {}
+    for (h, w) in buckets:
+        path = os.path.join(bundle_dir, 'hlo', f'{h}x{w}{SUFFIX}')
+        exports[(h, w)] = load_exported(path)
+
+    def fn(images):
+        # trace-time dispatch: inside each bucket's lowering the shape is
+        # concrete, so the executable embeds exactly one artifact
+        h, w = int(images.shape[1]), int(images.shape[2])
+        return exports[(h, w)].call(images)
+
+    exe_cache = ExeCache(os.path.join(bundle_dir, 'exe'))
+    return ServeEngine(fn, buckets, batch, name=name,
+                       exe_cache=exe_cache,
+                       compile_workers=compile_workers)
+
+
+def load_engine(bundle_dir: str, name: Optional[str] = None,
+                compile_workers: int = 0):
+    """(engine, manifest) for one published bundle — the serve-side entry
+    point (tools/segserve.py ``--bundle``). Bucket list, batch and the
+    engine's identity all come from the manifest: the bundle is
+    self-describing, the CLI flags can't drift from the bake."""
+    manifest = load_manifest(bundle_dir)
+    meta = manifest.get('meta', {})
+    buckets = parse_bucket_names(meta.get('buckets', ()))
+    if not buckets:
+        raise ValueError(f'bundle {bundle_dir} lists no buckets')
+    engine = build_bundle_engine(
+        bundle_dir, buckets, int(meta.get('batch', 1)),
+        name=name or f'bundle:{manifest.get("version", "?")}',
+        compile_workers=compile_workers)
+    return engine, manifest
+
+
+def bundle_serve_config(manifest: Dict[str, Any]):
+    """A resolved SegConfig matching the bundle's bake settings — what
+    the serving CLI needs for the preprocess transform and colormap, so
+    a replay of the golden payloads reproduces the bake bit-for-bit."""
+    from ..config import SegConfig
+    meta = manifest.get('meta', {})
+    cfg = SegConfig(dataset='synthetic', model=meta.get('model'),
+                    num_class=int(meta.get('num_class', 19)),
+                    compute_dtype=meta.get('compute_dtype'),
+                    save_dir='/tmp/segship_serve', use_tb=False)
+    cfg.resolve(num_devices=1)
+    return cfg

@@ -1,0 +1,344 @@
+"""Canary/shadow rollout control: a pure decide() acting on the fleet.
+
+The judgment core (:func:`decide`) is a pure function of one
+:class:`RolloutObs` snapshot — per-version request totals and windowed
+p99 from the router's version-labeled metrics (fleet/router.py
+``version_stats``), shadow-compare results, and an optional golden-replay
+verdict — against :class:`RolloutPolicy` thresholds, with the
+breach/clean streak threaded through successive calls exactly like the
+autoscaler's ``decide`` (fleet/autoscaler.py). That makes every rollout
+behavior unit-testable from seeded observation tables: no processes, no
+sleeps, no HTTP.
+
+Verdicts:
+
+  * ``rollback`` — the canary showed client-visible errors (immediate:
+    errors are hard evidence), or its p99 regressed past the stable
+    baseline by more than ``p99_regress_frac`` (plus an absolute floor so
+    1-core noise can't trip it) for ``breach_consecutive`` polls, or
+    shadow disagreement exceeded ``max_disagree_frac`` for that long;
+  * ``promote`` — enough canary traffic observed, ``clean_consecutive``
+    consecutive clean polls, no disagreement breach, and (when a golden
+    verdict is present) bit-identical golden replay;
+  * ``hold`` — not enough evidence yet, or a breach still under its
+    consecutive threshold.
+
+The :class:`RolloutController` is the loop: poll the router, feed
+decide(), and *act* — rollback clears the canary arm (the router falls
+back to stable before the replicas drain, so clients never see the
+teardown) and removes the canary group through the FleetManager; promote
+replays the bundle's golden pairs against a canary replica, flips the
+registry's ``stable`` channel pointer, promotes the split's canary arm,
+and drains the old stable group. Every transition lands as a structured
+``rollout`` event in the segscope sink, next to the ``fleet`` lifecycle
+events it causes.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+from ..obs import get_sink
+
+
+def emit_rollout(action: str, group: str, version: str, **fields) -> None:
+    """One structured ``rollout`` event (house style: _emit_fleet)."""
+    sink = get_sink()
+    if sink is not None:
+        sink.emit({'event': 'rollout', 'action': action, 'group': group,
+                   'version': version, **fields})
+
+
+@dataclass
+class RolloutPolicy:
+    """Thresholds for :func:`decide` — what counts as a regression."""
+    p99_regress_frac: float = 0.5   # canary p99 > stable p99 * (1 + this)
+    p99_floor_ms: float = 50.0      # ...and past stable p99 + this floor
+    max_error_frac: float = 0.0     # any client-visible canary 5xx
+    max_drop_excess: float = 0.05   # canary 504-rate above stable's by
+    #                                 more than this is a breach (a hung
+    #                                 canary whose slice times out must
+    #                                 roll back, but client-set deadlines
+    #                                 failing equally on both arms not)
+    max_disagree_frac: float = 0.02  # shadow mirrors disagreeing
+    min_canary_ok: int = 20         # traffic before any promote verdict
+    min_stable_ok: int = 20         # baseline before p99 comparison
+    breach_consecutive: int = 2     # polls a p99/drop/disagree breach
+    #                                 persists
+    clean_consecutive: int = 3      # clean polls before promote
+
+
+@dataclass
+class RolloutObs:
+    """One observation snapshot (all pure data, seedable in tests)."""
+    stable_ok: int = 0
+    canary_ok: int = 0
+    canary_errors: int = 0          # 5xx + unreachable, client-visible
+    canary_dropped: int = 0         # 504s in the canary slice (replica
+    #                                 'dropped' + router 'expired')
+    stable_dropped: int = 0         # ...and stable's, the comparison base
+    stable_p99_ms: Optional[float] = None
+    canary_p99_ms: Optional[float] = None
+    shadow_total: int = 0
+    shadow_disagree: int = 0
+    golden_ok: Optional[bool] = None   # None = not (yet) replayed
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+
+def obs_from_version_stats(stats: Dict[str, Dict[str, Any]],
+                           stable_version: str, canary_version: str,
+                           golden_ok: Optional[bool] = None) -> RolloutObs:
+    """Collapse the router's ``version_stats`` dict into a RolloutObs.
+    Client-caused 4xx (``client_error``) stay out on purpose: a bad
+    payload hashing into the canary slice is not canary evidence."""
+    st = stats.get(stable_version, {})
+    ca = stats.get(canary_version, {})
+    sh = stats.get('shadow', {})
+    return RolloutObs(
+        stable_ok=int(st.get('ok', 0)),
+        canary_ok=int(ca.get('ok', 0)),
+        canary_errors=int(ca.get('error', 0))
+        + int(ca.get('unreachable', 0)),
+        canary_dropped=int(ca.get('dropped', 0))
+        + int(ca.get('expired', 0)),
+        stable_dropped=int(st.get('dropped', 0))
+        + int(st.get('expired', 0)),
+        stable_p99_ms=st.get('p99_ms'),
+        canary_p99_ms=ca.get('p99_ms'),
+        shadow_total=int(sh.get('agree', 0)) + int(sh.get('disagree', 0)),
+        shadow_disagree=int(sh.get('disagree', 0)),
+        golden_ok=golden_ok,
+    )
+
+
+def decide(obs: RolloutObs, policy: RolloutPolicy,
+           streak: Tuple[int, int]) -> Tuple[str, str, Tuple[int, int]]:
+    """One rollout judgment: ('promote'|'hold'|'rollback', reason,
+    (breach, clean) streak to thread into the next call)."""
+    breach_streak, clean_streak = streak
+    total = obs.canary_ok + obs.canary_errors + obs.canary_dropped
+    if total and obs.canary_errors / total > policy.max_error_frac:
+        return ('rollback',
+                f'{obs.canary_errors}/{total} canary requests errored',
+                (0, 0))
+    breaches = []
+    if total >= policy.min_canary_ok:
+        # 504s are client-visible too — a hung canary whose whole slice
+        # times out never accumulates oks, so this gate runs on total
+        # attempts, DIFFERENTIALLY against stable's drop rate (deadline
+        # drops a client causes hit both arms alike and cancel out)
+        c_frac = obs.canary_dropped / total
+        s_total = obs.stable_ok + obs.stable_dropped
+        s_frac = obs.stable_dropped / s_total if s_total else 0.0
+        if c_frac > s_frac + policy.max_drop_excess:
+            breaches.append(
+                f'canary drop rate {c_frac:.3f} '
+                f'({obs.canary_dropped}/{total}) > stable '
+                f'{s_frac:.3f} + {policy.max_drop_excess}')
+    if (obs.stable_ok >= policy.min_stable_ok
+            and obs.canary_ok >= policy.min_canary_ok
+            and obs.stable_p99_ms is not None
+            and obs.canary_p99_ms is not None):
+        limit = max(obs.stable_p99_ms * (1.0 + policy.p99_regress_frac),
+                    obs.stable_p99_ms + policy.p99_floor_ms)
+        if obs.canary_p99_ms > limit:
+            breaches.append(
+                f'canary p99 {obs.canary_p99_ms:.0f}ms > '
+                f'{limit:.0f}ms (stable {obs.stable_p99_ms:.0f}ms)')
+    if obs.shadow_total:
+        frac = obs.shadow_disagree / obs.shadow_total
+        if frac > policy.max_disagree_frac:
+            breaches.append(
+                f'shadow disagreement {obs.shadow_disagree}/'
+                f'{obs.shadow_total} ({frac:.3f}) > '
+                f'{policy.max_disagree_frac}')
+    if breaches:
+        breach_streak += 1
+        if breach_streak >= policy.breach_consecutive:
+            return ('rollback',
+                    '; '.join(breaches)
+                    + f' over {breach_streak} polls', (0, 0))
+        return 'hold', 'breach: ' + '; '.join(breaches), (breach_streak, 0)
+    if obs.canary_ok < policy.min_canary_ok:
+        return ('hold', f'warming: {obs.canary_ok}/'
+                        f'{policy.min_canary_ok} canary oks',
+                (0, 0))
+    if obs.golden_ok is False:
+        # golden replay failed: the live path does not reproduce the
+        # bake — never promote, and a sustained failure is a rollback
+        breach_streak += 1
+        if breach_streak >= policy.breach_consecutive:
+            return 'rollback', 'golden replay mismatched', (0, 0)
+        return 'hold', 'golden replay mismatched', (breach_streak, 0)
+    clean_streak += 1
+    if clean_streak >= policy.clean_consecutive:
+        return ('promote',
+                f'clean over {clean_streak} polls '
+                f'({obs.canary_ok} canary oks)', (0, 0))
+    return 'hold', f'clean {clean_streak}/{policy.clean_consecutive}', \
+        (0, clean_streak)
+
+
+class RolloutController:
+    """The polling loop around :func:`decide` for one canary rollout."""
+
+    def __init__(self, router, manager, registry, group: str,
+                 canary_version: str, canary_group_name: str,
+                 bundle_dir: Optional[str] = None,
+                 old_stable_group: Optional[str] = None,
+                 policy: Optional[RolloutPolicy] = None,
+                 poll_s: float = 1.0):
+        self.router = router
+        self.manager = manager
+        self.registry = registry           # Registry or None
+        self.group = group
+        self.canary_version = canary_version
+        self.canary_group_name = canary_group_name
+        self.old_stable_group = old_stable_group
+        self.bundle_dir = bundle_dir       # for the golden promote gate
+        self.policy = policy if policy is not None else RolloutPolicy()
+        self.poll_s = poll_s
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._base: Dict[str, Dict[str, Any]] = {}
+        self._primed = False
+        self._outcome: Optional[Tuple[str, str]] = None
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f'segship-rollout-{group}')
+
+    # ------------------------------------------------------------ lifetime
+    def prime(self) -> None:
+        """Mark the rollout's starting line: snapshot the router's
+        cumulative counters (this rollout is judged only on what happens
+        AFTER this moment — an earlier candidate's shadow disagreements
+        or errors on a long-lived router must not poison this decide())
+        and emit the ``canary_start`` event. Idempotent; call it the
+        moment the canary arm starts taking traffic, even if the polling
+        thread starts later."""
+        if self._primed:
+            return
+        self._primed = True
+        split = self.router.groups[self.group]
+        self._base = self.router.version_stats(self.group)
+        emit_rollout('canary_start', self.group, self.canary_version,
+                     weight=split.canary_weight,
+                     stable=split.stable_arm().version)
+
+    def start(self) -> None:
+        self.prime()
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=30)
+
+    @property
+    def outcome(self) -> Optional[Tuple[str, str]]:
+        """(action, reason) once the rollout terminated, else None."""
+        with self._lock:
+            return self._outcome
+
+    def wait(self, timeout_s: float = 300.0) -> Optional[Tuple[str, str]]:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            out = self.outcome
+            if out is not None:
+                return out
+            time.sleep(0.05)
+        return self.outcome
+
+    # ---------------------------------------------------------------- loop
+    def observe(self) -> RolloutObs:
+        split = self.router.groups[self.group]
+        cur = self.router.version_stats(self.group)
+        base = self._base
+        rebased = {}
+        for v, stats in cur.items():
+            b = base.get(v, {})
+            rebased[v] = {
+                k: (val - int(b.get(k, 0))
+                    if isinstance(val, int) and not isinstance(val, bool)
+                    else val)       # p99/agree_frac floats pass through
+                for k, val in stats.items()}
+        return obs_from_version_stats(
+            rebased, split.stable_arm().version, self.canary_version)
+
+    def _loop(self) -> None:
+        streak = (0, 0)
+        while not self._stop.wait(self.poll_s):
+            obs = self.observe()
+            action, reason, streak = decide(obs, self.policy, streak)
+            if action == 'hold':
+                continue
+            if action == 'promote':
+                golden = self._golden_gate()
+                if golden is not None and not golden.get('bit_identical'):
+                    # the live canary does not reproduce its own bake —
+                    # that is corruption/drift, not a promotable version
+                    action, reason = 'rollback', (
+                        f'golden replay mismatch: '
+                        f'{golden.get("agree")}/{golden.get("pairs")} '
+                        f'pairs bit-identical')
+                else:
+                    self._promote(reason, golden)
+                    return
+            if action == 'rollback':
+                self._rollback(reason, obs)
+                return
+
+    # ------------------------------------------------------------- actions
+    def _golden_gate(self) -> Optional[Dict[str, Any]]:
+        """Replay the canary bundle's golden pairs against one canary
+        replica (direct, not through the split — the gate must hit the
+        new version deterministically)."""
+        if self.bundle_dir is None:
+            return None
+        from .bundle import replay_golden_http
+        group = self.manager.groups.get(self.canary_group_name)
+        ready = group.ready() if group is not None else []
+        if not ready or ready[0].url is None:
+            return {'pairs': 0, 'agree': 0, 'bit_identical': False,
+                    'mismatches': ['no ready canary replica to replay']}
+        return replay_golden_http(ready[0].url, self.bundle_dir)
+
+    def _promote(self, reason: str, golden: Optional[Dict[str, Any]]
+                 ) -> None:
+        split = self.router.groups[self.group]
+        prev = split.promote_canary()
+        if self.registry is not None:
+            self.registry.set_channel(self._model(), 'stable',
+                                      self.canary_version)
+        emit_rollout('promote', self.group, self.canary_version,
+                     reason=reason, previous=prev.version,
+                     golden=(golden or {}).get('pairs'))
+        # the old stable arm leaves only after the router stopped
+        # routing to it — draining costs no client a request
+        if self.old_stable_group:
+            self.manager.remove_group(self.old_stable_group, drain=True,
+                                      reason='promote')
+        with self._lock:
+            self._outcome = ('promote', reason)
+
+    def _rollback(self, reason: str, obs: RolloutObs) -> None:
+        split = self.router.groups[self.group]
+        split.clear_canary()
+        emit_rollout('rollback', self.group, self.canary_version,
+                     reason=reason, canary_ok=obs.canary_ok,
+                     canary_errors=obs.canary_errors,
+                     shadow_disagree=obs.shadow_disagree)
+        # arm cleared first: from here every request (the sticky canary
+        # hash slice included) routes to stable, so the drain below is
+        # invisible to clients
+        self.manager.remove_group(self.canary_group_name, drain=True,
+                                  reason='rollback')
+        with self._lock:
+            self._outcome = ('rollback', reason)
+
+    def _model(self) -> str:
+        """The registry model name — the router group name by segship
+        convention (tools/segship.py names groups after models)."""
+        return self.group

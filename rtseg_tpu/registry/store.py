@@ -1,0 +1,212 @@
+"""The on-disk artifact registry: versions, channels, atomic publish.
+
+Layout under one root directory (pure files — the registry works over
+NFS/object-store mounts and needs no daemon):
+
+    <root>/models/<model>/versions/<version>/   # one ArtifactBundle each
+    <root>/models/<model>/channels/<name>.json  # channel pointer files
+
+A *version* directory is immutable once published: bundles are staged
+under ``<root>/models/<model>/staging-*`` and moved into place with one
+``os.rename`` — a concurrent reader either sees the whole bundle or none
+of it, and a crashed bake leaves only a staging dir the next publish
+ignores. Publishing the version that already exists is a no-op (the
+version is the content hash, so "already there" means "bit-identical").
+
+A *channel* (``stable``, ``canary``, anything) is a JSON pointer file
+naming a version; updates go through tmp+rename so a reader never parses
+a half-written pointer, and each update records the previous version —
+the rollback path is literally "re-point at what the pointer said
+before". Refs resolve as ``@<channel>`` or a version prefix.
+
+Host-side pure stdlib; the rollout controller (registry/rollout.py)
+flips channels through this class, the CLI (tools/segship.py) fronts it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+import time
+from typing import Any, Dict, List, Optional
+
+from .bundle import MANIFEST, load_manifest, verify_bundle
+
+#: the channel every deploy reads by default
+STABLE = 'stable'
+CANARY = 'canary'
+
+
+class RegistryError(ValueError):
+    """Bad ref / unknown model / unknown version."""
+
+
+class Registry:
+    """One registry root; all methods are path math + atomic file ops."""
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+
+    # --------------------------------------------------------------- paths
+    def model_dir(self, model: str) -> str:
+        return os.path.join(self.root, 'models', model)
+
+    def version_dir(self, model: str, version: str) -> str:
+        return os.path.join(self.model_dir(model), 'versions', version)
+
+    def _channel_path(self, model: str, channel: str) -> str:
+        return os.path.join(self.model_dir(model), 'channels',
+                            f'{channel}.json')
+
+    # ------------------------------------------------------------- listing
+    def models(self) -> List[str]:
+        d = os.path.join(self.root, 'models')
+        if not os.path.isdir(d):
+            return []
+        return sorted(m for m in os.listdir(d)
+                      if os.path.isdir(os.path.join(d, m)))
+
+    def versions(self, model: str) -> List[str]:
+        d = os.path.join(self.model_dir(model), 'versions')
+        if not os.path.isdir(d):
+            return []
+        return sorted(v for v in os.listdir(d)
+                      if os.path.exists(os.path.join(d, v, MANIFEST)))
+
+    def channels(self, model: str) -> Dict[str, Dict[str, Any]]:
+        d = os.path.join(self.model_dir(model), 'channels')
+        out: Dict[str, Dict[str, Any]] = {}
+        if not os.path.isdir(d):
+            return out
+        for fn in sorted(os.listdir(d)):
+            if not fn.endswith('.json'):
+                continue
+            try:
+                with open(os.path.join(d, fn)) as f:
+                    out[fn[:-len('.json')]] = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                continue
+        return out
+
+    # ------------------------------------------------------------- staging
+    def staging_dir(self, model: str) -> str:
+        """A fresh private staging directory for one bake; publish moves
+        it into versions/ atomically, abandons are garbage a later
+        ``segship list`` can spot by the prefix."""
+        base = self.model_dir(model)
+        os.makedirs(base, exist_ok=True)
+        return tempfile.mkdtemp(prefix='staging-', dir=base)
+
+    def publish(self, model: str, staging: str) -> str:
+        """Move a staged bundle (already carrying MANIFEST.json) into
+        ``versions/<version>`` with one rename. Returns the version.
+        Re-publishing identical content is a no-op (content-addressed);
+        a version collision with *different* content cannot happen short
+        of a hash collision, so an existing target means done."""
+        manifest = load_manifest(staging)
+        version = manifest['version']
+        dst = self.version_dir(model, version)
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        if os.path.exists(dst):
+            shutil.rmtree(staging)
+            return version
+        try:
+            os.rename(staging, dst)
+        except OSError:
+            # lost a publish race for the same content: the winner's
+            # bundle is bit-identical by construction
+            if os.path.exists(dst):
+                shutil.rmtree(staging, ignore_errors=True)
+            else:
+                raise
+        return version
+
+    # ------------------------------------------------------------ channels
+    def set_channel(self, model: str, channel: str,
+                    version: str) -> Dict[str, Any]:
+        """Atomically point ``channel`` at ``version`` (which must be
+        published). The pointer records the previous version so a
+        rollback is one more set_channel."""
+        if version not in self.versions(model):
+            raise RegistryError(f'{model}: version {version!r} is not '
+                                f'published; have {self.versions(model)}')
+        path = self._channel_path(model, channel)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        prev = None
+        try:
+            with open(path) as f:
+                prev = json.load(f).get('version')
+        except (OSError, json.JSONDecodeError):
+            pass
+        pointer = {'version': version, 'previous': prev,
+                   'updated': time.time()}
+        tmp = f'{path}.tmp.{os.getpid()}'
+        with open(tmp, 'w') as f:
+            json.dump(pointer, f, indent=1)
+        os.replace(tmp, path)
+        return pointer
+
+    def channel(self, model: str, channel: str) -> Optional[str]:
+        try:
+            with open(self._channel_path(model, channel)) as f:
+                return json.load(f).get('version')
+        except (OSError, json.JSONDecodeError):
+            return None
+
+    # ------------------------------------------------------------- resolve
+    def resolve(self, model: str, ref: Optional[str] = None) -> str:
+        """Ref -> version: ``@<channel>`` follows a pointer, anything
+        else matches a unique version prefix; None means ``@stable``."""
+        ref = ref or f'@{STABLE}'
+        if ref.startswith('@'):
+            version = self.channel(model, ref[1:])
+            if version is None:
+                raise RegistryError(f'{model}: channel {ref[1:]!r} is '
+                                    f'not set')
+            return version
+        matches = [v for v in self.versions(model) if v.startswith(ref)]
+        if len(matches) != 1:
+            raise RegistryError(
+                f'{model}: ref {ref!r} matches {matches or "nothing"}; '
+                f'have {self.versions(model)}')
+        return matches[0]
+
+    def bundle_dir(self, model: str, ref: Optional[str] = None) -> str:
+        return self.version_dir(model, self.resolve(model, ref))
+
+    # -------------------------------------------------------------- verify
+    def verify(self, model: str, ref: Optional[str] = None) -> List[str]:
+        """Re-hash every member of the referenced bundle (empty list ==
+        intact); an unpublished ref is itself a problem, not a raise, so
+        CI gates can aggregate."""
+        try:
+            bundle = self.bundle_dir(model, ref)
+        except RegistryError as e:
+            return [str(e)]
+        return verify_bundle(bundle)
+
+    def describe(self, model: str) -> Dict[str, Any]:
+        """One model's versions (with bake meta) + channel pointers —
+        the ``segship list`` view."""
+        versions = {}
+        for v in self.versions(model):
+            try:
+                m = load_manifest(self.version_dir(model, v))
+            except (OSError, json.JSONDecodeError):
+                versions[v] = {'error': 'unreadable manifest'}
+                continue
+            meta = m.get('meta', {})
+            versions[v] = {
+                'members': len(m.get('members', {})),
+                'bytes': sum(int(x.get('bytes', 0))
+                             for x in m.get('members', {}).values()),
+                'buckets': meta.get('buckets'),
+                'batch': meta.get('batch'),
+                'perturb': meta.get('perturb'),
+                'platform': meta.get('platform'),
+            }
+        return {'model': model, 'versions': versions,
+                'channels': self.channels(model)}

@@ -26,15 +26,15 @@ from .loadgen import (bench_http, bench_pipeline, bench_sequential,
                       check_report, encode_png, format_report,
                       replica_skew, synth_images)
 from .pipeline import ServePipeline, ServeResult
-from .server import (DEADLINE_HEADER, REPLICA_HEADER, ServeHTTPServer,
-                     make_preprocess, make_server)
+from .server import (DEADLINE_HEADER, REPLICA_HEADER, VERSION_HEADER,
+                     ServeHTTPServer, make_preprocess, make_server)
 
 __all__ = [
     'Bucket', 'ServeEngine', 'UnknownBucket', 'assemble_batch',
     'parse_buckets', 'select_bucket',
     'MicroBatcher', 'Request', 'ServeDrop', 'ServeReject',
     'ServePipeline', 'ServeResult',
-    'DEADLINE_HEADER', 'REPLICA_HEADER',
+    'DEADLINE_HEADER', 'REPLICA_HEADER', 'VERSION_HEADER',
     'ServeHTTPServer', 'make_preprocess', 'make_server',
     'bench_http', 'bench_pipeline', 'bench_sequential', 'check_report',
     'encode_png', 'format_report', 'replica_skew', 'synth_images',

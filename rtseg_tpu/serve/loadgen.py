@@ -33,7 +33,7 @@ from ..obs.tracing import TRACE_HEADER, TRACE_KEY, new_trace_id
 from .batcher import ServeDrop, ServeReject
 from .engine import Bucket, ServeEngine, assemble_batch, select_bucket
 from .pipeline import ServePipeline
-from .server import REPLICA_HEADER
+from .server import REPLICA_HEADER, VERSION_HEADER
 
 _STAGES = ('queue_ms', 'assemble_ms', 'device_ms', 'post_ms', 'decode_ms')
 
@@ -147,19 +147,22 @@ def bench_pipeline(pipeline: ServePipeline, images: Sequence[np.ndarray],
 
 def bench_http(url, payloads: Sequence[bytes], requests: int,
                rps: float, seed: int = 0, timeout_s: float = 60.0,
-               workers: int = 32) -> dict:
+               workers: int = 32, query: str = '') -> dict:
     """Open-loop drive of one or more running segserve HTTP servers.
     ``url`` is a single URL (a replica, or a fleet router) or a sequence
     of URLs (client-side round-robin over a replica list). Client-side
     e2e latency; the server's own stage decomposition comes back in
-    X-Serve-Timing, per-replica attribution in X-Replica-Id."""
+    X-Serve-Timing, per-replica attribution in X-Replica-Id, per-version
+    attribution in X-Artifact-Version. ``query`` rides on every request
+    (e.g. ``raw=1`` so a shadow compare sees int8 masks, not PNGs)."""
     from urllib import error, request as urlreq
 
     arrivals = _open_loop_schedule(requests, rps, seed)
     order = np.random.default_rng(seed + 1).integers(
         0, len(payloads), requests)
     urls = [url] if isinstance(url, str) else list(url)
-    targets = [u.rstrip('/') + '/predict' for u in urls]
+    targets = [u.rstrip('/') + '/predict'
+               + (f'?{query}' if query else '') for u in urls]
 
     def one(i: int, t_sched: float) -> dict:
         body = payloads[int(order[i])]
@@ -180,6 +183,7 @@ def bench_http(url, payloads: Sequence[bytes], requests: int,
                         'e2e_ms': (time.perf_counter() - t_sched) * 1e3,
                         'timing': timing,
                         'replica': resp.headers.get(REPLICA_HEADER),
+                        'version': resp.headers.get(VERSION_HEADER),
                         'trace_ok': (resp.headers.get(TRACE_HEADER) == tid
                                      and timing.get(TRACE_KEY) == tid)}
         except error.HTTPError as e:
@@ -187,6 +191,7 @@ def bench_http(url, payloads: Sequence[bytes], requests: int,
             return {'status': {503: 'rejected', 504: 'dropped'}.get(
                 e.code, 'error'),
                 'replica': e.headers.get(REPLICA_HEADER),
+                'version': e.headers.get(VERSION_HEADER),
                 'trace_ok': e.headers.get(TRACE_HEADER) == tid}
         except Exception:   # noqa: BLE001 — connection-level failure
             return {'status': 'error'}
@@ -210,9 +215,17 @@ def bench_http(url, payloads: Sequence[bytes], requests: int,
     counts = {s: sum(1 for r in results if r['status'] == s)
               for s in ('ok', 'dropped', 'rejected', 'error')}
     per_replica: Dict[str, int] = {}
+    per_version: Dict[str, int] = {}
     for r in results:
         if r['status'] == 'ok' and r.get('replica'):
             per_replica[r['replica']] = per_replica.get(r['replica'],
+                                                        0) + 1
+        if r['status'] == 'ok' and r.get('version'):
+            # segship: ok responses attributed to the artifact version
+            # that served them (X-Artifact-Version) — what the canary
+            # split-weight gate and the per-version reconciliation
+            # against the router's fleet_requests_total{version} consume
+            per_version[r['version']] = per_version.get(r['version'],
                                                         0) + 1
     report = {'mode': 'http',
               'url': targets[0] if len(targets) == 1 else targets,
@@ -223,7 +236,8 @@ def bench_http(url, payloads: Sequence[bytes], requests: int,
               'trace_mismatch': sum(
                   1 for r in results if r.get('trace_ok') is False),
               'per_replica': per_replica,
-              'replica_skew': replica_skew(per_replica)}
+              'replica_skew': replica_skew(per_replica),
+              'per_version': per_version}
     return _finalize(report, e2e, stages, counts['ok'], counts['dropped'],
                      counts['rejected'], counts['error'], wall)
 
@@ -263,9 +277,24 @@ def bench_sequential(engine: ServeEngine, images: Sequence[np.ndarray],
 def check_report(report: dict, p95_ms: float,
                  expect_buckets: Optional[int] = None,
                  max_replica_skew: Optional[float] = None,
-                 expect_replicas: Optional[int] = None) -> List[str]:
+                 expect_replicas: Optional[int] = None,
+                 canary_version: Optional[str] = None,
+                 canary_weight: Optional[float] = None,
+                 canary_weight_tol: float = 0.1) -> List[str]:
     """CI gate: the list of violated conditions (empty == pass)."""
     problems = []
+    if canary_version is not None and canary_weight is not None:
+        # segship split-weight gate: the observed canary share of ok
+        # responses (per X-Artifact-Version) must sit within tol of the
+        # configured weight — the sticky trace-hash split converges there
+        ok = report.get('ok', 0)
+        seen = (report.get('per_version') or {}).get(canary_version, 0)
+        observed = seen / ok if ok else 0.0
+        if abs(observed - canary_weight) > canary_weight_tol:
+            problems.append(
+                f'canary {canary_version} served {observed:.3f} of ok '
+                f'traffic, configured weight {canary_weight} '
+                f'(tol {canary_weight_tol})')
     if expect_replicas is not None:
         seen = len(report.get('per_replica') or {})
         if seen != expect_replicas:
@@ -327,6 +356,12 @@ def format_report(report: dict) -> str:
         dist = ' | '.join(f'{rid} {n}' for rid, n in sorted(per.items()))
         lines.append(f'  per replica    : {dist} '
                      f'(skew {report.get("replica_skew")})')
+    pv = report.get('per_version')
+    if pv:
+        total = sum(pv.values())
+        dist = ' | '.join(f'{v} {n} ({n / total:.2f})'
+                          for v, n in sorted(pv.items()))
+        lines.append(f'  per version    : {dist}')
     eng = report.get('engine')
     if eng:
         lines.append(

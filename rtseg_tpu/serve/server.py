@@ -83,18 +83,31 @@ REPLICA_HEADER = 'X-Replica-Id'
 #: becomes the request's queue deadline (504 when it expires in queue)
 DEADLINE_HEADER = 'X-Deadline-Ms'
 
+#: response header naming the artifact version that produced the answer
+#: (segship: a replica serving a registry bundle stamps the bundle's
+#: content-hash version; the fleet router forwards it — or stamps the
+#: routed arm's version — so load-gen and clients can attribute every
+#: response to a model version during canary/shadow rollouts)
+VERSION_HEADER = 'X-Artifact-Version'
+
 
 class ServeHTTPServer(ThreadingHTTPServer):
     daemon_threads = True
+    # absorb open-loop arrival bursts at the TCP layer (socketserver's
+    # default listen backlog of 5 resets connections under a spike);
+    # overload belongs to the admission 503 path, not the kernel
+    request_queue_size = 128
 
     def __init__(self, addr, pipeline: ServePipeline,
                  colormap: Optional[np.ndarray] = None,
                  request_timeout_s: float = 30.0,
-                 replica_id: Optional[str] = None):
+                 replica_id: Optional[str] = None,
+                 artifact_version: Optional[str] = None):
         self.pipeline = pipeline
         self.colormap = colormap
         self.request_timeout_s = request_timeout_s
         self.replica_id = replica_id
+        self.artifact_version = artifact_version
         self._http_counters: dict = {}
         # drain lifecycle: _draining stops /predict admission, _inflight
         # counts admitted-but-unanswered predicts; both only ever move
@@ -189,6 +202,10 @@ class _Handler(BaseHTTPRequestHandler):
             # every response — success or error — attributes itself, so
             # the load-gen report and the router can count per replica
             self.send_header(REPLICA_HEADER, self.server.replica_id)
+        if self.server.artifact_version is not None:
+            # ...and to the artifact version it serves (segship canary/
+            # shadow rollouts reconcile per-version request counts)
+            self.send_header(VERSION_HEADER, self.server.artifact_version)
         for k, v in (extra or {}).items():
             self.send_header(k, v)
         self.end_headers()
@@ -377,13 +394,15 @@ class _Handler(BaseHTTPRequestHandler):
 def make_server(pipeline: ServePipeline, host: str = '127.0.0.1',
                 port: int = 8080, colormap: Optional[np.ndarray] = None,
                 request_timeout_s: float = 30.0,
-                replica_id: Optional[str] = None) -> ServeHTTPServer:
+                replica_id: Optional[str] = None,
+                artifact_version: Optional[str] = None) -> ServeHTTPServer:
     """Bind (port 0 picks a free one; read ``server.server_address``).
     Call ``serve_forever()`` — typically on a thread — then ``shutdown()``
     + ``pipeline.close()``."""
     return ServeHTTPServer((host, port), pipeline, colormap=colormap,
                            request_timeout_s=request_timeout_s,
-                           replica_id=replica_id)
+                           replica_id=replica_id,
+                           artifact_version=artifact_version)
 
 
 def make_preprocess(config):

@@ -311,21 +311,35 @@ class ExeCache:
             return None
 
     def _bump_hit(self, key: str) -> None:
-        """Best-effort per-entry hit counter in the provenance sidecar
-        (what `segwarm.py stats` reports across processes). The
-        read-modify-write is not cross-process atomic: simultaneous inits
-        can undercount by a hit — acceptable for bookkeeping, so don't
-        gate `stats --check --min-hits` tighter than sequential runs
-        guarantee."""
+        """Per-entry hit counter in the provenance sidecar (what
+        `segwarm.py stats` reports across processes). The read-modify-
+        write runs under a per-entry advisory file lock (a ``.lock``
+        sibling) and the rewrite is tmp+rename, so a concurrent replica
+        warm fan-out can neither lose counts nor leave a torn sidecar —
+        the segship artifact registry fingerprints these sidecars, and a
+        half-written one would read as bundle corruption. On platforms
+        without ``fcntl`` the write stays atomic (rename) and only the
+        count can race, same as any unlocked RMW."""
+        meta_path = self._meta_path(key)
         try:
-            with open(self._meta_path(key)) as f:
+            lock_f = open(meta_path + '.lock', 'a')
+        except OSError:
+            return
+        try:
+            try:
+                import fcntl
+                fcntl.flock(lock_f, fcntl.LOCK_EX)
+            except (ImportError, OSError):
+                pass          # count may race; the write stays atomic
+            with open(meta_path) as f:
                 meta = json.load(f)
             meta['hits'] = int(meta.get('hits', 0)) + 1
             meta['last_used'] = time.time()
-            _atomic_write(self._meta_path(key),
-                          json.dumps(meta, indent=1).encode())
+            _atomic_write(meta_path, json.dumps(meta, indent=1).encode())
         except Exception:   # noqa: BLE001 — stats bookkeeping only
             pass
+        finally:
+            lock_f.close()    # releases the flock
 
     def _record_fallback(self, key: str, name: str, err: Exception) -> None:
         with self._lock:

@@ -36,9 +36,13 @@ from rtseg_tpu.serve.server import make_server                 # noqa: E402
 
 
 class FakePipeline:
-    """Just enough ServePipeline surface for the HTTP front-end."""
+    """Just enough ServePipeline surface for the HTTP front-end.
 
-    def __init__(self, delay_ms: float, ctl_file=None):
+    ``mask_value`` fills the 4x4 int8 mask — two stubs with different
+    values model two model versions whose outputs disagree, which is how
+    the segship shadow-compare tests seed a detectable divergence."""
+
+    def __init__(self, delay_ms: float, ctl_file=None, mask_value=0):
         self.registry = MetricsRegistry()
         self._ok = self.registry.counter('serve_requests_total',
                                          status='ok')
@@ -46,6 +50,7 @@ class FakePipeline:
         self._g_depth = self.registry.gauge('serve_queue_depth')
         self._delay_ms = delay_ms
         self._ctl_file = ctl_file
+        self._mask_value = int(mask_value)
         self._lock = threading.Lock()
         if ctl_file:
             threading.Thread(target=self._ctl_loop, daemon=True).start()
@@ -75,7 +80,7 @@ class FakePipeline:
             self._ok.inc()
             self._h_e2e.observe(e2e)
             fut.set_result(ServeResult(
-                mask=np.zeros((4, 4), np.int8),
+                mask=np.full((4, 4), self._mask_value, np.int8),
                 timings={'e2e_ms': round(e2e, 3),
                          'device_ms': round(delay_s * 1e3, 3)},
                 meta=meta or {}))
@@ -100,13 +105,19 @@ def main() -> int:
     ap.add_argument('--ctl-file', default=None)
     ap.add_argument('--start-delay-s', type=float, default=0.0,
                     help='sleep before binding (slow-compile simulation)')
+    ap.add_argument('--artifact-version', default=None,
+                    help='stamped as X-Artifact-Version (segship tests)')
+    ap.add_argument('--mask-value', type=int, default=0,
+                    help='int8 fill of the fake mask (output divergence)')
     args = ap.parse_args()
     if args.start_delay_s > 0:
         time.sleep(args.start_delay_s)
-    pipe = FakePipeline(args.delay_ms, ctl_file=args.ctl_file)
+    pipe = FakePipeline(args.delay_ms, ctl_file=args.ctl_file,
+                        mask_value=args.mask_value)
     cmap = np.zeros((256, 3), np.uint8)
     server = make_server(pipe, host=args.host, port=args.port,
-                         colormap=cmap, replica_id=args.replica_id)
+                         colormap=cmap, replica_id=args.replica_id,
+                         artifact_version=args.artifact_version)
     port = server.server_address[1]
     if args.port_file:
         tmp = args.port_file + '.tmp'

@@ -1,0 +1,721 @@
+"""segship (rtseg_tpu/registry + fleet/split): bundle fingerprinting and
+verify (corrupt member -> red, volatile sidecar churn -> still green),
+atomic publish + channel pointers, the sticky trace-hash traffic split,
+seeded RolloutPolicy decide() tables, the atomic ExeCache hit-counter,
+load-gen per-version attribution + the canary weight gate, and the
+router-level shadow/canary/rollback/promote e2es over stub replicas
+(tests/_fleet_stub.py — the REAL serve front-end, ~0.3s per replica).
+
+The full jax path (bake -> publish -> warm serve -> golden replay ->
+auto-rollback/promote over real engines) is gated by the `segship` CI
+job and the committed segship_cpu.log.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from rtseg_tpu import obs
+from rtseg_tpu.fleet import (FleetManager, ReplicaGroup, ReplicaProcess,
+                             TrafficSplit, make_router, trace_share)
+from rtseg_tpu.obs.live import SinkTailer, format_frame, parse_prometheus
+from rtseg_tpu.obs.report import format_summary, summarize
+from rtseg_tpu.registry import (Registry, RegistryError,
+                                RolloutController, RolloutObs,
+                                RolloutPolicy, load_manifest,
+                                obs_from_version_stats,
+                                replay_golden_http, verify_bundle,
+                                write_manifest)
+from rtseg_tpu.registry import decide as rollout_decide
+from rtseg_tpu.serve import (VERSION_HEADER, bench_http, check_report)
+from rtseg_tpu.warm.exe_cache import ExeCache, _atomic_write
+
+STUB = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                    '_fleet_stub.py')
+
+
+# ------------------------------------------------------------ tiny helpers
+def stub_cmd(*extra):
+    def cmd(rid, port_file):
+        return [sys.executable, STUB, '--port-file', port_file,
+                '--replica-id', rid, *extra]
+    return cmd
+
+
+def make_manager(groups, tmp_path, **kw):
+    kw.setdefault('poll_s', 0.05)
+    kw.setdefault('restart_backoff_s', 0.05)
+    return FleetManager(groups, run_dir=str(tmp_path / 'fleet'), **kw)
+
+
+def start_router(groups, **kw):
+    router = make_router(groups, **kw)
+    threading.Thread(target=router.serve_forever, daemon=True).start()
+    return router, f'http://127.0.0.1:{router.server_address[1]}'
+
+
+def http_post(url, data=b'x', headers=None, timeout=30):
+    req = urllib.request.Request(url, data=data, method='POST',
+                                 headers=headers or {})
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def scrape(url):
+    with urllib.request.urlopen(url + '/metrics', timeout=10) as r:
+        return parse_prometheus(r.read().decode())
+
+
+def fake_bundle(tmp_path, name, payload=b'weights-v1',
+                sidecar_extra=None):
+    """A synthetic staged bundle: enough members (incl. an ExeCache
+    sidecar) to exercise fingerprinting without jax."""
+    d = tmp_path / name
+    (d / 'hlo').mkdir(parents=True)
+    (d / 'exe').mkdir()
+    (d / 'hlo' / '64x64.stablehlo').write_bytes(payload)
+    (d / 'exe' / 'abc123.exe').write_bytes(b'\x00exe' + payload)
+    (d / 'exe' / 'abc123.json').write_text(json.dumps(
+        {'key': 'abc123', 'name': 'seed', 'compile_s': 1.0,
+         'hits': 0, **(sidecar_extra or {})}))
+    (d / 'quality.json').write_text(json.dumps({'golden_pairs': 0}))
+    return str(d)
+
+
+@pytest.fixture()
+def sink(tmp_path):
+    path = str(tmp_path / 'events-000.jsonl')
+    s = obs.EventSink(path)
+    obs.set_sink(s)
+    yield path
+    obs.set_sink(None)
+    s.close()
+
+
+# ----------------------------------------------------- bundle fingerprints
+def test_manifest_verify_corrupt_member_red(tmp_path):
+    good = fake_bundle(tmp_path, 'good')
+    bad = fake_bundle(tmp_path, 'bad')
+    write_manifest(good, 'fastscnn')
+    write_manifest(bad, 'fastscnn')
+    assert verify_bundle(good) == []          # clean twin stays green
+    with open(os.path.join(bad, 'hlo', '64x64.stablehlo'), 'r+b') as f:
+        f.seek(3)
+        f.write(b'\xff')
+    problems = verify_bundle(bad)
+    assert any('64x64.stablehlo' in p and 'mismatch' in p
+               for p in problems), problems
+    # a deleted member is missing, not silently skipped
+    os.remove(os.path.join(bad, 'exe', 'abc123.exe'))
+    assert any('missing member exe/abc123.exe' in p
+               for p in verify_bundle(bad))
+    assert verify_bundle(good) == []
+
+
+def test_volatile_sidecar_churn_keeps_verify_green(tmp_path):
+    d = fake_bundle(tmp_path, 'b')
+    write_manifest(d, 'fastscnn')
+    side = os.path.join(d, 'exe', 'abc123.json')
+    meta = json.load(open(side))
+    # a serving replica bumping usage stats must NOT read as corruption
+    meta['hits'] = 17
+    meta['last_used'] = 123456.0
+    _atomic_write(side, json.dumps(meta, indent=1).encode())
+    assert verify_bundle(d) == []
+    # ...but real provenance drift must
+    meta['compile_s'] = 99.0
+    _atomic_write(side, json.dumps(meta, indent=1).encode())
+    assert any('abc123.json' in p for p in verify_bundle(d))
+
+
+def test_bundle_version_is_content_hash(tmp_path):
+    a = fake_bundle(tmp_path, 'a', payload=b'same')
+    b = fake_bundle(tmp_path, 'b', payload=b'same')
+    c = fake_bundle(tmp_path, 'c', payload=b'different')
+    va = write_manifest(a, 'fastscnn')['version']
+    vb = write_manifest(b, 'fastscnn')['version']
+    vc = write_manifest(c, 'fastscnn')['version']
+    assert va == vb                     # identical content, same version
+    assert va != vc                     # any changed byte, new version
+    assert write_manifest(a, 'other')['version'] != va
+
+
+# ------------------------------------------------------- registry + channels
+def test_publish_atomic_idempotent_and_channels(tmp_path):
+    reg = Registry(str(tmp_path / 'reg'))
+    s1 = reg.staging_dir('m')
+    # stage via the same member layout
+    os.rmdir(s1)
+    s1 = fake_bundle(tmp_path, 'stage1')
+    write_manifest(s1, 'm')
+    v1 = reg.publish('m', s1)
+    assert not os.path.exists(s1)             # staging moved, not copied
+    assert reg.versions('m') == [v1]
+    assert verify_bundle(reg.version_dir('m', v1)) == []
+    # identical content re-publish: same version, no error
+    s2 = fake_bundle(tmp_path, 'stage2')
+    write_manifest(s2, 'm')
+    assert reg.publish('m', s2) == v1
+    s3 = fake_bundle(tmp_path, 'stage3', payload=b'v2-weights')
+    write_manifest(s3, 'm')
+    v2 = reg.publish('m', s3)
+    assert sorted(reg.versions('m')) == sorted([v1, v2])
+
+    # channel pointers: atomic flips recording the previous version
+    with pytest.raises(RegistryError):
+        reg.set_channel('m', 'stable', 'nope00000000')
+    reg.set_channel('m', 'stable', v1)
+    assert reg.resolve('m', '@stable') == v1
+    ptr = reg.set_channel('m', 'stable', v2)
+    assert ptr['previous'] == v1
+    assert reg.channel('m', 'stable') == v2
+    chan_dir = os.path.join(reg.model_dir('m'), 'channels')
+    assert not [f for f in os.listdir(chan_dir) if '.tmp' in f]
+    # rollback is literally re-pointing at what the pointer recorded
+    reg.set_channel('m', 'stable', ptr['previous'])
+    assert reg.resolve('m') == v1
+    # prefix refs: unique resolves, ambiguous/unknown raises
+    assert reg.resolve('m', v2[:6]) == v2
+    with pytest.raises(RegistryError):
+        reg.resolve('m', 'zzzz')
+    with pytest.raises(RegistryError):
+        reg.resolve('m', '@canary')
+    assert reg.verify('m', '@canary')          # problems, not a raise
+    assert reg.describe('m')['versions'][v1]['members'] == 4
+
+
+# ------------------------------------------------------ sticky trace split
+def _ready_group(tmp_path, name, n=1):
+    g = ReplicaGroup(name, stub_cmd(), min_replicas=1, max_replicas=4)
+    for i in range(n):
+        r = ReplicaProcess(f'{name}-{i + 1}', argv=[],
+                           run_dir=str(tmp_path))
+        r.set_state('ready')
+        g.add(r)
+    return g
+
+
+def test_trace_hash_split_sticky_and_weighted(tmp_path):
+    ids = [f'{i:016x}' for i in range(4000)]
+    # pure + sticky: the same id always lands at the same share
+    assert all(trace_share(t) == trace_share(t) for t in ids[:64])
+    frac = sum(trace_share(t) < 0.2 for t in ids) / len(ids)
+    assert abs(frac - 0.2) < 0.03
+
+    stable = _ready_group(tmp_path, 's')
+    canary = _ready_group(tmp_path, 'c')
+    split = TrafficSplit(stable, stable_version='v1')
+    assert split.pick(ids[0]).version == 'v1'   # no canary arm yet
+    split.set_canary(canary, 'v2', 0.5)
+    arms = {t: split.pick(t).version for t in ids[:200]}
+    assert {arms[t] for t in ids[:200]} == {'v1', 'v2'}
+    assert all(split.pick(t).version == arms[t] for t in ids[:200])
+    split.set_weight(0.0)
+    assert all(split.pick(t).version == 'v1' for t in ids[:100])
+    split.set_weight(1.0)
+    assert all(split.pick(t).version == 'v2' for t in ids[:100])
+    # a canary with no ready replica falls back to stable silently
+    canary.replicas()[0].set_state('dead')
+    assert all(split.pick(t).version == 'v1' for t in ids[:100])
+
+    # shadow sampling draws from the top of the hash range
+    shadow = _ready_group(tmp_path, 'sh')
+    assert split.mirror(ids[0]) is None
+    split.set_shadow(shadow, 'v2', 1.0)
+    assert all(split.mirror(t) is not None for t in ids[:50])
+    split.set_shadow(shadow, 'v2', 0.25)
+    hits = sum(split.mirror(t) is not None for t in ids) / len(ids)
+    assert abs(hits - 0.25) < 0.03
+    split.clear_shadow()
+    assert split.mirror(ids[0]) is None
+
+
+def test_split_promote_and_clear(tmp_path):
+    stable = _ready_group(tmp_path, 's')
+    canary = _ready_group(tmp_path, 'c')
+    split = TrafficSplit(stable, stable_version='v1')
+    with pytest.raises(ValueError):
+        split.promote_canary()
+    with pytest.raises(ValueError):
+        split.set_canary(canary, 'v2', 1.5)
+    split.set_canary(canary, 'v2', 0.3)
+    assert split.versions() == ['v1', 'v2']
+    prev = split.promote_canary()
+    assert prev.version == 'v1' and prev.group is stable
+    assert split.stable_arm().version == 'v2'
+    assert split.stable_arm().group is canary
+    assert split.canary_arm() is None and split.canary_weight == 0.0
+    # clear on a fresh canary returns the arm for draining
+    split.set_canary(stable, 'v3', 0.1)
+    arm = split.clear_canary()
+    assert arm.version == 'v3' and split.canary_arm() is None
+
+
+# ------------------------------------------------------ decide() tables
+def _pol(**kw):
+    base = dict(p99_regress_frac=0.5, p99_floor_ms=50.0,
+                max_error_frac=0.0, max_disagree_frac=0.02,
+                min_canary_ok=10, min_stable_ok=10,
+                breach_consecutive=2, clean_consecutive=2)
+    base.update(kw)
+    return RolloutPolicy(**base)
+
+
+def test_decide_rollback_on_regression_promote_on_clean():
+    pol = _pol()
+    # seeded p99 regression: one breach holds, a sustained one rolls back
+    hot = RolloutObs(stable_ok=50, canary_ok=20, stable_p99_ms=100.0,
+                     canary_p99_ms=400.0)
+    a, reason, streak = rollout_decide(hot, pol, (0, 0))
+    assert a == 'hold' and 'breach' in reason and streak == (1, 0)
+    a, reason, _ = rollout_decide(hot, pol, streak)
+    assert a == 'rollback' and 'p99' in reason
+    # clean twin: same traffic, canary p99 inside the envelope -> promote
+    ok = RolloutObs(stable_ok=50, canary_ok=20, stable_p99_ms=100.0,
+                    canary_p99_ms=120.0)
+    a, _, streak = rollout_decide(ok, pol, (0, 0))
+    assert a == 'hold' and streak == (0, 1)
+    a, reason, _ = rollout_decide(ok, pol, streak)
+    assert a == 'promote' and 'clean' in reason
+
+
+def test_decide_error_rate_is_immediate_rollback():
+    pol = _pol()
+    bad = RolloutObs(stable_ok=50, canary_ok=19, canary_errors=1)
+    a, reason, _ = rollout_decide(bad, pol, (0, 5))
+    assert a == 'rollback' and 'errored' in reason
+    # clean twin: zero errors never trips the error gate
+    a, _, _ = rollout_decide(
+        RolloutObs(stable_ok=50, canary_ok=20), pol, (0, 1))
+    assert a == 'promote'
+
+
+def test_decide_canary_timeouts_are_evidence():
+    pol = _pol()
+    # a hung canary never accumulates oks — its 504s must still breach
+    # (differentially vs stable), not hold at 'warming' forever
+    hung = RolloutObs(stable_ok=50, canary_ok=0, canary_dropped=12)
+    a, reason, streak = rollout_decide(hung, pol, (0, 0))
+    assert a == 'hold' and 'drop rate' in reason
+    a, reason, _ = rollout_decide(hung, pol, streak)
+    assert a == 'rollback' and 'drop rate' in reason
+    # clean twin: client-caused deadline drops hit both arms alike and
+    # cancel out of the differential
+    even = RolloutObs(stable_ok=40, stable_dropped=10,
+                      canary_ok=16, canary_dropped=4)
+    a, _, _ = rollout_decide(even, pol, (0, 1))
+    assert a == 'promote'
+
+
+def test_decide_holds_while_warming_and_on_disagreement():
+    pol = _pol()
+    a, reason, _ = rollout_decide(
+        RolloutObs(stable_ok=50, canary_ok=3), pol, (0, 0))
+    assert a == 'hold' and 'warming' in reason
+    # shadow disagreement over threshold: sustained -> rollback
+    dis = RolloutObs(stable_ok=50, canary_ok=20, shadow_total=40,
+                     shadow_disagree=10)
+    a, _, streak = rollout_decide(dis, pol, (0, 0))
+    assert a == 'hold'
+    a, reason, _ = rollout_decide(dis, pol, streak)
+    assert a == 'rollback' and 'disagreement' in reason
+    # clean twin: under threshold promotes
+    agree = RolloutObs(stable_ok=50, canary_ok=20, shadow_total=40,
+                       shadow_disagree=0)
+    a, _, s = rollout_decide(agree, pol, (0, 1))
+    assert a == 'promote'
+
+
+def test_decide_golden_mismatch_blocks_promote():
+    pol = _pol()
+    bad = RolloutObs(stable_ok=50, canary_ok=20, golden_ok=False)
+    a, _, streak = rollout_decide(bad, pol, (0, 5))
+    assert a == 'hold'
+    a, reason, _ = rollout_decide(bad, pol, streak)
+    assert a == 'rollback' and 'golden' in reason
+    good = RolloutObs(stable_ok=50, canary_ok=20, golden_ok=True)
+    a, _, _ = rollout_decide(good, pol, (0, 1))
+    assert a == 'promote'
+
+
+def test_obs_from_version_stats_mapping():
+    stats = {'v1': {'ok': 30, 'error': 0, 'unreachable': 0,
+                    'p99_ms': 90.0},
+             'v2': {'ok': 7, 'error': 1, 'unreachable': 2,
+                    'p99_ms': 500.0},
+             'shadow': {'agree': 9, 'disagree': 3, 'error': 0}}
+    o = obs_from_version_stats(stats, 'v1', 'v2')
+    assert (o.stable_ok, o.canary_ok, o.canary_errors) == (30, 7, 3)
+    assert o.stable_p99_ms == 90.0 and o.canary_p99_ms == 500.0
+    assert (o.shadow_total, o.shadow_disagree) == (12, 3)
+
+
+# --------------------------------------------------- exe-cache hit counter
+def test_bump_hit_concurrent_exact_and_never_torn(tmp_path):
+    cache = ExeCache(str(tmp_path / 'exe'))
+    key = 'deadbeef' * 8
+    _atomic_write(cache._meta_path(key),
+                  json.dumps({'key': key, 'hits': 0}).encode())
+    n_threads, per = 8, 25
+    start = threading.Barrier(n_threads)
+
+    def worker():
+        start.wait()
+        for _ in range(per):
+            cache._bump_hit(key)
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    with open(cache._meta_path(key)) as f:
+        meta = json.load(f)                   # parseable == never torn
+    # the advisory flock makes the RMW exact: no lost increments
+    assert meta['hits'] == n_threads * per
+    assert 'last_used' in meta
+
+
+# ------------------------------------- loadgen per-version + weight gate
+def test_loadgen_per_version_attribution_and_weight_gate(tmp_path):
+    g1 = ReplicaGroup('a', stub_cmd('--artifact-version', 'v1'),
+                      min_replicas=1, max_replicas=1)
+    g2 = ReplicaGroup('b', stub_cmd('--artifact-version', 'v2'),
+                      min_replicas=1, max_replicas=1)
+    mgr = make_manager([g1, g2], tmp_path)
+    try:
+        mgr.start()
+        r1 = mgr.wait_ready('a', 1, timeout_s=30)[0]
+        r2 = mgr.wait_ready('b', 1, timeout_s=30)[0]
+        # client-side round-robin over the two "versions": 10 + 10
+        report = bench_http([r1.url, r2.url], [b'img'], requests=20,
+                            rps=400, seed=0)
+        assert report['ok'] == 20 and report['errors'] == 0
+        assert report['per_version'] == {'v1': 10, 'v2': 10}
+        # the split-weight gate: 0.5 observed
+        assert check_report(report, p95_ms=10000, canary_version='v2',
+                            canary_weight=0.5,
+                            canary_weight_tol=0.05) == []
+        problems = check_report(report, p95_ms=10000,
+                                canary_version='v2', canary_weight=0.1,
+                                canary_weight_tol=0.05)
+        assert any('configured weight' in p for p in problems)
+    finally:
+        mgr.stop(drain=False)
+
+
+# --------------------------------------- router: canary split over stubs
+def test_router_canary_split_versions_reconcile(tmp_path, sink):
+    gs = ReplicaGroup('m', stub_cmd('--artifact-version', 'v1'),
+                      min_replicas=1, max_replicas=1)
+    gc = ReplicaGroup('m-canary', stub_cmd('--artifact-version', 'v2'),
+                      min_replicas=1, max_replicas=1)
+    mgr = make_manager([gs], tmp_path)
+    router = None
+    try:
+        mgr.start()
+        mgr.wait_ready('m', 1, timeout_s=30)
+        mgr.add_group(gc)
+        mgr.wait_ready('m-canary', 1, timeout_s=30)
+        split = TrafficSplit(gs, stable_version='v1')
+        router, base = start_router({'m': split})
+        router.configure_canary('m', gc, 'v2', 0.5)
+        # sticky: one trace id answers from the same version every time
+        tid = 'feedface' * 2
+        versions = set()
+        for _ in range(3):
+            with http_post(base + '/predict',
+                           headers={'X-Trace-Id': tid}) as r:
+                versions.add(r.headers[VERSION_HEADER])
+                r.read()
+        assert len(versions) == 1
+        report = bench_http(base, [b'img'], requests=60, rps=400, seed=3)
+        assert report['ok'] == 60 and report['errors'] == 0
+        pv = report['per_version']
+        assert set(pv) == {'v1', 'v2'} and sum(pv.values()) == 60
+        # router per-version counters mirror the client's view exactly
+        # (+3 for the traced posts above, on whichever arm their sticky
+        # hash picked)
+        parsed = scrape(base)
+        by_version = {lab['version']: int(v)
+                      for lab, v in parsed['fleet_requests_total']
+                      if lab['status'] == 'ok'}
+        traced_v = versions.pop()
+        assert by_version == {
+            v: pv.get(v, 0) + (3 if v == traced_v else 0)
+            for v in ('v1', 'v2')}
+        assert check_report(report, p95_ms=10000, canary_version='v2',
+                            canary_weight=0.5,
+                            canary_weight_tol=0.2) == []
+        stats = router.stats()['groups']['m']
+        assert stats['canary']['version'] == 'v2'
+        assert set(stats['by_version']) == {'v1', 'v2'}
+    finally:
+        if router is not None:
+            router.shutdown()
+        mgr.stop(drain=False)
+
+
+def test_router_shadow_mirror_detects_divergence(tmp_path, sink):
+    gs = ReplicaGroup('m', stub_cmd('--artifact-version', 'v1',
+                                    '--mask-value', '0'),
+                      min_replicas=1, max_replicas=1)
+    gsh = ReplicaGroup('m-shadow',
+                       stub_cmd('--artifact-version', 'v2',
+                                '--mask-value', '3'),
+                       min_replicas=1, max_replicas=1)
+    mgr = make_manager([gs], tmp_path)
+    router = None
+    try:
+        mgr.start()
+        mgr.wait_ready('m', 1, timeout_s=30)
+        mgr.add_group(gsh)
+        mgr.wait_ready('m-shadow', 1, timeout_s=30)
+        router, base = start_router({'m': TrafficSplit(gs, 'v1')})
+        router.configure_shadow('m', gsh, 'v2', 1.0)
+        report = bench_http(base, [b'img'], requests=12, rps=200,
+                            seed=0, query='raw=1')
+        assert report['ok'] == 12 and report['errors'] == 0
+        deadline = time.monotonic() + 30
+        sh = {}
+        while time.monotonic() < deadline:
+            sh = router.version_stats('m').get('shadow', {})
+            if sh.get('agree', 0) + sh.get('disagree', 0) \
+                    + sh.get('error', 0) >= 12:
+                break
+            time.sleep(0.05)
+        # every mirrored raw mask diverged (mask 3 vs 0), users only
+        # ever saw v1 answers
+        assert sh.get('disagree') == 12 and sh.get('agree', 0) == 0
+        assert sh.get('agree_frac') == 0.0
+        assert set(report['per_version']) == {'v1'}
+        # clean twin: a shadow that computes the same masks bit-agrees
+        router.groups['m'].clear_shadow()
+        gok = ReplicaGroup('m-shadow2',
+                           stub_cmd('--artifact-version', 'v3',
+                                    '--mask-value', '0'),
+                           min_replicas=1, max_replicas=1)
+        mgr.add_group(gok)
+        mgr.wait_ready('m-shadow2', 1, timeout_s=30)
+        router.configure_shadow('m', gok, 'v3', 1.0)
+        before = router.version_stats('m')['shadow']
+        report = bench_http(base, [b'img'], requests=8, rps=200,
+                            seed=1, query='raw=1')
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            sh = router.version_stats('m').get('shadow', {})
+            if sh.get('agree', 0) >= before.get('agree', 0) + 8:
+                break
+            time.sleep(0.05)
+        assert sh['agree'] >= 8 and sh['disagree'] == before['disagree']
+        assert sh.get('agree_frac') == 1.0
+    finally:
+        if router is not None:
+            router.shutdown()
+        mgr.stop(drain=False)
+
+
+# ---------------------------------- rollout controller e2e (stub fleet)
+def _publish_fake(reg, tmp_path, model, name, payload):
+    staging = fake_bundle(tmp_path, name, payload=payload)
+    write_manifest(staging, model)
+    return reg.publish(model, staging)
+
+
+def test_rollout_rollback_mid_traffic_zero_errors(tmp_path, sink):
+    reg = Registry(str(tmp_path / 'reg'))
+    v1 = _publish_fake(reg, tmp_path, 'm', 's1', b'v1')
+    v2 = _publish_fake(reg, tmp_path, 'm', 's2', b'v2')
+    reg.set_channel('m', 'stable', v1)
+    gs = ReplicaGroup('m', stub_cmd('--artifact-version', v1),
+                      min_replicas=1, max_replicas=1)
+    gc = ReplicaGroup('m-canary',
+                      stub_cmd('--artifact-version', v2,
+                               '--delay-ms', '300'),
+                      min_replicas=1, max_replicas=1)
+    mgr = make_manager([gs], tmp_path)
+    router = None
+    ctl = None
+    try:
+        mgr.start()
+        mgr.wait_ready('m', 1, timeout_s=30)
+        mgr.add_group(gc)
+        mgr.wait_ready('m-canary', 1, timeout_s=30)
+        split = TrafficSplit(gs, stable_version=v1)
+        router, base = start_router({'m': split})
+        router.configure_canary('m', gc, v2, 0.5)
+        pol = RolloutPolicy(p99_regress_frac=0.5, p99_floor_ms=50.0,
+                            min_canary_ok=5, min_stable_ok=5,
+                            breach_consecutive=2, clean_consecutive=999)
+        ctl = RolloutController(router, mgr, reg, 'm', v2, 'm-canary',
+                                policy=pol, poll_s=0.1)
+        ctl.start()
+        # the seeded regression (300ms canary) rolls back MID-bench;
+        # the canary hash slice must fall back to stable with 0 errors
+        report = bench_http(base, [b'img'], requests=80, rps=40, seed=0)
+        outcome = ctl.wait(timeout_s=60)
+        assert outcome is not None and outcome[0] == 'rollback', outcome
+        assert 'p99' in outcome[1]
+        assert report['errors'] == 0 and report['ok'] == 80
+        assert set(report['per_version']) == {v1, v2}
+        # canary group was drained out of the manager, channel untouched
+        deadline = time.monotonic() + 30
+        while 'm-canary' in mgr.groups and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert 'm-canary' not in mgr.groups
+        assert reg.channel('m', 'stable') == v1
+        assert split.canary_arm() is None
+        # post-rollback traffic: one version, zero errors
+        with http_post(base + '/predict') as r:
+            assert r.headers[VERSION_HEADER] == v1
+            r.read()
+    finally:
+        if ctl is not None:
+            ctl.stop()
+        if router is not None:
+            router.shutdown()
+        mgr.stop(drain=False)
+    evs = [json.loads(line) for line in open(sink) if '"rollout"' in line]
+    actions = [e['action'] for e in evs if e.get('event') == 'rollout']
+    assert 'canary_start' in actions and 'rollback' in actions
+    rb = next(e for e in evs if e.get('action') == 'rollback')
+    assert rb['version'] == v2 and rb['group'] == 'm'
+
+
+def test_rollout_promote_flips_channel_and_split(tmp_path, sink):
+    reg = Registry(str(tmp_path / 'reg'))
+    v1 = _publish_fake(reg, tmp_path, 'm', 's1', b'v1')
+    v2 = _publish_fake(reg, tmp_path, 'm', 's2', b'v2')
+    reg.set_channel('m', 'stable', v1)
+    gs = ReplicaGroup('m', stub_cmd('--artifact-version', v1),
+                      min_replicas=1, max_replicas=1)
+    gc = ReplicaGroup('m-canary', stub_cmd('--artifact-version', v2),
+                      min_replicas=1, max_replicas=1)
+    mgr = make_manager([gs], tmp_path)
+    router = None
+    ctl = None
+    try:
+        mgr.start()
+        mgr.wait_ready('m', 1, timeout_s=30)
+        mgr.add_group(gc)
+        mgr.wait_ready('m-canary', 1, timeout_s=30)
+        split = TrafficSplit(gs, stable_version=v1)
+        router, base = start_router({'m': split})
+        router.configure_canary('m', gc, v2, 0.5)
+        pol = RolloutPolicy(p99_regress_frac=2.0, p99_floor_ms=1000.0,
+                            min_canary_ok=5, min_stable_ok=5,
+                            breach_consecutive=2, clean_consecutive=2)
+        ctl = RolloutController(router, mgr, reg, 'm', v2, 'm-canary',
+                                old_stable_group='m', policy=pol,
+                                poll_s=0.05)
+        # prime marks the starting line BEFORE traffic (the controller
+        # judges only post-prime deltas, so starting the polling thread
+        # after the bench still sees the bench)
+        ctl.prime()
+        report = bench_http(base, [b'img'], requests=60, rps=300, seed=0)
+        assert report['errors'] == 0
+        ctl.start()
+        outcome = ctl.wait(timeout_s=60)
+        assert outcome is not None and outcome[0] == 'promote', outcome
+        # the registry channel flipped, the split promoted, the old
+        # stable group drained away — and traffic now answers as v2
+        assert reg.channel('m', 'stable') == v2
+        assert split.stable_arm().version == v2
+        assert split.canary_arm() is None
+        deadline = time.monotonic() + 30
+        while 'm' in mgr.groups and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert 'm' not in mgr.groups and 'm-canary' in mgr.groups
+        with http_post(base + '/predict') as r:
+            assert r.headers[VERSION_HEADER] == v2
+            r.read()
+    finally:
+        if ctl is not None:
+            ctl.stop()
+        if router is not None:
+            router.shutdown()
+        mgr.stop(drain=False)
+    evs = [json.loads(line) for line in open(sink) if '"rollout"' in line]
+    actions = [e['action'] for e in evs if e.get('event') == 'rollout']
+    assert 'promote' in actions and 'rollback' not in actions
+    pr = next(e for e in evs if e.get('action') == 'promote')
+    assert pr['version'] == v2 and pr['previous'] == v1
+
+
+# ------------------------------------------------- golden replay over HTTP
+def test_replay_golden_http_bit_gate(tmp_path):
+    bundle = tmp_path / 'bundle'
+    gdir = bundle / 'golden'
+    gdir.mkdir(parents=True)
+    (gdir / 'g000.png').write_bytes(b'payload-any-bytes')
+    np.save(gdir / 'g000.mask.npy', np.zeros((4, 4), np.int8))
+    g = ReplicaGroup('m', stub_cmd('--mask-value', '0'),
+                     min_replicas=1, max_replicas=1)
+    mgr = make_manager([g], tmp_path)
+    try:
+        mgr.start()
+        r = mgr.wait_ready('m', 1, timeout_s=30)[0]
+        res = replay_golden_http(r.url, str(bundle))
+        assert res == {'pairs': 1, 'agree': 1, 'bit_identical': True,
+                       'mismatches': []}
+        # negative control: an expectation the replica can't reproduce
+        np.save(gdir / 'g000.mask.npy', np.full((4, 4), 7, np.int8))
+        res = replay_golden_http(r.url, str(bundle))
+        assert res['bit_identical'] is False and res['agree'] == 0
+        assert res['mismatches'] and 'agreement 0.0000' \
+            in res['mismatches'][0]
+    finally:
+        mgr.stop(drain=False)
+
+
+# --------------------------------------------------- obs rollout surfaces
+def test_report_and_live_render_rollout_sections(tmp_path):
+    path = tmp_path / 'events-000.jsonl'
+    evs = [
+        {'event': 'run_start', 'ts': 1.0, 'model': 'fastscnn'},
+        {'event': 'rollout', 'action': 'canary_start', 'group': 'm',
+         'version': 'v2', 'weight': 0.2, 'ts': 2.0},
+        {'event': 'rollout', 'action': 'rollback', 'group': 'm',
+         'version': 'v2', 'reason': 'canary p99 900ms > 200ms',
+         'ts': 3.0},
+        {'event': 'run_end', 'ts': 4.0, 'wall_s': 3.0},
+    ]
+    with open(path, 'w') as f:
+        for e in evs:
+            f.write(json.dumps(e) + '\n')
+    s = summarize(evs)
+    assert s['rollout']['actions'] == {'canary_start': 1, 'rollback': 1}
+    assert s['rollout']['last_action'] == 'rollback'
+    assert s['rollout']['last_version'] == 'v2'
+    text = format_summary(s)
+    assert 'rollout' in text and 'rollback v2' in text
+    tailer = SinkTailer(str(path))
+    frame = tailer.poll()
+    assert frame['rollout']['actions']['rollback'] == 1
+    assert frame['rollout']['last']['action'] == 'rollback'
+    assert 'rollback v2' in format_frame(frame)
+    # clean twin: a run with no rollout events renders no section
+    s2 = summarize([e for e in evs if e['event'] != 'rollout'])
+    assert s2['rollout'] is None
+    assert 'rollout' not in format_summary(s2)
+
+
+# ------------------------------------------------------------ lint scope
+def test_concurrency_lint_covers_registry():
+    from rtseg_tpu.analysis.concurrency import TARGET_PREFIXES
+    assert 'rtseg_tpu/registry/' in TARGET_PREFIXES
+
+
+def test_registry_manifest_roundtrip_helpers(tmp_path):
+    d = fake_bundle(tmp_path, 'b')
+    m = write_manifest(d, 'fastscnn', meta={'buckets': ['64x64'],
+                                            'batch': 4})
+    assert load_manifest(d) == m
+    assert m['meta']['buckets'] == ['64x64']
+    assert all(set(v) == {'sha256', 'bytes'}
+               for v in m['members'].values())

@@ -114,13 +114,9 @@ def _scrape(url: str) -> dict:
 
 
 def _replica_ok_sum(replicas) -> int:
-    total = 0
-    for r in replicas:
-        parsed = _scrape(r.url)
-        total += int(next(
-            (v for lab, v in parsed.get('serve_requests_total', ())
-             if lab.get('status') == 'ok'), 0))
-    return total
+    from rtseg_tpu.obs.live import scrape_counter_sum
+    return scrape_counter_sum([r.url for r in replicas],
+                              'serve_requests_total', status='ok')
 
 
 def _router_counts(url: str, group: str) -> dict:

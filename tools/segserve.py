@@ -61,6 +61,18 @@ def _add_engine_args(p: argparse.ArgumentParser) -> None:
     p.add_argument('--artifact', default=None,
                    help='StableHLO artifact (tools/export.py); bucket and '
                         'batch come from its input shape')
+    p.add_argument('--bundle', default=None, metavar='DIR',
+                   help='segship ArtifactBundle directory (a published '
+                        'registry version — tools/segship.py bake): '
+                        'buckets/batch/dtype come from its manifest, the '
+                        'engine deserializes its baked executables, and '
+                        'every response carries the bundle version in '
+                        'X-Artifact-Version')
+    p.add_argument('--artifact-version', default=None,
+                   help='version stamped into every response as '
+                        'X-Artifact-Version (defaults to the --bundle '
+                        'manifest version; per-version attribution in '
+                        'the load-gen report and the fleet router)')
     p.add_argument('--buckets', default='512x1024',
                    help='comma-separated HxW buckets, e.g. 512x1024,256x512')
     p.add_argument('--batch', type=int, default=8,
@@ -136,12 +148,28 @@ def cmd_serve(args) -> int:
             'serve': True, 'model': args.model, 'buckets': args.buckets,
             'batch': args.batch})
         obs.set_sink(sink)
-    cfg = _build_config(args)
-    engine = _build_engine(args, cfg)
+    version = args.artifact_version
+    if args.bundle:
+        # a published segship bundle is self-describing: engine geometry
+        # and dtype come from its manifest, the serialized executables
+        # deserialize through its own exe/ cache, and the content-hash
+        # version attributes every response
+        from rtseg_tpu.registry import bundle_serve_config, load_engine
+        engine, manifest = load_engine(
+            args.bundle, compile_workers=args.compile_workers)
+        cfg = bundle_serve_config(manifest)
+        args.model = cfg.model
+        args.buckets = ','.join(manifest['meta']['buckets'])
+        if version is None:
+            version = manifest['version']
+    else:
+        cfg = _build_config(args)
+        engine = _build_engine(args, cfg)
     pipeline = _build_pipeline(args, cfg, engine)
     server = make_server(pipeline, host=args.host, port=args.port,
                          colormap=get_colormap(cfg),
-                         replica_id=args.replica_id)
+                         replica_id=args.replica_id,
+                         artifact_version=version)
     host, port = server.server_address[:2]
     if args.port_file:
         # --port 0 binds an ephemeral port; a fleet manager discovers it
@@ -152,6 +180,8 @@ def cmd_serve(args) -> int:
             f.write(f'{port}\n')
         os.replace(tmp, args.port_file)
     rid = f' | replica {args.replica_id}' if args.replica_id else ''
+    if version:
+        rid += f' | version {version}'
     print(f'segserve: {cfg.model} on http://{host}:{port}{rid} | buckets '
           f'{args.buckets} x batch {engine.batch} | POST /predict /drain '
           f'/debug/profile?ms=, GET /healthz /stats /metrics', flush=True)

@@ -1,0 +1,577 @@
+#!/usr/bin/env python
+"""segship — versioned artifact registry + canary/shadow rollout CLI.
+
+Usage:
+    # bake one model into a content-hashed ArtifactBundle and publish it
+    python tools/segship.py bake --registry /var/segship \
+        --model fastscnn --num_class 19 --buckets 512x1024,256x512 \
+        --batch 8 --ckpt save/best.ckpt --channel stable
+
+    # registry contents: versions, sizes, channel pointers
+    python tools/segship.py list --registry /var/segship [--model M]
+
+    # re-hash every member of a published bundle (deploy gate)
+    python tools/segship.py verify --registry /var/segship \
+        --model fastscnn --ref @stable
+
+    # point a channel at a version (atomic tmp+rename pointer flip)
+    python tools/segship.py set-channel --registry /var/segship \
+        --model fastscnn --channel canary --ref 0a1b2c
+
+    # the rollout e2e (CI + BENCHMARKS.md "Canary rollout methodology"):
+    # spawn the @stable fleet, shadow-mirror a sample of live traffic to
+    # the candidate (outputs compared bit-for-bit, users only ever see
+    # stable), then canary it at --weight with the RolloutController
+    # watching per-version p99/errors/disagreement — auto-rollback on
+    # regression, golden-replay-gated promote on clean
+    python tools/segship.py rollout --registry /var/segship \
+        --model fastscnn --canary @canary --weight 0.2 \
+        --shadow-sample 0.3 --requests 200 --rps 40 \
+        --expect promote --check
+
+Replicas are real `tools/segserve.py serve --bundle` subprocesses: the
+bundle manifest fixes buckets/batch/dtype, the baked executables
+deserialize through the bundle's own exe/ cache, and every response
+carries X-Artifact-Version. Rollout transitions land as `rollout` events
+in the segscope sink (--obs-dir), next to the `fleet` lifecycle events
+they cause.
+
+Exit codes: 0 ok, 1 --check/verify failed, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from rtseg_tpu import obs                                      # noqa: E402
+from rtseg_tpu.fleet import (FleetManager, ReplicaGroup,       # noqa: E402
+                             TrafficSplit, make_router)
+from rtseg_tpu.registry import (Registry, RolloutController,   # noqa: E402
+                                RolloutPolicy, bake_model)
+from rtseg_tpu.registry.bundle import _f32_payloads            # noqa: E402
+from rtseg_tpu.serve import (bench_http, check_report,         # noqa: E402
+                             format_report, parse_buckets)
+
+_SEGSERVE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         'segserve.py')
+
+
+# -------------------------------------------------------------------- bake
+def cmd_bake(args) -> int:
+    reg = Registry(args.registry)
+    staging = reg.staging_dir(args.model)
+    t0 = time.perf_counter()
+    manifest = bake_model(
+        staging, args.model, args.num_class,
+        parse_buckets(args.buckets), args.batch,
+        compute_dtype=args.compute_dtype, ckpt_path=args.ckpt,
+        golden=args.golden, seed=args.seed,
+        perturb=args.perturb, perturb_seed=args.perturb_seed,
+        miou=args.miou)
+    version = reg.publish(args.model, staging)
+    dur = time.perf_counter() - t0
+    members = manifest['members']
+    total = sum(int(m['bytes']) for m in members.values())
+    line = (f'segship bake — {args.model} -> version {version} | '
+            f'{len(members)} members, {total / 2**20:.1f} MiB, '
+            f'{manifest["meta"]["buckets"]} x batch '
+            f'{manifest["meta"]["batch"]} | {dur:.1f} s')
+    if args.perturb:
+        line += f' | perturb {args.perturb}@{args.perturb_seed}'
+    print(line, flush=True)
+    if args.channel:
+        reg.set_channel(args.model, args.channel, version)
+        print(f'  channel {args.channel} -> {version}', flush=True)
+    if args.json:
+        print(json.dumps({'version': version,
+                          'meta': manifest['meta']}, indent=2))
+    return 0
+
+
+# -------------------------------------------------------------------- list
+def cmd_list(args) -> int:
+    reg = Registry(args.registry)
+    models = [args.model] if args.model else reg.models()
+    out = {m: reg.describe(m) for m in models}
+    if args.json:
+        print(json.dumps(out, indent=2))
+        return 0
+    for m, d in out.items():
+        chans = {c: p.get('version') for c, p in d['channels'].items()}
+        print(f'segship — {m} | channels {chans or "{}"}')
+        for v, info in d['versions'].items():
+            tags = ''.join(f' @{c}' for c, pv in chans.items() if pv == v)
+            print(f'  {v}{tags}: {info.get("members")} members '
+                  f'{info.get("bytes", 0) / 2**20:.1f} MiB | buckets '
+                  f'{info.get("buckets")} batch {info.get("batch")}'
+                  + (f' | perturb {info["perturb"]}'
+                     if info.get('perturb') else ''))
+    return 0
+
+
+# ------------------------------------------------------------------ verify
+def cmd_verify(args) -> int:
+    reg = Registry(args.registry)
+    problems = reg.verify(args.model, args.ref)
+    version = None
+    try:
+        version = reg.resolve(args.model, args.ref)
+    except Exception:   # noqa: BLE001 — the problem list already says
+        pass
+    if problems:
+        print(f'segship verify FAILED — {args.model} '
+              f'{args.ref or "@stable"} ({version}): '
+              + '; '.join(problems), file=sys.stderr, flush=True)
+        return 1
+    print(f'segship verify OK — {args.model} {args.ref or "@stable"} '
+          f'({version}): every member re-hashed clean', flush=True)
+    return 0
+
+
+def cmd_set_channel(args) -> int:
+    reg = Registry(args.registry)
+    version = reg.resolve(args.model, args.ref)
+    pointer = reg.set_channel(args.model, args.channel, version)
+    print(f'segship: {args.model} channel {args.channel} -> {version} '
+          f'(was {pointer.get("previous")})', flush=True)
+    return 0
+
+
+# ----------------------------------------------------------------- rollout
+def _bundle_spawn_cmd(bundle_dir: str, args, max_wait_ms: float):
+    def cmd(rid: str, port_file: str):
+        return [sys.executable, _SEGSERVE, 'serve',
+                '--bundle', bundle_dir,
+                '--host', '127.0.0.1', '--port', '0',
+                '--port-file', port_file,
+                '--replica-id', rid,
+                '--max-wait-ms', str(max_wait_ms),
+                '--max-queue', str(args.max_queue),
+                '--workers', str(args.workers)]
+    return cmd
+
+
+def _scrape_ok(replicas) -> int:
+    from rtseg_tpu.obs.live import scrape_counter_sum
+    return scrape_counter_sum([r.url for r in replicas],
+                              'serve_requests_total', status='ok')
+
+
+def _ok_by_version(router, group: str) -> dict:
+    return {v: int(st.get('ok', 0))
+            for v, st in router.version_stats(group).items()
+            if v != 'shadow'}
+
+
+def cmd_rollout(args) -> int:
+    obs_dir = args.obs_dir or '/tmp/segship_rollout/segscope'
+    sink = obs.init_run(obs_dir, meta={
+        'segship': True, 'model': args.model, 'weight': args.weight,
+        'shadow_sample': args.shadow_sample})
+    obs.set_sink(sink)
+    reg = Registry(args.registry)
+    stable_v = reg.resolve(args.model, args.stable)
+    canary_v = reg.resolve(args.model, args.canary)
+    if stable_v == canary_v:
+        print(f'segship: stable and canary both resolve to {stable_v}; '
+              f'nothing to roll out', file=sys.stderr)
+        return 2
+    stable_dir = reg.version_dir(args.model, stable_v)
+    canary_dir = reg.version_dir(args.model, canary_v)
+    problems = []
+    for tag, ref in (('stable', stable_v), ('canary', canary_v)):
+        bad = reg.verify(args.model, ref)
+        if bad:
+            # never roll out (or keep serving) a corrupt bundle
+            print(f'segship: {tag} bundle failed verify: '
+                  + '; '.join(bad), file=sys.stderr)
+            return 1
+    payloads = _f32_payloads(stable_dir)
+    if not payloads:
+        print('segship: stable bundle has no golden payloads to drive '
+              'traffic with', file=sys.stderr)
+        return 2
+
+    stable_channel_before = reg.channel(args.model, 'stable')
+    group = args.model
+    stable_rg = ReplicaGroup(
+        group, _bundle_spawn_cmd(stable_dir, args, args.max_wait_ms),
+        min_replicas=args.replicas, max_replicas=max(args.replicas, 4))
+    canary_rg = ReplicaGroup(
+        f'{group}-canary',
+        _bundle_spawn_cmd(canary_dir, args, args.canary_max_wait_ms
+                          if args.canary_max_wait_ms is not None
+                          else args.max_wait_ms),
+        min_replicas=args.canary_replicas,
+        max_replicas=max(args.canary_replicas, 4))
+    manager = FleetManager([stable_rg], run_dir=args.run_dir,
+                           drain_grace_s=args.drain_grace_s)
+    split = TrafficSplit(stable_rg, stable_version=stable_v)
+    router = None
+    controller = None
+    report = {'model': args.model, 'stable': stable_v,
+              'canary': canary_v, 'weight': args.weight}
+    t_start = time.perf_counter()
+    try:
+        manager.start()
+        replicas = manager.wait_ready(group, args.replicas,
+                                      timeout_s=args.ready_timeout_s)
+        manager.add_group(canary_rg)
+        canaries = manager.wait_ready(canary_rg.name,
+                                      args.canary_replicas,
+                                      timeout_s=args.ready_timeout_s)
+        report['spinup'] = {
+            **{r.replica_id: round(r.ready_s, 2) for r in replicas},
+            **{r.replica_id: round(r.ready_s, 2) for r in canaries}}
+        router = make_router({group: split}, host='127.0.0.1',
+                             port=args.port,
+                             max_outstanding=args.max_outstanding)
+        threading.Thread(target=router.serve_forever,
+                         daemon=True).start()
+        url = f'http://127.0.0.1:{router.server_address[1]}'
+        print(f'segship rollout — {args.model}: stable {stable_v} '
+              f'({len(replicas)} replicas) vs canary {canary_v} '
+              f'({len(canaries)}) | router {url} | spin-up '
+              + ' '.join(f'{k}={v}s'
+                         for k, v in report['spinup'].items()),
+              flush=True)
+
+        # ---- phase S: shadow — mirror a sample of stable traffic to
+        # the candidate; users only ever get stable answers
+        if args.shadow_sample > 0:
+            router.configure_shadow(group, canary_rg, canary_v,
+                                    args.shadow_sample)
+            before_can = _scrape_ok(canaries)
+            shadow_bench = bench_http(url, payloads,
+                                      args.shadow_requests, args.rps,
+                                      seed=args.seed, query='raw=1')
+            # mirrors are daemon threads (and the canary batcher may
+            # hold them a full coalescing window): wait for QUIESCENCE —
+            # two consecutive polls where the router's compare tally and
+            # the canary replicas' serve count agree and stopped moving
+            deadline = time.monotonic() + 60
+            counts = {}
+            last = (-1, -1)
+            while time.monotonic() < deadline:
+                counts = dict(router.version_stats(group)
+                              .get('shadow', {}))
+                n = sum(int(counts.get(k, 0))
+                        for k in ('agree', 'disagree', 'error'))
+                delta = _scrape_ok(canaries) - before_can
+                if n and n == delta and (n, delta) == last:
+                    break
+                last = (n, delta)
+                time.sleep(0.25)
+            router.groups[group].clear_shadow()
+            mirrors = sum(int(counts.get(k, 0))
+                          for k in ('agree', 'disagree', 'error'))
+            report['shadow'] = {
+                'requests': shadow_bench['requests'],
+                'ok': shadow_bench['ok'],
+                'errors': shadow_bench['errors'],
+                'mirrors': mirrors,
+                'canary_serve_delta': _scrape_ok(canaries) - before_can,
+                **{k: int(v) for k, v in counts.items()
+                   if k in ('agree', 'disagree', 'error')},
+                'agree_frac': counts.get('agree_frac'),
+            }
+            print(f'  shadow         : {mirrors} mirrored of '
+                  f'{shadow_bench["ok"]} ok | agree '
+                  f'{counts.get("agree", 0)} | disagree '
+                  f'{counts.get("disagree", 0)} | last raw agreement '
+                  f'{counts.get("agree_frac")}', flush=True)
+            if shadow_bench['errors']:
+                problems.append(f'shadow phase: '
+                                f'{shadow_bench["errors"]} client '
+                                f'errors (want 0)')
+            if mirrors == 0:
+                problems.append('shadow phase mirrored nothing')
+            if mirrors != report['shadow']['canary_serve_delta']:
+                problems.append(
+                    f'shadow reconciliation: {mirrors} mirrors != '
+                    f'{report["shadow"]["canary_serve_delta"]} canary '
+                    f'serve oks')
+            if args.expect_shadow == 'disagree' \
+                    and not counts.get('disagree'):
+                problems.append('expected shadow disagreement, saw none')
+            if args.expect_shadow == 'agree' \
+                    and counts.get('disagree'):
+                problems.append(f'expected bit-agreement, '
+                                f'{counts["disagree"]} mirrors '
+                                f'disagreed')
+
+        # ---- phase C: canary — weighted sticky split + controller
+        router.configure_canary(group, canary_rg, canary_v, args.weight)
+        policy = RolloutPolicy(
+            p99_regress_frac=args.p99_regress_frac,
+            p99_floor_ms=args.p99_floor_ms,
+            max_disagree_frac=args.max_disagree,
+            min_canary_ok=args.min_canary_ok,
+            min_stable_ok=args.min_stable_ok,
+            breach_consecutive=args.breach_consecutive,
+            clean_consecutive=args.clean_consecutive)
+        controller = RolloutController(
+            router, manager, reg, group, canary_v, canary_rg.name,
+            bundle_dir=canary_dir, old_stable_group=group,
+            policy=policy, poll_s=args.poll_s)
+        before_rtr = _ok_by_version(router, group)
+        before_stable = _scrape_ok(replicas)
+        before_canary = _scrape_ok(canaries)
+        # the rollout's starting line is NOW (canary arm live): the
+        # baseline snapshot + canary_start event fire here even when
+        # the polling thread starts after the bench
+        controller.prime()
+        live = args.expect == 'rollback'
+        if live:
+            # the controller watches the bench as it runs: a seeded
+            # regression must roll back MID-traffic with zero
+            # client-visible errors (the canary hash slice falls back
+            # to stable the moment the arm clears)
+            controller.start()
+        bench = bench_http(url, payloads, args.requests, args.rps,
+                           seed=args.seed + 1)
+        report['canary_bench'] = bench
+        print(format_report(bench), flush=True)
+        after_rtr = _ok_by_version(router, group)
+        rtr_delta = {v: after_rtr.get(v, 0) - before_rtr.get(v, 0)
+                     for v in after_rtr}
+        recon = {'loadgen_per_version': bench.get('per_version'),
+                 'router_delta': rtr_delta}
+        if not live:
+            # replica-side leg BEFORE the controller acts (a promote
+            # drains the old stable group; a golden replay adds direct
+            # canary traffic) — after it, only bookkept deltas exist
+            recon['stable_serve_delta'] = \
+                _scrape_ok(replicas) - before_stable
+            recon['canary_serve_delta'] = \
+                _scrape_ok(canaries) - before_canary
+        report['reconciliation'] = recon
+        print(f'  reconciliation : loadgen {recon["loadgen_per_version"]}'
+              f' == router {rtr_delta}', flush=True)
+        for v, n in rtr_delta.items():
+            if n != (bench.get('per_version') or {}).get(v, 0):
+                problems.append(
+                    f'per-version reconciliation mismatch for {v}: '
+                    f'router {n} != loadgen '
+                    f'{(bench.get("per_version") or {}).get(v, 0)}')
+        if sum(rtr_delta.values()) != bench['ok']:
+            problems.append(f'router ok sum {sum(rtr_delta.values())} '
+                            f'!= loadgen ok {bench["ok"]}')
+        if not live:
+            if recon['stable_serve_delta'] != rtr_delta.get(stable_v, 0):
+                problems.append(
+                    f'stable replicas served '
+                    f'{recon["stable_serve_delta"]}, router says '
+                    f'{rtr_delta.get(stable_v, 0)}')
+            if recon['canary_serve_delta'] != rtr_delta.get(canary_v, 0):
+                problems.append(
+                    f'canary replicas served '
+                    f'{recon["canary_serve_delta"]}, router says '
+                    f'{rtr_delta.get(canary_v, 0)}')
+        problems += check_report(
+            bench, args.p95_ms,
+            canary_version=canary_v if not live else None,
+            canary_weight=args.weight if not live else None,
+            canary_weight_tol=args.weight_tol)
+        if not live:
+            controller.start()
+        outcome = controller.wait(timeout_s=args.decide_timeout_s)
+        controller.stop()
+        action, reason = outcome if outcome else ('none', 'undecided')
+        report['outcome'] = {'action': action, 'reason': reason}
+        print(f'  outcome        : {action} — {reason}', flush=True)
+        if args.expect != 'none' and action != args.expect:
+            problems.append(f'expected {args.expect}, controller '
+                            f'decided {action} ({reason})')
+        now_stable = reg.channel(args.model, 'stable')
+        report['stable_channel_after'] = now_stable
+        if action == 'promote' and now_stable != canary_v:
+            problems.append(f'promote did not flip the stable channel '
+                            f'(still {now_stable})')
+        if action == 'rollback' and now_stable != stable_channel_before:
+            problems.append(f'rollback must not move the stable '
+                            f'channel ({stable_channel_before} -> '
+                            f'{now_stable})')
+
+        # ---- phase P: post-action traffic — whatever the controller
+        # decided, clients must see exactly one version and zero errors
+        expected_v = canary_v if action == 'promote' else stable_v
+        report['post_expected_version'] = expected_v
+        post = bench_http(url, payloads, args.post_requests, args.rps,
+                          seed=args.seed + 2)
+        report['post_bench'] = post
+        print(f'  post-{action:<9}: {post["ok"]}/{post["requests"]} ok | '
+              f'{post["errors"]} errors | versions '
+              f'{post.get("per_version")}', flush=True)
+        if post['errors'] or post['ok'] != post['requests']:
+            problems.append(
+                f'post-{action} traffic lost requests: '
+                f'{post["ok"]}/{post["requests"]} ok, '
+                f'{post["errors"]} errors')
+        if set(post.get('per_version') or {}) != {expected_v}:
+            problems.append(
+                f'post-{action} traffic saw versions '
+                f'{post.get("per_version")}, expected only '
+                f'{expected_v}')
+    finally:
+        if controller is not None:
+            controller.stop()
+        if router is not None:
+            router.shutdown()
+        manager.stop(drain=False)
+        sink.emit({'event': 'run_end'})
+        sink.close()
+        if obs.get_sink() is sink:
+            obs.set_sink(None)
+
+    events = []
+    for name in sorted(os.listdir(obs_dir)):
+        if name.startswith('events-') and name.endswith('.jsonl'):
+            with open(os.path.join(obs_dir, name)) as f:
+                events += [json.loads(line) for line in f
+                           if line.strip()]
+    actions = [e['action'] for e in events
+               if e.get('event') == 'rollout']
+    report['rollout_events'] = {a: actions.count(a)
+                                for a in sorted(set(actions))}
+    report['wall_s'] = round(time.perf_counter() - t_start, 1)
+    print(f'  rollout events : {report["rollout_events"]} '
+          f'(sink {obs_dir})', flush=True)
+    if 'canary_start' not in actions:
+        problems.append('no canary_start rollout event reached the sink')
+    if args.expect != 'none' and args.expect not in actions:
+        problems.append(f'no {args.expect} rollout event reached the '
+                        f'sink')
+    if args.report_json:
+        os.makedirs(os.path.dirname(os.path.abspath(args.report_json)),
+                    exist_ok=True)
+        with open(args.report_json, 'w') as f:
+            json.dump(report, f, indent=2)
+    if args.check:
+        if problems:
+            print('segship check FAILED: ' + '; '.join(problems),
+                  file=sys.stderr, flush=True)
+            return 1
+        print(f'segship check OK: {report["outcome"]["action"]} of '
+              f'{canary_v} over {stable_v} | canary bench '
+              f'{report["canary_bench"]["ok"]}/'
+              f'{report["canary_bench"]["requests"]} ok, 0 errors | '
+              f'exact per-version reconciliation | post-action '
+              f'{report["post_bench"]["ok"]}/'
+              f'{report["post_bench"]["requests"]} ok on '
+              f'{report.get("post_expected_version")} | '
+              f'{report["wall_s"]}s', flush=True)
+    return 0
+
+
+# --------------------------------------------------------------------- main
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog='segship', description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = ap.add_subparsers(dest='cmd', required=True)
+
+    bp = sub.add_parser('bake', help='build + publish an ArtifactBundle')
+    bp.add_argument('--registry', required=True)
+    bp.add_argument('--model', default='fastscnn')
+    bp.add_argument('--num_class', type=int, default=19)
+    bp.add_argument('--compute_dtype', default=None)
+    bp.add_argument('--buckets', default='512x1024')
+    bp.add_argument('--batch', type=int, default=8)
+    bp.add_argument('--ckpt', default=None)
+    bp.add_argument('--golden', type=int, default=4,
+                    help='golden input/output pairs recorded at bake')
+    bp.add_argument('--seed', type=int, default=0)
+    bp.add_argument('--perturb', type=float, default=0.0,
+                    help='seeded gaussian weight noise — the rollout-'
+                         'drill knob (bakes a deliberately-different '
+                         'version the shadow compare must catch)')
+    bp.add_argument('--perturb-seed', type=int, default=0)
+    bp.add_argument('--miou', type=float, default=None,
+                    help='held-out mIoU measured by the baker (recorded '
+                         'in quality.json)')
+    bp.add_argument('--channel', default=None,
+                    help='also point this channel at the new version')
+    bp.add_argument('--json', action='store_true')
+
+    lp = sub.add_parser('list', help='versions + channel pointers')
+    lp.add_argument('--registry', required=True)
+    lp.add_argument('--model', default=None)
+    lp.add_argument('--json', action='store_true')
+
+    vp = sub.add_parser('verify', help='re-hash a published bundle')
+    vp.add_argument('--registry', required=True)
+    vp.add_argument('--model', required=True)
+    vp.add_argument('--ref', default=None,
+                    help='@channel or version prefix (default @stable)')
+
+    cp = sub.add_parser('set-channel', help='atomic channel pointer flip')
+    cp.add_argument('--registry', required=True)
+    cp.add_argument('--model', required=True)
+    cp.add_argument('--channel', required=True)
+    cp.add_argument('--ref', required=True)
+
+    rp = sub.add_parser('rollout',
+                        help='shadow + canary a version against @stable')
+    rp.add_argument('--registry', required=True)
+    rp.add_argument('--model', default='fastscnn')
+    rp.add_argument('--stable', default='@stable')
+    rp.add_argument('--canary', default='@canary')
+    rp.add_argument('--weight', type=float, default=0.2)
+    rp.add_argument('--shadow-sample', type=float, default=0.3)
+    rp.add_argument('--replicas', type=int, default=1)
+    rp.add_argument('--canary-replicas', type=int, default=1)
+    rp.add_argument('--requests', type=int, default=200)
+    rp.add_argument('--shadow-requests', type=int, default=64)
+    rp.add_argument('--post-requests', type=int, default=32)
+    rp.add_argument('--rps', type=float, default=40.0)
+    rp.add_argument('--seed', type=int, default=0)
+    rp.add_argument('--max-wait-ms', type=float, default=10.0)
+    rp.add_argument('--canary-max-wait-ms', type=float, default=None,
+                    help='override the canary replicas\' batcher wait — '
+                         'the seeded-regression knob for rollback drills'
+                         ' (a big wait legitimately inflates canary p99)')
+    rp.add_argument('--max-queue', type=int, default=128)
+    rp.add_argument('--workers', type=int, default=2)
+    rp.add_argument('--port', type=int, default=0)
+    rp.add_argument('--max-outstanding', type=int, default=256)
+    rp.add_argument('--run-dir', default=None)
+    rp.add_argument('--ready-timeout-s', type=float, default=600.0)
+    rp.add_argument('--drain-grace-s', type=float, default=60.0)
+    rp.add_argument('--poll-s', type=float, default=0.5)
+    rp.add_argument('--decide-timeout-s', type=float, default=120.0)
+    rp.add_argument('--p99-regress-frac', type=float, default=0.5)
+    rp.add_argument('--p99-floor-ms', type=float, default=50.0)
+    rp.add_argument('--max-disagree', type=float, default=0.02)
+    rp.add_argument('--min-canary-ok', type=int, default=10)
+    rp.add_argument('--min-stable-ok', type=int, default=10)
+    rp.add_argument('--breach-consecutive', type=int, default=2)
+    rp.add_argument('--clean-consecutive', type=int, default=3)
+    rp.add_argument('--p95-ms', type=float, default=10000.0)
+    rp.add_argument('--weight-tol', type=float, default=0.15)
+    rp.add_argument('--expect', default='none',
+                    choices=('promote', 'rollback', 'none'),
+                    help='--check gates that the controller reached '
+                         'this verdict')
+    rp.add_argument('--expect-shadow', default='any',
+                    choices=('agree', 'disagree', 'any'),
+                    help='--check gates the shadow compare outcome')
+    rp.add_argument('--obs-dir', default=None)
+    rp.add_argument('--report-json', default=None, metavar='PATH')
+    rp.add_argument('--check', action='store_true')
+
+    args = ap.parse_args(argv)
+    return {'bake': cmd_bake, 'list': cmd_list, 'verify': cmd_verify,
+            'set-channel': cmd_set_channel,
+            'rollout': cmd_rollout}[args.cmd](args)
+
+
+if __name__ == '__main__':
+    sys.exit(main())
