@@ -71,6 +71,7 @@ TARGET_PREFIXES = (
     'rtseg_tpu/serve/', 'rtseg_tpu/obs/', 'rtseg_tpu/warm/',
     'rtseg_tpu/data/', 'rtseg_tpu/train/checkpoint.py',
     'rtseg_tpu/native/', 'rtseg_tpu/fleet/', 'rtseg_tpu/registry/',
+    'rtseg_tpu/stream/',
 )
 
 #: constructor names (last dotted segment) that create a lock object;

@@ -73,13 +73,17 @@ import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple, Union
 
+from ..obs import get_sink
 from ..obs.metrics import Histogram, MetricsRegistry, render_prometheus
 from ..obs.tracing import (TRACE_HEADER, new_trace_id, valid_trace_id)
 from ..serve.server import DEADLINE_HEADER, REPLICA_HEADER, VERSION_HEADER
+from ..stream.protocol import (MASK_AGE_HEADER, MIGRATED_HEADER,
+                               PROVENANCE_HEADER, SEQ_HEADER,
+                               SESSION_HEADER)
 from .manager import ReplicaGroup
 from .policy import LeastOutstanding, RoutingPolicy
 from .replica import ReplicaProcess
-from .split import Arm, TrafficSplit
+from .split import Arm, TrafficSplit, affinity_pick
 
 #: request header selecting the model group (the path segment wins)
 MODEL_HEADER = 'X-Model'
@@ -107,6 +111,20 @@ _MAX_MIRRORS = 8
 
 #: response headers copied verbatim from the replica to the client
 _PASS_HEADERS = ('X-Serve-Timing', 'X-Mask-Shape', 'X-Mask-Dtype')
+
+#: ...plus the segstream frame headers (provenance/freshness/session)
+_STREAM_PASS_HEADERS = _PASS_HEADERS + (PROVENANCE_HEADER,
+                                        MASK_AGE_HEADER, SESSION_HEADER,
+                                        SEQ_HEADER)
+
+#: session lifecycle events the router counts
+#: (fleet_session_events_total{group, action})
+_SESSION_ACTIONS = ('open', 'migrate', 'close')
+
+#: bound sessions the router remembers; past the cap the oldest binding
+#: is evicted — its next frame just re-derives the same replica from the
+#: affinity hash (rendezvous is deterministic), so eviction is invisible
+_MAX_SESSION_BINDINGS = 4096
 
 #: exceptions that mean "the replica connection died" — retryable
 #: (URLError wraps refused/reset sockets; HTTPException covers a torn
@@ -206,6 +224,26 @@ class FleetRouter(ThreadingHTTPServer):
         self._mirror_slots = threading.BoundedSemaphore(_MAX_MIRRORS)
         self._out_group: Dict[str, int] = {g: 0 for g in self.groups}
         self._out_replica: Dict[str, int] = {}
+        # segstream: session -> replica-id affinity bindings (guarded by
+        # _lock). The binding only changes when the bound replica stops
+        # being routable — that one change IS the migration.
+        self._session_bind: Dict[str, str] = {}
+        self._c_frames = {
+            (g, st): self.registry.counter(
+                'fleet_frames_total',
+                help='routed stream frames by terminal status (same '
+                     'vocabulary as fleet_requests_total; ok mirrors '
+                     'the replica stream_frames_total{ok} leg of the '
+                     'frame reconciliation)',
+                group=g, status=st)
+            for g in self.groups
+            for st in _REPLICA_STATUSES + _ROUTER_STATUSES}
+        self._c_session = {
+            (g, a): self.registry.counter(
+                'fleet_session_events_total',
+                help='streaming session lifecycle at the router '
+                     '(open/migrate/close)', group=g, action=a)
+            for g in self.groups for a in _SESSION_ACTIONS}
         super().__init__(addr, _RouterHandler)
 
     # ------------------------------------------------ versioned metrics
@@ -327,6 +365,29 @@ class FleetRouter(ThreadingHTTPServer):
             self._out_replica[replica_id] = \
                 self._out_replica.get(replica_id, 0) - 1
 
+    # -------------------------------------------- session affinity (segstream)
+    def session_binding(self, session_id: str) -> Optional[str]:
+        with self._lock:
+            return self._session_bind.get(session_id)
+
+    def bind_session(self, session_id: str, replica_id: str) -> None:
+        with self._lock:
+            if session_id not in self._session_bind \
+                    and len(self._session_bind) >= _MAX_SESSION_BINDINGS:
+                # evict the oldest binding (insertion order); its next
+                # frame re-derives the same replica from the rendezvous
+                # hash, so this costs a dict miss, not a migration
+                self._session_bind.pop(next(iter(self._session_bind)))
+            self._session_bind[session_id] = replica_id
+
+    def unbind_session(self, session_id: str) -> Optional[str]:
+        with self._lock:
+            return self._session_bind.pop(session_id, None)
+
+    def bound_sessions(self) -> int:
+        with self._lock:
+            return len(self._session_bind)
+
     # ------------------------------------------------------------- metrics
     def count(self, group: str, version: str, status: str) -> None:
         self._counter(group, version, status).inc()
@@ -381,7 +442,13 @@ class FleetRouter(ThreadingHTTPServer):
                 'by_version': per_version,
                 'retries': self._c_retry[g].value,
                 'e2e_ms': self._group_e2e(g),
+                'frames': {st: self._c_frames[(g, st)].value
+                           for st in (_REPLICA_STATUSES
+                                      + _ROUTER_STATUSES)},
+                'session_events': {a: self._c_session[(g, a)].value
+                                   for a in _SESSION_ACTIONS},
             }
+        out['bound_sessions'] = self.bound_sessions()
         return out
 
     def _group_e2e(self, group: str) -> dict:
@@ -466,6 +533,12 @@ class FleetRouter(ThreadingHTTPServer):
             self._mirror_slots.release()
 
 
+def _stream_route(path: str) -> bool:
+    """Is this a segstream session-plane path?"""
+    return path in ('/session', '/frame') or (
+        path.startswith('/session/') and path.endswith('/close'))
+
+
 def _forward(url: str, data: bytes, headers: Dict[str, str],
              timeout_s: float) -> Tuple[int, bytes, Dict[str, str]]:
     """POST to a replica; returns (code, body, headers). HTTP error
@@ -542,7 +615,10 @@ class _RouterHandler(BaseHTTPRequestHandler):
                                                self.server.groups))},
                             trace_hdr)
             return
-        if not data:
+        stream_path = _stream_route(path)
+        if not data and not (stream_path
+                             and path.endswith('/close')):
+            # /session/<id>/close legitimately has no body
             self._send_json(400, {'error': 'empty body'}, trace_hdr)
             return
         deadline_at = None
@@ -570,14 +646,21 @@ class _RouterHandler(BaseHTTPRequestHandler):
                             trace_hdr)
             return
         try:
-            self._route(group, data, query, tid, trace_hdr, deadline_at)
+            if stream_path:
+                self._route_stream(path, group, data, query, tid,
+                                   trace_hdr, deadline_at)
+            else:
+                self._route(group, data, query, tid, trace_hdr,
+                            deadline_at)
         finally:
             self.server.release(group)
 
     def _resolve_group(self, path: str) -> Optional[str]:
-        """/predict + X-Model header, or /predict/<model>; None when the
-        name (or the route itself) is unknown."""
-        if path in ('/', '/predict'):
+        """/predict + X-Model header, or /predict/<model>; streaming
+        routes (/session, /frame) resolve like bare /predict — the
+        X-Model header or the default group. None when the name (or the
+        route itself) is unknown."""
+        if path in ('/', '/predict') or _stream_route(path):
             name = self.headers.get(MODEL_HEADER) \
                 or self.server.default_group
             return name if name in self.server.groups else None
@@ -742,6 +825,242 @@ class _RouterHandler(BaseHTTPRequestHandler):
                                      code, body, raw)
             return True, True
         return False, True
+
+    # ------------------------------------------------ segstream routing
+    def _route_stream(self, path: str, group: str, data: bytes,
+                      query: str, tid: str, trace_hdr: dict,
+                      deadline_at: Optional[float]) -> None:
+        if path == '/session':
+            self._stream_open(group, data, query, trace_hdr)
+        elif path == '/frame':
+            self._stream_frame(group, data, query, trace_hdr,
+                               deadline_at)
+        else:
+            sid = path[len('/session/'):-len('/close')]
+            self._stream_close(group, sid, trace_hdr)
+
+    def _stream_candidates(self, arm: Arm, tried: Tuple[str, ...]):
+        """id -> replica for the arm's ready replicas with a live port,
+        minus the already-tried ids."""
+        return {r.replica_id: r for r in arm.group.ready()
+                if r.url is not None and r.replica_id not in tried}
+
+    def _session_arms(self, group: str, sid: str) -> List[Arm]:
+        """The arm chain for one session — sticky by session hash (the
+        same keyed_share canary splits use), stable as fallback."""
+        split = self.server.groups[group]
+        first = split.pick(sid)
+        return [first] if first.name == 'stable' \
+            else [first, split.stable_arm()]
+
+    def _stream_open(self, group: str, data: bytes, query: str,
+                     trace_hdr: dict) -> None:
+        """Open a session: mint/honor the id, pick its home replica by
+        rendezvous affinity, bind, forward."""
+        srv = self.server
+        inbound = self.headers.get(SESSION_HEADER)
+        sid = inbound if valid_trace_id(inbound) else new_trace_id()
+        fwd = {**trace_hdr, SESSION_HEADER: sid}
+        ctype = self.headers.get('Content-Type')
+        if ctype:
+            fwd['Content-Type'] = ctype
+        tried: Tuple[str, ...] = ()
+        for arm in self._session_arms(group, sid):
+            for _ in range(4):
+                cands = self._stream_candidates(arm, tried)
+                rid = affinity_pick(sid, list(cands))
+                if rid is None:
+                    break
+                replica = cands[rid]
+                srv.note_start(rid)
+                try:
+                    code, body, headers = _forward(
+                        replica.url + '/session'
+                        + (f'?{query}' if query else ''),
+                        data, fwd, srv.request_timeout_s)
+                except _CONN_ERRORS as e:
+                    if _is_timeout(e):
+                        self._send_json(504, {'error': 'replica wait '
+                                                       'timed out'},
+                                        trace_hdr)
+                        return
+                    tried = tried + (rid,)
+                    continue
+                finally:
+                    srv.note_done(rid)
+                if code == 503 and headers.get('X-Replica-State') \
+                        == 'draining':
+                    tried = tried + (rid,)
+                    continue
+                if code == 200:
+                    srv.bind_session(sid, rid)
+                    srv._c_session[(group, 'open')].inc()
+                extra = {REPLICA_HEADER: rid, SESSION_HEADER: sid,
+                         VERSION_HEADER: headers.get(VERSION_HEADER,
+                                                     arm.version),
+                         **trace_hdr}
+                self._send(code, body,
+                           headers.get('Content-Type',
+                                       'application/json'), extra)
+                return
+        self._send_json(503, {'error': f'no ready replicas in group '
+                                       f'{group}'}, trace_hdr)
+
+    def _stream_frame(self, group: str, data: bytes, query: str,
+                      trace_hdr: dict,
+                      deadline_at: Optional[float]) -> None:
+        """Forward one frame to the session's bound replica; when that
+        replica is gone (drained, killed, restarted without the session)
+        re-home the session by rendezvous affinity — ONE migration, a
+        `session_migrate` event, zero client-visible errors. Timeouts
+        are never retried (same contract as /predict)."""
+        srv = self.server
+        sid = self.headers.get(SESSION_HEADER)
+        if not valid_trace_id(sid):
+            srv._c_frames[(group, 'client_error')].inc()
+            self._send_json(400, {'error': f'{SESSION_HEADER} missing '
+                                           f'or malformed'}, trace_hdr)
+            return
+        seq_raw = self.headers.get(SEQ_HEADER)
+        bound = srv.session_binding(sid)
+        tried: Tuple[str, ...] = ()
+        migrated = False
+        for arm in self._session_arms(group, sid):
+            for _ in range(4):
+                cands = self._stream_candidates(arm, tried)
+                if not cands:
+                    break
+                if bound in cands:
+                    rid = bound
+                else:
+                    rid = affinity_pick(sid, list(cands))
+                    migrated = migrated or (bound is not None
+                                            and rid != bound)
+                replica = cands[rid]
+                fwd = {**trace_hdr, SESSION_HEADER: sid}
+                if seq_raw is not None:
+                    fwd[SEQ_HEADER] = seq_raw
+                if migrated:
+                    # tells the replica to force a keyframe; echoed to
+                    # the client so load-gen counts migrations
+                    fwd[MIGRATED_HEADER] = '1'
+                ctype = self.headers.get('Content-Type')
+                if ctype:
+                    fwd['Content-Type'] = ctype
+                timeout_s = srv.request_timeout_s
+                if deadline_at is not None:
+                    remaining_ms = \
+                        (deadline_at - time.perf_counter()) * 1e3
+                    if remaining_ms <= 0:
+                        srv._c_frames[(group, 'expired')].inc()
+                        self._send_json(504, {'error': 'deadline spent '
+                                                       'inside the '
+                                                       'fleet'},
+                                        trace_hdr)
+                        return
+                    fwd[DEADLINE_HEADER] = f'{remaining_ms:.3f}'
+                    timeout_s = min(timeout_s,
+                                    remaining_ms / 1e3 + 5.0)
+                srv.note_start(rid)
+                try:
+                    code, body, headers = _forward(
+                        replica.url + '/frame'
+                        + (f'?{query}' if query else ''),
+                        data, fwd, timeout_s)
+                except _CONN_ERRORS as e:
+                    if _is_timeout(e):
+                        srv._c_frames[(group, 'expired')].inc()
+                        self._send_json(504, {'error': 'replica wait '
+                                                       'timed out'},
+                                        trace_hdr)
+                        return
+                    tried = tried + (rid,)
+                    continue
+                finally:
+                    srv.note_done(rid)
+                if code == 503 and headers.get('X-Replica-State') \
+                        == 'draining':
+                    tried = tried + (rid,)
+                    continue
+                if rid != bound:
+                    srv.bind_session(sid, rid)
+                    if migrated:
+                        srv._c_session[(group, 'migrate')].inc()
+                        sink = get_sink()
+                        if sink is not None:
+                            sink.emit({'event': 'session_migrate',
+                                       'group': group, 'session': sid,
+                                       'seq': seq_raw,
+                                       'from': bound, 'to': rid})
+                status = {200: 'ok', 503: 'rejected',
+                          504: 'dropped'}.get(
+                    code, 'client_error' if 400 <= code < 500
+                    else 'error')
+                srv._c_frames[(group, status)].inc()
+                extra = {REPLICA_HEADER: rid,
+                         VERSION_HEADER: headers.get(VERSION_HEADER,
+                                                     arm.version),
+                         **trace_hdr}
+                for h in _STREAM_PASS_HEADERS:
+                    if headers.get(h):
+                        extra[h] = headers[h]
+                if migrated:
+                    extra[MIGRATED_HEADER] = '1'
+                self._send(code, body,
+                           headers.get('Content-Type',
+                                       'application/json'), extra)
+                return
+        srv._c_frames[(group,
+                       'unreachable' if tried else 'unroutable')].inc()
+        if tried:
+            self._send_json(502, {'error': 'replica connection failed '
+                                           'and the retry budget is '
+                                           'spent'}, trace_hdr)
+        else:
+            self._send_json(503, {'error': f'no ready replicas in '
+                                           f'group {group}'}, trace_hdr)
+
+    def _stream_close(self, group: str, sid: str,
+                      trace_hdr: dict) -> None:
+        """Close a session wherever it lives. A dead bound replica makes
+        the close a local unbind + 200 — the session state died with the
+        replica; surfacing that as a client error would fail the
+        zero-error contract for nothing actionable."""
+        srv = self.server
+        if not valid_trace_id(sid):
+            self._send_json(400, {'error': f'malformed session id '
+                                           f'{sid!r}'}, trace_hdr)
+            return
+        bound = srv.unbind_session(sid)
+        srv._c_session[(group, 'close')].inc()
+        tried: Tuple[str, ...] = ()
+        for arm in self._session_arms(group, sid):
+            cands = self._stream_candidates(arm, tried)
+            rid = bound if bound in cands \
+                else affinity_pick(sid, list(cands))
+            if rid is None:
+                continue
+            replica = cands[rid]
+            srv.note_start(rid)
+            try:
+                code, body, headers = _forward(
+                    replica.url + f'/session/{sid}/close', b'',
+                    {**trace_hdr, SESSION_HEADER: sid},
+                    srv.request_timeout_s)
+            except _CONN_ERRORS:
+                tried = tried + (rid,)
+                continue
+            finally:
+                srv.note_done(rid)
+            extra = {REPLICA_HEADER: rid, SESSION_HEADER: sid,
+                     **trace_hdr}
+            self._send(code, body,
+                       headers.get('Content-Type', 'application/json'),
+                       extra)
+            return
+        self._send_json(200, {'session': sid, 'closed': False,
+                              'note': 'replica gone; binding dropped'},
+                        {**trace_hdr, SESSION_HEADER: sid})
 
 
 def make_router(groups: Dict[str, Union[ReplicaGroup, TrafficSplit]],

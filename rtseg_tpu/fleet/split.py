@@ -39,12 +39,43 @@ from .manager import ReplicaGroup
 UNVERSIONED = 'unversioned'
 
 
-def trace_share(trace_id: str) -> float:
-    """Deterministic [0, 1) share for one trace id: first 8 hex chars of
-    sha256 over the id. Pure — two processes (the router and a replayed
-    CI gate) always agree on where an id lands."""
-    h = hashlib.sha256(trace_id.encode()).hexdigest()
+def keyed_share(key: str, salt: str = '') -> float:
+    """Deterministic [0, 1) share for one sticky key: first 8 hex chars
+    of ``sha256(salt ':' key)`` (bare ``sha256(key)`` when unsalted, so
+    the historical trace-id hash is unchanged). Pure — two processes (the
+    router and a replayed CI gate) always agree on where a key lands.
+
+    This is the ONE hashing code path behind both stickiness planes:
+    canary splits (:func:`trace_share`, unsalted) and segstream's
+    session->replica affinity (:func:`affinity_pick`, salted per
+    candidate for rendezvous hashing)."""
+    material = f'{salt}:{key}' if salt else key
+    h = hashlib.sha256(material.encode()).hexdigest()
     return int(h[:8], 16) / float(0x100000000)
+
+
+def trace_share(trace_id: str) -> float:
+    """Deterministic [0, 1) share for one trace id (the canary/shadow
+    split decision). Delegates to :func:`keyed_share` unsalted, so every
+    pre-segstream pin of this hash still holds bit-for-bit."""
+    return keyed_share(trace_id)
+
+
+def affinity_pick(key: str, candidates) -> Optional[str]:
+    """Rendezvous (highest-random-weight) pick: the candidate id whose
+    salted :func:`keyed_share` of ``key`` is largest. Sticky — the same
+    key over the same candidate set always lands on the same candidate —
+    and minimally disruptive: removing one candidate only moves the keys
+    that were bound to it, everything else stays put (that is why session
+    affinity survives a replica drain/death with one migration, not a
+    reshuffle). Ties (possible only on hash collisions) break by sorted
+    candidate id so two routers agree. Returns None when no candidates."""
+    best, best_share = None, -1.0
+    for cand in sorted(set(candidates)):
+        share = keyed_share(key, salt=cand)
+        if share > best_share:
+            best, best_share = cand, share
+    return best
 
 
 class Arm(NamedTuple):

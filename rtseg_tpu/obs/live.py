@@ -228,6 +228,13 @@ class SinkTailer:
         self.totals = {'ok': 0, 'rejected': 0, 'dropped': 0,
                        'ingress': 0, 'stalls': 0, 'steps': 0,
                        'compile_steps': 0, 'captures': 0}
+        # segstream: frame-status / provenance / session-action running
+        # totals (frame percentiles come from the sliding window)
+        self.frame_totals = {'ok': 0, 'dropped_late': 0, 'stale': 0,
+                             'error': 0}
+        self.frame_keyframes = 0
+        self.session_actions: Dict[str, int] = {}
+        self.migrations = 0
         self.run_meta: Dict[str, Any] = {}
         # segprof: last non-retraced profile capture + peak HBM seen
         self._busy_frac: Optional[float] = None
@@ -287,6 +294,20 @@ class SinkTailer:
                 if e.get('compile'):
                     self.totals['compile_steps'] += 1
                 self._recent.append(e)
+            elif kind == 'frame':
+                status = e.get('status', 'ok')
+                if status in self.frame_totals:
+                    self.frame_totals[status] += 1
+                if status == 'ok' \
+                        and e.get('provenance') == 'keyframe':
+                    self.frame_keyframes += 1
+                self._recent.append(e)
+            elif kind == 'session':
+                a = e.get('action', '?')
+                self.session_actions[a] = \
+                    self.session_actions.get(a, 0) + 1
+            elif kind == 'session_migrate':
+                self.migrations += 1
             elif kind == 'profile':
                 self.totals['captures'] += 1
                 if not e.get('retraced') \
@@ -326,6 +347,7 @@ class SinkTailer:
             'source': self.dir or self.files[0], 'mode': 'sink',
             'run': self.run_meta, 'stalls': self.totals['stalls'],
             'serving': None, 'train': None, 'device': None,
+            'streaming': None,
             'rollout': ({'actions': dict(self._rollout_actions),
                          'last': self._rollout_last}
                         if self._rollout_actions else None),
@@ -348,6 +370,22 @@ class SinkTailer:
                 'p50_ms': _pct(e2e, 0.5), 'p95_ms': _pct(e2e, 0.95),
                 'p99_ms': _pct(e2e, 0.99),
                 'queue_depth': None, 'occupancy': None,
+            }
+        if any(self.frame_totals.values()) or self.session_actions \
+                or self.migrations:
+            fr = [e for e in self._recent if e.get('event') == 'frame'
+                  and e.get('status') == 'ok' and 'e2e_ms' in e]
+            fr_e2e = sorted(float(e['e2e_ms']) for e in fr)
+            ok = self.frame_totals['ok']
+            frame['streaming'] = {
+                **self.frame_totals,
+                'sessions': dict(self.session_actions),
+                'migrations': self.migrations,
+                'keyframe_ratio': (self.frame_keyframes / ok
+                                   if ok else None),
+                'fps': len(fr) / span_s if span_s > 0 else None,
+                'frame_p50_ms': _pct(fr_e2e, 0.5),
+                'frame_p99_ms': _pct(fr_e2e, 0.99),
             }
         if self.totals['steps']:
             wait = sum(float(e.get('data_wait_s', 0.0)) for e in steps)
@@ -403,6 +441,22 @@ def format_frame(frame: Dict[str, Any]) -> str:
         if tr.get('goodput') is not None:
             lines.append(f'  goodput        : '
                          f'{100 * tr["goodput"]:.1f}%')
+    st = frame.get('streaming')
+    if st:
+        kr = (f'{st["keyframe_ratio"]:.3f}'
+              if st.get('keyframe_ratio') is not None else '—')
+        sess = ' '.join(f'{a}={n}'
+                        for a, n in sorted(st['sessions'].items())) \
+            or '—'
+        lines += [
+            f'  frames         : {st["ok"]} ok | {st["dropped_late"]} '
+            f'dropped-late | {st["stale"]} stale | {st["error"]} errors'
+            f' | {_fmt(st["fps"])} fps',
+            f'  frame p50/p99  : {_fmt(st["frame_p50_ms"])} / '
+            f'{_fmt(st["frame_p99_ms"])} ms | keyframe ratio {kr}',
+            f'  sessions       : {sess} | migrations '
+            f'{st["migrations"]}',
+        ]
     ro = frame.get('rollout')
     if ro:
         acts = ' | '.join(f'{a} x{n}'
@@ -420,7 +474,7 @@ def format_frame(frame: Dict[str, Any]) -> str:
                      f' | {dv.get("captures", 0)} capture(s)')
     if frame.get('stalls') is not None:
         lines.append(f'  stalls         : {frame["stalls"]}')
-    if not sv and not tr:
+    if not sv and not tr and not st:
         lines.append('  (no activity observed yet)')
     return '\n'.join(lines)
 
@@ -432,9 +486,10 @@ def check_frame(frame: Dict[str, Any],
     problems: List[str] = []
     sv = frame.get('serving')
     tr = frame.get('train')
-    if sv is None and tr is None:
-        problems.append('no serving or training activity observed '
-                        '(wrong target?)')
+    st = frame.get('streaming')
+    if sv is None and tr is None and st is None:
+        problems.append('no serving, streaming or training activity '
+                        'observed (wrong target?)')
     if sv:
         if sv.get('errors'):
             problems.append(f"{sv['errors']} request errors (want 0)")
@@ -443,6 +498,8 @@ def check_frame(frame: Dict[str, Any],
             if p99 is None or p99 > p99_ms:
                 problems.append(
                     f'request p99 {_fmt(p99)} ms > threshold {p99_ms} ms')
+    if st and st.get('error'):
+        problems.append(f"{st['error']} frame errors (want 0)")
     if max_hbm_bytes is not None:
         dv = frame.get('device') or {}
         peak = dv.get('peak_hbm_bytes')

@@ -265,6 +265,62 @@ def summarize(events: List[dict],
                           else 0.0),
         }
 
+    # segstream: per-frame events from the streaming session plane
+    # (stream/frontend.py emits 'frame' and 'session'; the fleet router
+    # emits 'session_migrate'). Counts from every host — one stream
+    # spans router + replica processes, like the rollout story. Jitter
+    # is the mean of per-session stddevs of ok-frame e2e (cross-session
+    # mixing would let two steady sessions at different latencies read
+    # as jitter); freshness is the mean mask age in frames (0 = every
+    # response came from a full network pass).
+    frames = [e for e in events if e.get('event') == 'frame']
+    sess_ev = [e for e in events if e.get('event') == 'session']
+    migrations = [e for e in events
+                  if e.get('event') == 'session_migrate']
+    streaming: Optional[Dict[str, Any]] = None
+    if frames or sess_ev or migrations:
+        okf = [e for e in frames if e.get('status') == 'ok']
+        e2e_by_sess: Dict[str, List[float]] = {}
+        for e in okf:
+            if 'e2e_ms' in e:
+                e2e_by_sess.setdefault(
+                    str(e.get('session', '?')), []).append(
+                        float(e['e2e_ms']))
+        e2e_all = np.asarray([v for vs in e2e_by_sess.values()
+                              for v in vs], np.float64)
+        jitters = [float(np.std(np.asarray(vs, np.float64)))
+                   for vs in e2e_by_sess.values() if len(vs) > 1]
+        ages = [float(e['mask_age']) for e in okf if 'mask_age' in e]
+        provs = [e.get('provenance', '?') for e in okf]
+        keyframes = provs.count('keyframe')
+        actions = [e.get('action', '?') for e in sess_ev]
+
+        def _fpct(q):
+            return float(np.percentile(e2e_all, q)) if e2e_all.size \
+                else None
+
+        streaming = {
+            'frames': len(frames),
+            'ok': len(okf),
+            'dropped_late': len([e for e in frames
+                                 if e.get('status') == 'dropped_late']),
+            'stale': len([e for e in frames
+                          if e.get('status') == 'stale']),
+            'errors': len([e for e in frames
+                           if e.get('status') == 'error']),
+            'sessions': len(e2e_by_sess),
+            'session_actions': {a: actions.count(a)
+                                for a in sorted(set(actions))},
+            'migrations': len(migrations),
+            'provenance': {p: provs.count(p)
+                           for p in sorted(set(provs))},
+            'keyframe_ratio': (keyframes / len(okf) if okf else None),
+            'frame_p50_ms': _fpct(50), 'frame_p99_ms': _fpct(99),
+            'jitter_ms': (float(np.mean(jitters)) if jitters
+                          else None),
+            'freshness': (float(np.mean(ages)) if ages else None),
+        }
+
     # segship: rollout transitions (registry/rollout.py emit_rollout) —
     # the deploy story next to the run it happened during. Counts come
     # from every host (one rollout spans router + controller processes).
@@ -360,10 +416,18 @@ def summarize(events: List[dict],
         'epochs': len([e for e in events if e.get('event') == 'epoch'
                        and e.get('kind') == 'train' and mine(e)]),
         'serving': serving,
+        'streaming': streaming,
         'rollout': rollout,
         # flattened for diff_table's flat-key rows
         'serve_p99_ms': serving['e2e_p99_ms'] if serving else None,
         'serve_rps': serving['rps'] if serving else None,
+        'frame_p99_ms': streaming['frame_p99_ms'] if streaming else None,
+        'frame_jitter_ms': streaming['jitter_ms'] if streaming else None,
+        'frame_freshness': streaming['freshness'] if streaming else None,
+        'frame_dropped_late': (streaming['dropped_late'] if streaming
+                               else None),
+        'keyframe_ratio': (streaming['keyframe_ratio'] if streaming
+                           else None),
         'device': device,
         'profile_captures': len(profs),
         **dev_flat,
@@ -439,6 +503,30 @@ def format_summary(s: Dict[str, Any], path: str = '') -> str:
                 f'  batching       : {sv["batches"]} batches | mean size '
                 f'{sv["mean_batch"]:.1f} | occupancy '
                 f'{100 * sv["occupancy"]:.0f}%')
+    if s.get('streaming'):
+        st = s['streaming']
+
+        def _m(v, spec='.1f'):
+            return format(v, spec) if v is not None else '—'
+
+        acts = st.get('session_actions', {})
+        act_str = ' '.join(f'{a}={n}' for a, n in acts.items()) or '—'
+        lines += [
+            f'  streaming      : {st["ok"]}/{st["frames"]} frames ok | '
+            f'dropped-late {st["dropped_late"]} | stale {st["stale"]} | '
+            f'errors {st["errors"]} | {st["sessions"]} sessions',
+            f'  frame p50/p99  : {_m(st["frame_p50_ms"])} / '
+            f'{_m(st["frame_p99_ms"])} ms | jitter '
+            f'{_m(st["jitter_ms"])} ms | freshness '
+            f'{_m(st["freshness"], ".2f")} frames',
+            f'  scheduling     : keyframe ratio '
+            f'{_m(st["keyframe_ratio"], ".3f")} | sessions {act_str} | '
+            f'migrations {st["migrations"]}',
+        ]
+        prov = st.get('provenance', {})
+        if prov:
+            lines.append('  provenance     : ' + ' | '.join(
+                f'{p} {n}' for p, n in prov.items()))
     if s.get('rollout'):
         ro = s['rollout']
         acts = ' | '.join(f'{a} x{n}' for a, n in ro['actions'].items())
@@ -502,6 +590,16 @@ _DIFF_ROWS = (
     # serving rows (None — rendered as '—' — for training-only runs)
     ('serve_p99_ms', 'serve p99 (ms)', 1.0, False),
     ('serve_rps', 'serve RPS', 1.0, True),
+    # segstream rows (None — rendered as '—' — for non-streaming runs).
+    # keyframe_ratio counts as lower-is-better: the scheduler's whole
+    # point is answering frames without the full network, so a ratio
+    # creeping up is the streaming analogue of a throughput regression
+    # (quality is gated separately, by the bench's mIoU-delta table).
+    ('frame_p99_ms', 'frame p99 (ms)', 1.0, False),
+    ('frame_jitter_ms', 'frame jitter (ms)', 1.0, False),
+    ('frame_freshness', 'frame freshness (frames)', 1.0, False),
+    ('frame_dropped_late', 'frames dropped late', 1.0, False),
+    ('keyframe_ratio', 'keyframe ratio (%)', 100.0, False),
     # segprof device-attribution rows: busy fraction (higher = the chip
     # is actually working) and per-category device ms per captured
     # iteration (a collective/copy share creeping up shows here — the

@@ -23,8 +23,10 @@ from .batcher import MicroBatcher, Request, ServeDrop, ServeReject
 from .engine import (Bucket, ServeEngine, UnknownBucket, assemble_batch,
                      parse_buckets, select_bucket)
 from .loadgen import (bench_http, bench_pipeline, bench_sequential,
-                      check_report, encode_png, format_report,
-                      replica_skew, synth_images)
+                      bench_video, check_report, check_video_report,
+                      encode_png, format_report, format_video_report,
+                      make_video_payloads, replica_skew, synth_images,
+                      synth_video)
 from .pipeline import ServePipeline, ServeResult
 from .server import (DEADLINE_HEADER, REPLICA_HEADER, VERSION_HEADER,
                      ServeHTTPServer, make_preprocess, make_server)
@@ -36,6 +38,8 @@ __all__ = [
     'ServePipeline', 'ServeResult',
     'DEADLINE_HEADER', 'REPLICA_HEADER', 'VERSION_HEADER',
     'ServeHTTPServer', 'make_preprocess', 'make_server',
-    'bench_http', 'bench_pipeline', 'bench_sequential', 'check_report',
-    'encode_png', 'format_report', 'replica_skew', 'synth_images',
+    'bench_http', 'bench_pipeline', 'bench_sequential', 'bench_video',
+    'check_report', 'check_video_report', 'encode_png', 'format_report',
+    'format_video_report', 'make_video_payloads', 'replica_skew',
+    'synth_images', 'synth_video',
 ]

@@ -242,6 +242,323 @@ def bench_http(url, payloads: Sequence[bytes], requests: int,
                      counts['rejected'], counts['error'], wall)
 
 
+def synth_video(bucket: Bucket, frames: int, seed: int = 0,
+                shift: int = 2) -> List[np.ndarray]:
+    """Deterministic synthetic video: a smoothed random field translating
+    ``shift`` px/frame (circular). Consecutive frames share almost all
+    content — the temporal redundancy the keyframe scheduler exploits —
+    while every frame still differs, so a scheduler that cheats (serves
+    frame i's mask for frame j without warping) loses measurable mIoU."""
+    h, w = bucket
+    rng = np.random.default_rng(seed)
+    base = rng.standard_normal((h, w, 3)).astype(np.float32)
+    for _ in range(2):   # cheap smoothing: content is regions, not noise
+        base = (base + np.roll(base, 1, axis=0)
+                + np.roll(base, 1, axis=1)) / 3.0
+    return [np.roll(base, (shift * i) % max(h, 1), axis=0)
+            for i in range(frames)]
+
+
+def make_video_payloads(bucket: Bucket, sessions: int, frames: int,
+                        seed: int = 0,
+                        shift: int = 2) -> List[List[bytes]]:
+    """Per-session PNG payload lists (sessions x frames). Built once and
+    passed to *both* the scheduled and the keyframe-every-frame passes,
+    so the quality delta compares masks over identical inputs."""
+    return [[encode_png(f) for f in synth_video(bucket, frames,
+                                                seed=seed + s,
+                                                shift=shift)]
+            for s in range(sessions)]
+
+
+def bench_video(url: str, payloads: Sequence[Sequence[bytes]],
+                fps: float, bucket: Bucket,
+                keyframe_interval: Optional[int] = None,
+                cheap_mode: Optional[str] = None,
+                frame_deadline_ms: Optional[float] = None,
+                timeout_s: float = 30.0, workers: int = 32,
+                query: str = 'raw=1',
+                mask_store: Optional[dict] = None) -> dict:
+    """Video mode: one streaming session per payload list, frames fired
+    at fixed ``fps`` on a precomputed schedule — open-loop per session,
+    so a slow frame shows up as tail latency / a dropped-late count,
+    never as a stretched schedule (coordinated omission, same rule as
+    :func:`bench_http`). Sessions are staggered across one frame period
+    so arrivals interleave instead of phase-locking.
+
+    Per-session report rows carry p99, jitter (stddev of ok-frame e2e),
+    freshness (mean ``X-Mask-Age``), dropped-late and keyframe counts;
+    ``migrated`` counts frames answered with ``X-Session-Migrated`` (a
+    replica died/drained mid-stream). With ``mask_store`` (a dict) every
+    ok raw mask lands under ``(session_index, seq)`` — the quality pass
+    feeds them to rtseg_tpu/stream/quality.py."""
+    from urllib import error, request as urlreq
+    from ..stream.protocol import (MASK_AGE_HEADER, MIGRATED_HEADER,
+                                   PROVENANCE_HEADER, PROV_KEYFRAME,
+                                   SEQ_HEADER, SESSION_HEADER)
+    from .server import DEADLINE_HEADER
+
+    sessions = len(payloads)
+    frames = len(payloads[0]) if sessions else 0
+    base = url.rstrip('/')
+    overrides: dict = {'h': bucket[0], 'w': bucket[1]}
+    if keyframe_interval is not None:
+        overrides['keyframe_interval'] = keyframe_interval
+    if cheap_mode is not None:
+        overrides['cheap_mode'] = cheap_mode
+    if frame_deadline_ms is not None:
+        overrides['frame_deadline_ms'] = frame_deadline_ms
+
+    def post(path: str, data: bytes, headers: dict, q: str = ''):
+        req = urlreq.Request(base + path + q, data=data, method='POST',
+                             headers=headers)
+        try:
+            with urlreq.urlopen(req, timeout=timeout_s) as resp:
+                return resp.status, resp.read(), dict(resp.headers)
+        except error.HTTPError as e:
+            return e.code, e.read(), dict(e.headers)
+
+    sids: List[str] = []
+    for s in range(sessions):
+        code, body, headers = post('/session',
+                                   json.dumps(overrides).encode(),
+                                   {'Content-Type': 'application/json'})
+        if code != 200:
+            raise RuntimeError(f'session open {s} failed: {code} '
+                               f'{body[:200]!r}')
+        sids.append(json.loads(body)['session'])
+
+    def one(s: int, i: int, t_sched: float) -> dict:
+        headers = {SESSION_HEADER: sids[s], SEQ_HEADER: str(i)}
+        if frame_deadline_ms is not None:
+            headers[DEADLINE_HEADER] = f'{frame_deadline_ms:.3f}'
+        try:
+            code, body, hdrs = post('/frame', payloads[s][i], headers,
+                                    f'?{query}' if query else '')
+        except Exception:   # noqa: BLE001 — connection-level failure
+            return {'s': s, 'i': i, 'status': 'error'}
+        out = {'s': s, 'i': i,
+               'e2e_ms': (time.perf_counter() - t_sched) * 1e3,
+               'replica': hdrs.get(REPLICA_HEADER),
+               'migrated': hdrs.get(MIGRATED_HEADER) is not None}
+        if code == 200:
+            out['status'] = 'ok'
+            out['provenance'] = hdrs.get(PROVENANCE_HEADER)
+            try:
+                out['mask_age'] = int(hdrs.get(MASK_AGE_HEADER, '0'))
+            except ValueError:
+                out['mask_age'] = 0
+            if mask_store is not None and 'raw=1' in query:
+                shape = hdrs.get('X-Mask-Shape')
+                if shape:
+                    h, w = (int(x) for x in shape.split(','))
+                    mask_store[(s, i)] = np.frombuffer(
+                        body, np.int8).reshape(h, w)
+        elif code in (503, 504):
+            try:
+                out['status'] = json.loads(body).get(
+                    'status', 'rejected' if code == 503 else
+                    'dropped_late')
+            except (ValueError, AttributeError):
+                out['status'] = 'rejected' if code == 503 \
+                    else 'dropped_late'
+        else:
+            out['status'] = 'error'
+        return out
+
+    period = 1.0 / fps
+    plan = sorted(
+        ((s * period / max(sessions, 1) + i * period, s, i)
+         for s in range(sessions) for i in range(frames)),
+        key=lambda x: x[0])
+    t0 = time.perf_counter() + 0.05
+    results: List[dict] = []
+    with ThreadPoolExecutor(max_workers=workers) as pool:
+        futs = []
+        for t_rel, s, i in plan:
+            t_sched = t0 + t_rel
+            _sleep_until(t_sched)
+            futs.append(pool.submit(one, s, i, t_sched))
+        results = [f.result() for f in futs]
+    wall = time.perf_counter() - t0
+
+    per_session: List[dict] = []
+    for s in range(sessions):
+        rs = sorted((r for r in results if r['s'] == s),
+                    key=lambda r: r['i'])
+        ok = [r for r in rs if r['status'] == 'ok']
+        e2e = [r['e2e_ms'] for r in ok]
+        pct = _percentiles(e2e)
+        keyframes = sum(1 for r in ok
+                        if r.get('provenance') == PROV_KEYFRAME)
+        row = {
+            'session': sids[s],
+            'frames': len(rs),
+            'ok': len(ok),
+            'dropped_late': sum(1 for r in rs
+                                if r['status'] == 'dropped_late'),
+            'stale': sum(1 for r in rs if r['status'] == 'stale'),
+            'rejected': sum(1 for r in rs if r['status'] == 'rejected'),
+            'errors': sum(1 for r in rs if r['status'] == 'error'),
+            'e2e_p50_ms': pct['p50'], 'e2e_p99_ms': pct['p99'],
+            'jitter_ms': (round(float(np.std(e2e)), 3) if e2e
+                          else None),
+            'freshness': (round(float(np.mean(
+                [r['mask_age'] for r in ok])), 3) if ok else None),
+            'keyframes': keyframes,
+            'keyframe_ratio': (round(keyframes / len(ok), 4)
+                               if ok else None),
+            'migrated': sum(1 for r in rs if r.get('migrated')),
+            'replicas': sorted({r['replica'] for r in ok
+                                if r.get('replica')}),
+        }
+        per_session.append(row)
+
+    for s in range(sessions):
+        post(f'/session/{sids[s]}/close', b'', {})
+
+    all_ok = [r for r in results if r['status'] == 'ok']
+    e2e_all = [r['e2e_ms'] for r in all_ok]
+    pct = _percentiles(e2e_all)
+    jitters = [row['jitter_ms'] for row in per_session
+               if row['jitter_ms'] is not None]
+    fresh = [row['freshness'] for row in per_session
+             if row['freshness'] is not None]
+    keyframes = sum(row['keyframes'] for row in per_session)
+    per_replica: Dict[str, int] = {}
+    for r in all_ok:
+        if r.get('replica'):
+            per_replica[r['replica']] = \
+                per_replica.get(r['replica'], 0) + 1
+    consistency = None
+    if mask_store:
+        from ..stream.quality import temporal_consistency
+        per_sess_cons = []
+        for s in range(sessions):
+            masks = [mask_store[(s, i)] for i in range(frames)
+                     if (s, i) in mask_store]
+            c = temporal_consistency(masks)
+            if c is not None:
+                per_sess_cons.append(c)
+        if per_sess_cons:
+            consistency = round(float(np.mean(per_sess_cons)), 4)
+    report = {
+        'mode': 'video', 'url': base, 'sessions': sessions,
+        'frames_per_session': frames, 'fps_target': fps,
+        'requests': sessions * frames,
+        'ok': len(all_ok),
+        'dropped_late': sum(1 for r in results
+                            if r['status'] == 'dropped_late'),
+        'stale': sum(1 for r in results if r['status'] == 'stale'),
+        'rejected': sum(1 for r in results
+                        if r['status'] == 'rejected'),
+        'errors': sum(1 for r in results if r['status'] == 'error'),
+        'migrated_frames': sum(1 for r in results
+                               if r.get('migrated')),
+        'sessions_migrated': sum(1 for row in per_session
+                                 if row['migrated']),
+        'wall_s': round(wall, 3),
+        'fps_achieved': round(len(all_ok) / wall / max(sessions, 1), 2)
+        if wall > 0 else 0.0,
+        'rps_achieved': round(len(all_ok) / wall, 2) if wall > 0
+        else 0.0,
+        'frame_p50_ms': pct['p50'], 'frame_p95_ms': pct['p95'],
+        'frame_p99_ms': pct['p99'],
+        'jitter_ms': (round(float(np.mean(jitters)), 3) if jitters
+                      else None),
+        'freshness': (round(float(np.mean(fresh)), 3) if fresh
+                      else None),
+        'keyframes': keyframes,
+        'keyframe_ratio': (round(keyframes / len(all_ok), 4)
+                           if all_ok else None),
+        'consistency': consistency,
+        'per_session': per_session,
+        'per_replica': per_replica,
+        'replica_skew': replica_skew(per_replica),
+    }
+    return report
+
+
+def check_video_report(report: dict, p99_ms: Optional[float] = None,
+                       keyframe_band: Optional[Sequence[float]] = None,
+                       max_dropped_late: int = 0,
+                       expect_sessions: Optional[int] = None,
+                       min_consistency: Optional[float] = None
+                       ) -> List[str]:
+    """CI gate for a video report: violated conditions (empty == pass).
+    ``keyframe_band`` is (lo, hi) for the observed keyframe ratio — a
+    scheduler quietly keyframing everything (no speedup) or nothing
+    (stale masks forever) both fail."""
+    problems = []
+    if report.get('errors', 0):
+        problems.append(f"{report['errors']} frame errors (want 0)")
+    if report.get('rejected', 0):
+        problems.append(f"{report['rejected']} rejected frames (want 0)")
+    if report.get('dropped_late', 0) > max_dropped_late:
+        problems.append(f"{report['dropped_late']} dropped-late frames "
+                        f"> {max_dropped_late}")
+    if expect_sessions is not None \
+            and report.get('sessions') != expect_sessions:
+        problems.append(f"{report.get('sessions')} sessions != "
+                        f"{expect_sessions}")
+    if p99_ms is not None:
+        p99 = report.get('frame_p99_ms')
+        if p99 is None or p99 > p99_ms:
+            problems.append(f'frame p99 {p99} ms > threshold '
+                            f'{p99_ms} ms')
+    if keyframe_band is not None:
+        lo, hi = keyframe_band
+        ratio = report.get('keyframe_ratio')
+        if ratio is None or not lo <= ratio <= hi:
+            problems.append(f'keyframe ratio {ratio} outside '
+                            f'[{lo}, {hi}]')
+    if min_consistency is not None:
+        cons = report.get('consistency')
+        if cons is None or cons < min_consistency:
+            problems.append(f'temporal consistency {cons} < '
+                            f'{min_consistency}')
+    return problems
+
+
+def format_video_report(report: dict) -> str:
+    def fmt(v, spec='.1f'):
+        return format(v, spec) if v is not None else 'n/a'
+
+    lines = [
+        f"segstream bench — video | {report['sessions']} sessions x "
+        f"{report['frames_per_session']} frames @ "
+        f"{report['fps_target']} fps",
+        f"  completed      : {report['ok']} ok | "
+        f"{report['dropped_late']} dropped-late | {report['stale']} "
+        f"stale | {report['rejected']} rejected | {report['errors']} "
+        f"errors",
+        f"  achieved       : {report['rps_achieved']} frames/s total "
+        f"({report['fps_achieved']} fps/session) over "
+        f"{report['wall_s']} s",
+        f"  frame p50/p99  : {fmt(report['frame_p50_ms'])} / "
+        f"{fmt(report['frame_p99_ms'])} ms | jitter "
+        f"{fmt(report['jitter_ms'])} ms",
+        f"  freshness      : {fmt(report['freshness'], '.2f')} frames "
+        f"mean mask age | keyframe ratio "
+        f"{fmt(report['keyframe_ratio'], '.3f')} "
+        f"({report['keyframes']} keyframes)",
+    ]
+    if report.get('consistency') is not None:
+        lines.append(f"  consistency    : "
+                     f"{report['consistency']:.4f} mean consecutive-"
+                     f"mask agreement")
+    if report.get('migrated_frames'):
+        lines.append(f"  migrations     : {report['sessions_migrated']} "
+                     f"sessions re-homed "
+                     f"({report['migrated_frames']} frames flagged)")
+    per = report.get('per_replica')
+    if per:
+        dist = ' | '.join(f'{rid} {n}' for rid, n in sorted(per.items()))
+        lines.append(f'  per replica    : {dist} '
+                     f'(skew {report.get("replica_skew")})')
+    return '\n'.join(lines)
+
+
 def replica_skew(per_replica: Dict[str, int]) -> Optional[float]:
     """Imbalance of per-replica ok counts: (max - min) / total, so 0 is
     perfectly balanced and 1 is one replica taking everything. None when

@@ -102,12 +102,16 @@ class ServeHTTPServer(ThreadingHTTPServer):
                  colormap: Optional[np.ndarray] = None,
                  request_timeout_s: float = 30.0,
                  replica_id: Optional[str] = None,
-                 artifact_version: Optional[str] = None):
+                 artifact_version: Optional[str] = None,
+                 stream=None):
         self.pipeline = pipeline
         self.colormap = colormap
         self.request_timeout_s = request_timeout_s
         self.replica_id = replica_id
         self.artifact_version = artifact_version
+        # segstream session plane (rtseg_tpu/stream/frontend.py); None =
+        # streaming routes answer 404 (per-image serving unaffected)
+        self.stream = stream
         self._http_counters: dict = {}
         # drain lifecycle: _draining stops /predict admission, _inflight
         # counts admitted-but-unanswered predicts; both only ever move
@@ -222,7 +226,10 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(200, self.server.health())
         elif path == '/stats':
             update_memory_gauges(self.server.pipeline.registry)
-            self._send_json(200, self.server.pipeline.stats())
+            stats = self.server.pipeline.stats()
+            if self.server.stream is not None:
+                stats['sessions'] = self.server.stream.stats()
+            self._send_json(200, stats)
         elif path == '/metrics':
             # refresh the device memory watermarks at scrape time so
             # peak HBM is current, not an epoch/capture stale-read
@@ -256,6 +263,27 @@ class _Handler(BaseHTTPRequestHandler):
                                                              'false')
             self.server.begin_drain(exit_after=exit_after)
             self._send_json(200, self.server.health(), trace_hdr)
+            return
+        if path in ('/session', '/frame') or (
+                path.startswith('/session/') and path.endswith('/close')):
+            # segstream session plane — same admission token predicts
+            # use, so a draining replica answers frames 503 +
+            # X-Replica-State and the router migrates the session
+            if self.server.stream is None:
+                self._send_json(404, {'error': 'streaming not enabled '
+                                               'on this replica'},
+                                trace_hdr)
+                return
+            if not self.server.try_admit():
+                self._send_json(503, {'error': 'replica draining'},
+                                {**trace_hdr,
+                                 'X-Replica-State': 'draining'})
+                return
+            try:
+                self.server.stream.handle_post(self, path, data, tid,
+                                               trace_hdr)
+            finally:
+                self.server.release()
             return
         if path not in ('/', '/predict'):
             self._send_json(404, {'error': f'no route {path}'},
@@ -395,14 +423,25 @@ def make_server(pipeline: ServePipeline, host: str = '127.0.0.1',
                 port: int = 8080, colormap: Optional[np.ndarray] = None,
                 request_timeout_s: float = 30.0,
                 replica_id: Optional[str] = None,
-                artifact_version: Optional[str] = None) -> ServeHTTPServer:
+                artifact_version: Optional[str] = None,
+                stream_config=None) -> ServeHTTPServer:
     """Bind (port 0 picks a free one; read ``server.server_address``).
     Call ``serve_forever()`` — typically on a thread — then ``shutdown()``
-    + ``pipeline.close()``."""
+    + ``pipeline.close()``. A ``stream_config``
+    (rtseg_tpu/stream/session.py StreamConfig) mounts the segstream
+    session plane (/session, /frame) on top of the same pipeline."""
+    stream = None
+    if stream_config is not None:
+        # function-level import: the stream package imports serve
+        # modules, so a top-level import here would cycle
+        from ..stream.frontend import StreamFrontend
+        stream = StreamFrontend(pipeline, stream_config,
+                                replica_id=replica_id)
     return ServeHTTPServer((host, port), pipeline, colormap=colormap,
                            request_timeout_s=request_timeout_s,
                            replica_id=replica_id,
-                           artifact_version=artifact_version)
+                           artifact_version=artifact_version,
+                           stream=stream)
 
 
 def make_preprocess(config):

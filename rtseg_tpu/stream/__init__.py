@@ -1,0 +1,45 @@
+"""segstream — streaming video segmentation over the serve/fleet planes.
+
+The per-image serving stack (rtseg_tpu/serve) answers independent
+predicts; real-time segmentation traffic is video — ordered frames with
+temporal redundancy. This package adds the session plane that exploits
+it:
+
+  * ``protocol`` — the wire contract (headers, frame statuses), stdlib
+    only so the fleet router imports it without numpy.
+  * ``scheduler`` — the pure keyframe-vs-cheap-path policy
+    (:class:`FrameScheduler`): full network every K frames, a cheap path
+    (reuse / warp / light) in between, staleness-forced early keyframes.
+  * ``session`` — per-session frame ordering (bounded reorder window,
+    drop-late deadlines) and the process session table; the shared
+    mutable state audited by segrace.
+  * ``quality`` — pure-numpy temporal-consistency and mIoU-delta math
+    that gates the keyframe speedup (BENCHMARKS.md).
+  * ``frontend`` — HTTP glue mounted into the serve front-end via
+    ``make_server(..., stream_config=...)``.
+
+Session affinity (a session's frames hitting the same warm replica, and
+migrating exactly once on drain/death) lives in the fleet plane:
+``fleet/split.py::affinity_pick`` + the router's binding table.
+"""
+
+from .protocol import (CHEAP_PROVENANCE, FRAME_DROPPED_LATE, FRAME_ERROR,
+                       FRAME_OK, FRAME_STALE, MASK_AGE_HEADER,
+                       MIGRATED_HEADER, PROVENANCE_HEADER, PROV_KEYFRAME,
+                       SEQ_HEADER, SESSION_HEADER)
+from .quality import (mask_agreement, miou, quality_delta,
+                      temporal_consistency)
+from .scheduler import Decision, FrameScheduler, SchedulerConfig, decide
+from .session import (SessionClosed, SessionExists, SessionLimit,
+                      SessionTable, StreamConfig, StreamSession)
+from .frontend import StreamFrontend
+
+__all__ = [
+    'CHEAP_PROVENANCE', 'FRAME_DROPPED_LATE', 'FRAME_ERROR', 'FRAME_OK',
+    'FRAME_STALE', 'MASK_AGE_HEADER', 'MIGRATED_HEADER',
+    'PROVENANCE_HEADER', 'PROV_KEYFRAME', 'SEQ_HEADER', 'SESSION_HEADER',
+    'mask_agreement', 'miou', 'quality_delta', 'temporal_consistency',
+    'Decision', 'FrameScheduler', 'SchedulerConfig', 'decide',
+    'SessionClosed', 'SessionExists', 'SessionLimit', 'SessionTable',
+    'StreamConfig', 'StreamSession', 'StreamFrontend',
+]

@@ -1,0 +1,497 @@
+"""HTTP glue for streaming sessions: routes, cheap paths, telemetry.
+
+:class:`StreamFrontend` hangs off a ServeHTTPServer (``make_server(...,
+stream_config=...)``) and owns the session table, the live-plane stream
+metrics and the segscope ``frame``/``session`` events. The serve
+front-end delegates ``POST /session``, ``POST /frame`` and ``POST
+/session/<id>/close`` here, inside the same admission token predicts
+use — so a draining replica answers frames 503 + ``X-Replica-State:
+draining`` and the fleet router migrates the session instead of
+surfacing an error.
+
+Cheap paths (scheduler ``cheap_mode``):
+
+  * ``reuse`` — answer the cached keyframe mask as-is. Zero decode, zero
+    device work; the baseline the bench always reports.
+  * ``warp`` — decode a small grayscale thumbnail, estimate a global
+    integer translation against the keyframe's thumbnail (SSD over a
+    +-4 px search at thumb scale), and ``np.roll`` the keyframe mask by
+    that motion. Always warps FROM the keyframe (no drift
+    accumulation). The thumbnail diff doubles as the scheduler's
+    staleness signal.
+  * ``light`` — decode, 2x-downsample, re-encode and run the full
+    network at the half-resolution bucket (which must be sealed into
+    the executable table — ``segserve --stream`` adds it), then
+    nearest-upsample the mask. Real device work, ~1/4 the FLOPs.
+
+Keyframes go through ``pipeline.submit_bytes`` exactly like a
+``/predict`` — same batcher, same deadline drop-late semantics, same
+sealed-table guard (a whole session is zero-retrace by construction
+because ``/session`` pinned its bucket at open).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import math
+import time
+from typing import Optional, Tuple
+
+import numpy as np
+
+from ..obs import get_sink
+from ..obs.tracing import TRACE_KEY, new_trace_id, valid_trace_id
+from ..serve.batcher import ServeDrop, ServeReject
+from ..serve.engine import UnknownBucket, select_bucket
+from .protocol import (FRAME_DROPPED_LATE, FRAME_ERROR, FRAME_OK,
+                       FRAME_STALE, MASK_AGE_HEADER, MIGRATED_HEADER,
+                       PROVENANCE_HEADER, PROV_KEYFRAME, SEQ_HEADER,
+                       SESSION_HEADER)
+from .session import (SessionClosed, SessionExists, SessionLimit,
+                      SessionTable, StreamConfig)
+
+#: replica-side frame statuses (stream_frames_total label values);
+#: 'rejected' = batcher admission 503 on a keyframe
+FRAME_STATUSES = (FRAME_OK, FRAME_DROPPED_LATE, FRAME_STALE, 'rejected',
+                  FRAME_ERROR)
+
+#: thumbnail stride for warp/staleness (decoded image -> thumb)
+_THUMB_STRIDE = 8
+#: warp motion search radius, in thumb pixels
+_WARP_RADIUS = 4
+
+
+def _decode_thumb(data: bytes) -> np.ndarray:
+    """bytes -> small grayscale f32 thumb in [0, 1] (warp + staleness)."""
+    from PIL import Image
+    img = np.asarray(Image.open(io.BytesIO(data)).convert('L'),
+                     dtype=np.float32) / 255.0
+    return img[::_THUMB_STRIDE, ::_THUMB_STRIDE]
+
+
+def estimate_shift(ref: np.ndarray, cur: np.ndarray,
+                   radius: int = _WARP_RADIUS) -> Tuple[int, int]:
+    """Global integer translation (dy, dx) that best maps ``ref`` onto
+    ``cur``: argmin SSD over a (2r+1)^2 circular-shift search on the
+    thumbnails. Circular shift matches the np.roll warp applied to the
+    mask, so the estimate and the warp agree about edge wrap."""
+    if ref.shape != cur.shape or ref.size == 0:
+        return 0, 0
+    best, best_err = (0, 0), math.inf
+    for dy in range(-radius, radius + 1):
+        for dx in range(-radius, radius + 1):
+            err = float(np.mean(
+                (np.roll(ref, (dy, dx), axis=(0, 1)) - cur) ** 2))
+            if err < best_err:
+                best, best_err = (dy, dx), err
+    return best
+
+
+def staleness_of(ref: Optional[np.ndarray],
+                 cur: np.ndarray) -> Optional[float]:
+    """Mean abs thumbnail diff in [0, 1] — the scene-change signal that
+    forces an early keyframe (None before the first keyframe)."""
+    if ref is None or ref.shape != cur.shape:
+        return None
+    return float(np.mean(np.abs(ref - cur)))
+
+
+class StreamFrontend:
+    """Session routes + cheap-path execution over one ServePipeline."""
+
+    def __init__(self, pipeline, config: StreamConfig,
+                 replica_id: Optional[str] = None):
+        self.pipeline = pipeline
+        self.config = config
+        self.replica_id = replica_id
+        self.table = SessionTable(config)
+        reg = pipeline.registry
+        self._c_sessions = {
+            a: reg.counter('stream_sessions_total',
+                           help='session lifecycle events', action=a)
+            for a in ('open', 'adopt', 'close', 'expire')}
+        # frontend-incremented (NOT the pipeline's serve_requests_total:
+        # cheap frames never enter the pipeline) — the replica leg of the
+        # router==replica==loadgen frame reconciliation
+        self._c_frames = {
+            s: reg.counter('stream_frames_total',
+                           help='frames by outcome', status=s)
+            for s in FRAME_STATUSES}
+        self._c_prov = {
+            p: reg.counter('stream_frames_by_provenance_total',
+                           help='ok frames by mask provenance',
+                           provenance=p)
+            for p in (PROV_KEYFRAME, 'reused', 'warped', 'light')}
+        self._h_e2e = reg.histogram('stream_frame_e2e_ms')
+        self._g_active = reg.gauge('stream_active_sessions')
+
+    # ---------------------------------------------------------- helpers
+    def _emit(self, event: dict) -> None:
+        sink = get_sink()
+        if sink is not None:
+            if self.replica_id is not None:
+                event.setdefault('replica', self.replica_id)
+            sink.emit(event)
+
+    def _sweep(self) -> None:
+        for stats in self.table.sweep():
+            self._c_sessions['expire'].inc()
+            self._emit({'event': 'session', 'action': 'expire',
+                        'session': stats['session'],
+                        'frames': stats['frames']})
+        self._g_active.set(float(self.table.active()))
+
+    def _pick_bucket(self, h: int, w: int) -> Tuple[int, int]:
+        """Pin the session to the sealed bucket that fits (h, w); no
+        engine (stub pipelines) means the request shape IS the bucket."""
+        engine = getattr(self.pipeline, 'engine', None)
+        buckets = getattr(engine, 'buckets', None)
+        if not buckets:
+            return (h, w)
+        b = select_bucket(buckets, h, w)
+        if b is None:
+            raise UnknownBucket(
+                f'no bucket fits {h}x{w}; sealed table: '
+                + ','.join(f'{bh}x{bw}' for bh, bw in buckets))
+        return b
+
+    # ------------------------------------------------------------ routes
+    def handle_post(self, handler, path: str, data: bytes, tid: str,
+                    trace_hdr: dict) -> None:
+        if path == '/session':
+            self._open(handler, data, trace_hdr)
+        elif path == '/frame':
+            self._frame(handler, data, tid, trace_hdr)
+        elif path.startswith('/session/') and path.endswith('/close'):
+            sid = path[len('/session/'):-len('/close')]
+            self._close(handler, sid, trace_hdr)
+        else:
+            handler._send_json(404, {'error': f'no stream route {path}'},
+                               trace_hdr)
+
+    def _open(self, handler, data: bytes, trace_hdr: dict) -> None:
+        self._sweep()
+        try:
+            body = json.loads(data.decode() or '{}')
+            h, w = int(body['h']), int(body['w'])
+        except (ValueError, KeyError, TypeError):
+            handler._send_json(400, {'error': 'body must be JSON with '
+                                              'integer h and w'},
+                               trace_hdr)
+            return
+        inbound = handler.headers.get(SESSION_HEADER)
+        sid = inbound if valid_trace_id(inbound) else new_trace_id()
+        overrides = {}
+        for key in ('keyframe_interval', 'cheap_mode', 'staleness_max',
+                    'frame_deadline_ms', 'reorder_window'):
+            if key in body:
+                overrides[key] = body[key]
+        try:
+            cfg = (self.config if not overrides
+                   else StreamConfig(**{**self.config.__dict__,
+                                        **overrides}))
+            bucket = self._pick_bucket(h, w)
+            self.table.open(sid, bucket=bucket, config=cfg)
+        except UnknownBucket as e:
+            handler._send_json(413, {'error': str(e)}, trace_hdr)
+            return
+        except SessionExists:
+            handler._send_json(409, {'error': f'session {sid} already '
+                                              f'open'}, trace_hdr)
+            return
+        except SessionLimit as e:
+            handler._send_json(503, {'error': f'session table full '
+                                              f'({e})'}, trace_hdr)
+            return
+        except (ValueError, TypeError) as e:
+            handler._send_json(400, {'error': str(e)}, trace_hdr)
+            return
+        self._c_sessions['open'].inc()
+        self._g_active.set(float(self.table.active()))
+        self._emit({'event': 'session', 'action': 'open', 'session': sid,
+                    'bucket': f'{bucket[0]}x{bucket[1]}'})
+        handler._send_json(200, {
+            'session': sid,
+            'bucket': f'{bucket[0]}x{bucket[1]}',
+            'keyframe_interval': cfg.keyframe_interval,
+            'cheap_mode': cfg.cheap_mode,
+            'frame_deadline_ms': cfg.frame_deadline_ms,
+        }, {**trace_hdr, SESSION_HEADER: sid})
+
+    def _close(self, handler, sid: str, trace_hdr: dict) -> None:
+        if not valid_trace_id(sid):
+            handler._send_json(400, {'error': f'malformed session id '
+                                              f'{sid!r}'}, trace_hdr)
+            return
+        stats = self.table.close(sid)
+        self._g_active.set(float(self.table.active()))
+        if stats is None:
+            # the session already expired or lived on another replica;
+            # closing it is a no-op, not an error (zero-error migration)
+            handler._send_json(200, {'session': sid, 'closed': False,
+                                     'note': 'unknown here'},
+                               {**trace_hdr, SESSION_HEADER: sid})
+            return
+        self._c_sessions['close'].inc()
+        self._emit({'event': 'session', 'action': 'close',
+                    'session': sid, 'frames': stats['frames'],
+                    'provenance': stats['provenance']})
+        handler._send_json(200, {'closed': True, **stats},
+                           {**trace_hdr, SESSION_HEADER: sid})
+
+    # ------------------------------------------------------------ frames
+    def _frame(self, handler, data: bytes, tid: str,
+               trace_hdr: dict) -> None:
+        sid = handler.headers.get(SESSION_HEADER)
+        seq_raw = handler.headers.get(SEQ_HEADER)
+        if not valid_trace_id(sid):
+            handler._send_json(400, {'error': f'{SESSION_HEADER} missing '
+                                              f'or malformed'}, trace_hdr)
+            return
+        try:
+            seq = int(seq_raw)
+            if seq < 0:
+                raise ValueError
+        except (TypeError, ValueError):
+            handler._send_json(400, {'error': f'{SEQ_HEADER} must be a '
+                                              f'non-negative integer'},
+                               trace_hdr)
+            return
+        t0 = time.perf_counter()
+        base_hdr = {**trace_hdr, SESSION_HEADER: sid,
+                    SEQ_HEADER: str(seq)}
+        sess = self.table.get(sid)
+        migrated = handler.headers.get(MIGRATED_HEADER) is not None
+        if sess is None:
+            # this replica has never seen the session: the router
+            # migrated it here, or it expired. Adopt it — forced
+            # keyframe, zero client-visible errors.
+            try:
+                sess, created = self.table.adopt(sid, first_seq=seq)
+            except SessionLimit as e:
+                self._count(FRAME_ERROR)
+                handler._send_json(503, {'error': f'session table full '
+                                                  f'({e})'}, base_hdr)
+                return
+            if created:
+                self._c_sessions['adopt'].inc()
+                self._g_active.set(float(self.table.active()))
+                self._emit({'event': 'session', 'action': 'adopt',
+                            'session': sid, 'seq': seq,
+                            'migrated': migrated})
+        deadline_ms = self._deadline_ms(handler, sess)
+        if deadline_ms is not None and deadline_ms <= 0:
+            self._count(FRAME_DROPPED_LATE)
+            self._respond_drop(handler, FRAME_DROPPED_LATE, sid, seq,
+                               t0, base_hdr)
+            return
+        deadline_at = (t0 + deadline_ms / 1e3
+                       if deadline_ms is not None else None)
+        try:
+            turn = sess.wait_turn(seq, deadline_at)
+        except SessionClosed:
+            # closed/expired between lookup and wait: re-adopt once
+            sess, created = self.table.adopt(sid, first_seq=seq)
+            if created:
+                self._c_sessions['adopt'].inc()
+                self._emit({'event': 'session', 'action': 'adopt',
+                            'session': sid, 'seq': seq,
+                            'migrated': migrated})
+            turn = sess.wait_turn(seq, deadline_at)
+        if turn in (FRAME_STALE, FRAME_DROPPED_LATE):
+            self._count(turn)
+            self._respond_drop(handler, turn, sid, seq, t0, base_hdr)
+            return
+        # --- this thread owns the stream cursor until complete() ---
+        thumb = None
+        decision, last_mask, last_thumb, _age = sess.plan()
+        if sess.config.cheap_mode in ('warp', 'light'):
+            # these modes decode a small thumb anyway (motion / light
+            # input); its diff against the keyframe thumb is the
+            # staleness signal. reuse mode skips the decode entirely —
+            # that is its whole point — and relies on the interval alone
+            try:
+                thumb = _decode_thumb(data)
+            except Exception:   # noqa: BLE001 — undecodable frame
+                self._finish_frame(handler, sess, sid, seq, decision,
+                                   FRAME_ERROR, 400,
+                                   'frame does not decode', t0, base_hdr)
+                return
+            staleness = staleness_of(last_thumb, thumb)
+            if staleness is not None and decision.kind == 'cheap' \
+                    and staleness >= sess.config.staleness_max:
+                # re-plan with the computed staleness: forces the early
+                # keyframe the pure policy would have chosen
+                sess.force_keyframe('staleness')
+                decision, last_mask, last_thumb, _age = sess.plan()
+        if decision.kind == 'keyframe':
+            self._keyframe(handler, sess, sid, seq, decision, data,
+                           thumb, deadline_ms, tid, t0, base_hdr,
+                           migrated)
+        else:
+            self._cheap(handler, sess, sid, seq, decision, last_mask,
+                        last_thumb, thumb, data, t0, base_hdr, migrated)
+
+    def _deadline_ms(self, handler, sess) -> Optional[float]:
+        from ..serve.server import DEADLINE_HEADER
+        raw = handler.headers.get(DEADLINE_HEADER)
+        if raw is not None:
+            try:
+                dl = float(raw)
+                if math.isfinite(dl):
+                    return dl
+            except ValueError:
+                pass
+        return sess.config.frame_deadline_ms
+
+    # ------------------------------------------------------- executions
+    def _keyframe(self, handler, sess, sid, seq, decision, data, thumb,
+                  deadline_ms, tid, t0, base_hdr, migrated) -> None:
+        try:
+            fut = self.pipeline.submit_bytes(
+                data, deadline_ms=deadline_ms,
+                meta={TRACE_KEY: tid, 'session': sid, 'seq': seq})
+            res = fut.result(timeout=handler.server.request_timeout_s)
+        except ServeReject as e:
+            self._finish_frame(handler, sess, sid, seq, decision,
+                              'rejected', 503, str(e), t0, base_hdr)
+            return
+        except ServeDrop as e:
+            self._finish_frame(handler, sess, sid, seq, decision,
+                              FRAME_DROPPED_LATE, 504, str(e), t0,
+                              base_hdr)
+            return
+        except UnknownBucket as e:
+            self._finish_frame(handler, sess, sid, seq, decision,
+                              FRAME_ERROR, 413, str(e), t0, base_hdr)
+            return
+        except Exception as e:   # noqa: BLE001 — surface, don't hang
+            self._finish_frame(handler, sess, sid, seq, decision,
+                              FRAME_ERROR, 500,
+                              f'{type(e).__name__}: {e}', t0, base_hdr)
+            return
+        age = sess.complete(seq, FRAME_OK, decision, mask=res.mask,
+                            thumb=thumb)
+        self._respond_mask(handler, res.mask, decision, age, sid, seq,
+                           t0, base_hdr, migrated,
+                           timings=res.timings)
+
+    def _cheap(self, handler, sess, sid, seq, decision, last_mask,
+               last_thumb, thumb, data, t0, base_hdr, migrated) -> None:
+        prov = decision.provenance
+        try:
+            if prov == 'reused':
+                mask = last_mask
+            elif prov == 'warped':
+                dy, dx = ((0, 0) if last_thumb is None or thumb is None
+                          else estimate_shift(last_thumb, thumb))
+                mask = np.roll(last_mask,
+                               (dy * _THUMB_STRIDE, dx * _THUMB_STRIDE),
+                               axis=(0, 1))
+            else:   # light: half-res pass through the sealed half bucket
+                mask = self._light_mask(last_mask, data, handler, sid,
+                                        seq)
+        except Exception as e:   # noqa: BLE001 — surface, don't hang
+            self._finish_frame(handler, sess, sid, seq, decision,
+                              FRAME_ERROR, 500,
+                              f'{type(e).__name__}: {e}', t0, base_hdr)
+            return
+        age = sess.complete(seq, FRAME_OK, decision, thumb=thumb)
+        self._respond_mask(handler, mask, decision, age, sid, seq, t0,
+                           base_hdr, migrated)
+
+    def _light_mask(self, last_mask, data, handler, sid,
+                    seq) -> np.ndarray:
+        """Decode, 2x-downsample, run the half-res bucket, upsample."""
+        from PIL import Image
+        img = Image.open(io.BytesIO(data)).convert('RGB')
+        small = img.resize((max(1, img.width // 2),
+                            max(1, img.height // 2)), Image.BILINEAR)
+        buf = io.BytesIO()
+        small.save(buf, format='PNG')
+        fut = self.pipeline.submit_bytes(
+            buf.getvalue(), meta={'session': sid, 'seq': seq,
+                                  'light': True})
+        res = fut.result(timeout=handler.server.request_timeout_s)
+        up = np.repeat(np.repeat(res.mask, 2, axis=0), 2, axis=1)
+        if last_mask is not None and up.shape != last_mask.shape:
+            up = up[:last_mask.shape[0], :last_mask.shape[1]]
+        return up
+
+    # -------------------------------------------------------- responses
+    def _count(self, status: str) -> None:
+        c = self._c_frames.get(status)
+        if c is not None:
+            c.inc()
+
+    def _finish_frame(self, handler, sess, sid, seq, decision, status,
+                      code, error, t0, base_hdr) -> None:
+        """Error/drop outcome for the frame HOLDING the cursor: record,
+        advance, answer."""
+        sess.complete(seq, status, decision)
+        self._count(status)
+        e2e = (time.perf_counter() - t0) * 1e3
+        self._h_e2e.observe(e2e)
+        self._emit({'event': 'frame', 'session': sid, 'seq': seq,
+                    'status': status, 'provenance': decision.provenance,
+                    'reason': decision.reason, 'e2e_ms': round(e2e, 3)})
+        handler._send_json(code, {'error': error, 'status': status},
+                           base_hdr)
+
+    def _respond_drop(self, handler, status, sid, seq, t0,
+                      base_hdr) -> None:
+        """stale/dropped-late outcome decided in wait_turn (session
+        counters already updated there)."""
+        e2e = (time.perf_counter() - t0) * 1e3
+        self._h_e2e.observe(e2e)
+        self._emit({'event': 'frame', 'session': sid, 'seq': seq,
+                    'status': status, 'e2e_ms': round(e2e, 3)})
+        msg = ('frame arrived behind the stream cursor'
+               if status == FRAME_STALE
+               else 'deadline expired waiting for predecessors')
+        handler._send_json(504, {'error': msg, 'status': status},
+                           base_hdr)
+
+    def _respond_mask(self, handler, mask, decision, age, sid, seq, t0,
+                      base_hdr, migrated, timings=None) -> None:
+        self._count(FRAME_OK)
+        c = self._c_prov.get(decision.provenance)
+        if c is not None:
+            c.inc()
+        e2e = (time.perf_counter() - t0) * 1e3
+        self._h_e2e.observe(e2e)
+        self._emit({'event': 'frame', 'session': sid, 'seq': seq,
+                    'status': FRAME_OK,
+                    'provenance': decision.provenance,
+                    'reason': decision.reason, 'mask_age': age,
+                    'e2e_ms': round(e2e, 3)})
+        timing = json.dumps({'e2e_ms': round(e2e, 3),
+                             **{k: round(v, 3)
+                                for k, v in (timings or {}).items()}})
+        extra = {**base_hdr, PROVENANCE_HEADER: decision.provenance,
+                 MASK_AGE_HEADER: str(age), 'X-Serve-Timing': timing}
+        if migrated:
+            extra[MIGRATED_HEADER] = '1'
+        import urllib.parse
+        query = urllib.parse.parse_qs(
+            urllib.parse.urlsplit(handler.path).query)
+        if query.get('raw', ['0'])[0] not in ('0', '', 'false'):
+            h, w = mask.shape
+            handler._send(200, np.ascontiguousarray(mask).tobytes(),
+                          'application/octet-stream',
+                          {'X-Mask-Shape': f'{h},{w}',
+                           'X-Mask-Dtype': 'int8', **extra})
+            return
+        cmap = handler.server.colormap
+        if cmap is None:
+            handler._send_json(500, {'error': 'server has no colormap; '
+                                              'use ?raw=1'}, base_hdr)
+            return
+        from PIL import Image
+        buf = io.BytesIO()
+        Image.fromarray(cmap[mask]).save(buf, format='PNG')
+        handler._send(200, buf.getvalue(), 'image/png', extra)
+
+    def stats(self) -> dict:
+        return self.table.stats()

@@ -1,0 +1,63 @@
+"""segstream wire protocol: header names and shared constants.
+
+Kept in its own stdlib-only module so the fleet router (which speaks the
+protocol but holds no session state beyond the affinity binding) can
+import the names without pulling the numpy-backed session/frontend
+machinery.
+
+Protocol summary (full prose in README "Streaming video"):
+
+  * ``POST /session`` opens a session. The JSON body pins the session to
+    one (H, W) bucket — the sealed-executable-table guard stays armed,
+    so a whole session is zero-retrace *by construction*. The response
+    echoes the session id in ``X-Session-Id``.
+  * ``POST /frame`` carries one encoded frame with ``X-Session-Id`` and
+    a monotonically increasing ``X-Frame-Seq``. Out-of-order frames are
+    reordered within a bounded window; a frame whose predecessors never
+    show up before its deadline is dropped late (504) and the stream
+    skips past it — latency never collapses into a backlog.
+  * ``POST /session/<id>/close`` tears the session down and returns its
+    stats.
+
+Every 200 frame response carries ``X-Frame-Provenance`` (keyframe |
+reused | warped | light — which path produced the mask) and
+``X-Mask-Age`` (frames since the mask's source keyframe — the client's
+freshness signal). A router that re-homed the session mid-stream stamps
+``X-Session-Migrated: 1`` on the first response from the new replica.
+"""
+
+from __future__ import annotations
+
+#: request+response header carrying the session id (16 hex chars, same
+#: alphabet/validation as trace ids — obs/tracing.valid_trace_id)
+SESSION_HEADER = 'X-Session-Id'
+
+#: request header: this frame's position in the session's stream
+SEQ_HEADER = 'X-Frame-Seq'
+
+#: response header: which path produced this mask
+PROVENANCE_HEADER = 'X-Frame-Provenance'
+
+#: response header: frames since the mask's source keyframe (0 = fresh)
+MASK_AGE_HEADER = 'X-Mask-Age'
+
+#: router->replica hint + router->client echo: the session was re-homed
+#: (bound replica drained/died); the new replica forces a keyframe
+MIGRATED_HEADER = 'X-Session-Migrated'
+
+#: frame outcome vocabulary — shared by replica counters, router
+#: counters, the loadgen video report and segscope's session section
+FRAME_OK = 'ok'
+FRAME_DROPPED_LATE = 'dropped_late'   # deadline hit waiting for its turn
+FRAME_STALE = 'stale'                 # arrived behind the stream cursor
+FRAME_ERROR = 'error'
+
+#: provenance vocabulary (PROVENANCE_HEADER values)
+PROV_KEYFRAME = 'keyframe'
+PROV_REUSED = 'reused'
+PROV_WARPED = 'warped'
+PROV_LIGHT = 'light'
+
+#: cheap-path mode -> provenance stamped on its frames
+CHEAP_PROVENANCE = {'reuse': PROV_REUSED, 'warp': PROV_WARPED,
+                    'light': PROV_LIGHT}

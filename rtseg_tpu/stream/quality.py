@@ -1,0 +1,95 @@
+"""Temporal-quality math for streaming segmentation — pure numpy.
+
+Two measurements gate the keyframe scheduler (BENCHMARKS.md "Video
+serving methodology"):
+
+  * **Temporal consistency** — mean fraction of pixels on which
+    consecutive masks of one session agree. A scheduler that reuses or
+    warps masks between keyframes scores *higher* than keyframe-every-
+    frame (its cheap frames are temporally smooth by construction), so
+    this metric alone can't justify the speedup — which is why it is
+    always reported next to the quality delta below.
+  * **Quality delta** — per-frame mIoU of the scheduled pass's masks
+    against a keyframe-every-frame reference pass over the *same*
+    payloads. The reference is the best the deployed network can do on
+    each frame, so the delta isolates exactly what the cheap path costs.
+
+Kept free of serve/fleet imports so loadgen and the CLIs can call in
+from anywhere without an import cycle.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+def mask_agreement(a: np.ndarray, b: np.ndarray) -> float:
+    """Fraction of pixels on which two class-id masks agree, in [0, 1]."""
+    a = np.asarray(a)
+    b = np.asarray(b)
+    if a.shape != b.shape:
+        raise ValueError(f'mask shapes differ: {a.shape} vs {b.shape}')
+    if a.size == 0:
+        return 1.0
+    return float(np.mean(a == b))
+
+
+def temporal_consistency(masks: Sequence[np.ndarray]) -> Optional[float]:
+    """Mean :func:`mask_agreement` over consecutive mask pairs of one
+    session (None when fewer than two masks — no pairs to score)."""
+    if len(masks) < 2:
+        return None
+    pairs = [mask_agreement(masks[i], masks[i + 1])
+             for i in range(len(masks) - 1)]
+    return float(np.mean(pairs))
+
+
+def miou(pred: np.ndarray, ref: np.ndarray,
+         num_class: Optional[int] = None) -> float:
+    """Mean IoU of ``pred`` against ``ref`` over the classes present in
+    either mask (classes absent from both don't dilute the mean). With
+    ``num_class`` the class axis is bounded; ids outside it still count
+    as (their own) classes via the union of observed ids. Identical
+    masks score 1.0; disjoint ones 0.0."""
+    pred = np.asarray(pred).ravel()
+    ref = np.asarray(ref).ravel()
+    if pred.shape != ref.shape:
+        raise ValueError(f'mask sizes differ: {pred.shape} vs {ref.shape}')
+    classes = np.union1d(np.unique(pred), np.unique(ref))
+    if num_class is not None:
+        classes = classes[(classes >= 0) & (classes < num_class)]
+    if classes.size == 0:
+        return 1.0
+    ious = []
+    for c in classes:
+        p, r = pred == c, ref == c
+        union = np.count_nonzero(p | r)
+        if union == 0:
+            continue
+        ious.append(np.count_nonzero(p & r) / union)
+    return float(np.mean(ious)) if ious else 1.0
+
+
+def quality_delta(scheduled: Dict, reference: Dict,
+                  num_class: Optional[int] = None) -> dict:
+    """Per-frame mIoU of a scheduled pass against its keyframe-every-
+    frame reference pass. Both dicts map ``(session, seq) -> mask``;
+    only keys present in *both* are scored (a frame dropped late in one
+    pass has no counterpart to compare). Returns the mean, the worst
+    frame, and a per-frame table sorted by (session, seq) for the
+    committed bench log."""
+    keys = sorted(set(scheduled) & set(reference))
+    rows: List[dict] = []
+    for key in keys:
+        score = miou(scheduled[key], reference[key], num_class=num_class)
+        rows.append({'session': key[0], 'seq': key[1],
+                     'miou': round(score, 4)})
+    scores = [r['miou'] for r in rows]
+    return {
+        'frames_compared': len(rows),
+        'mean_miou': round(float(np.mean(scores)), 4) if scores else None,
+        'min_miou': round(float(np.min(scores)), 4) if scores else None,
+        'per_frame': rows,
+    }

@@ -1,0 +1,112 @@
+"""Temporal keyframe scheduler — a pure policy, then a thin stateful
+wrapper.
+
+The policy (:func:`decide`) answers one question per frame: run the full
+network (**keyframe**) or a cheap path (**reuse** the last mask, **warp**
+it by estimated motion, or a **light** half-resolution pass)? The rules,
+in priority order:
+
+  1. a *force* (first frame of a session, session just migrated to a new
+     replica, or the previous keyframe failed) always wins;
+  2. ``since_keyframe >= keyframe_interval`` schedules the periodic
+     keyframe (``keyframe_interval=1`` is the keyframe-every-frame
+     baseline the bench compares against);
+  3. a computed ``staleness`` (mean abs diff of the incoming frame's
+     thumbnail against the keyframe's — scene change signal) at or above
+     ``staleness_max`` forces an early keyframe;
+  4. otherwise the cheap path runs.
+
+``decide`` is pure — (inputs) -> Decision with no clock, no randomness,
+no hidden state — so the policy table is pinned by seeded tests with
+clean twins. :class:`FrameScheduler` adds the per-session bookkeeping
+(frames since last keyframe, pending force) and is *not* itself
+thread-safe: segstream serializes frames per session on the session's
+condition (stream/session.py), so exactly one thread consults the
+scheduler at a time.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import NamedTuple, Optional
+
+from .protocol import CHEAP_PROVENANCE, PROV_KEYFRAME
+
+
+@dataclass(frozen=True)
+class SchedulerConfig:
+    """Scheduler knobs (CLI: ``--keyframe-interval``, ``--cheap-mode``,
+    ``--staleness-max``)."""
+    keyframe_interval: int = 8
+    cheap_mode: str = 'reuse'          # reuse | warp | light
+    staleness_max: float = 0.25        # thumb mean-abs-diff trigger
+
+    def __post_init__(self):
+        if self.keyframe_interval < 1:
+            raise ValueError(f'keyframe_interval must be >= 1, '
+                             f'got {self.keyframe_interval}')
+        if self.cheap_mode not in CHEAP_PROVENANCE:
+            raise ValueError(f'cheap_mode must be one of '
+                             f'{sorted(CHEAP_PROVENANCE)}, '
+                             f'got {self.cheap_mode!r}')
+
+
+class Decision(NamedTuple):
+    """One frame's scheduling decision."""
+    kind: str          # 'keyframe' | 'cheap'
+    reason: str        # 'first' | 'forced' | 'interval' | 'staleness'
+    provenance: str    # what the response header will say
+
+
+def decide(since_keyframe: int, staleness: Optional[float],
+           force: Optional[str], config: SchedulerConfig) -> Decision:
+    """The pure policy: see the module docstring for the rule order.
+    ``force`` is None or the reason string to stamp ('first', 'forced',
+    ...); ``staleness`` is None when the cheap mode measures none
+    (reuse mode never decodes, so it relies on the interval alone)."""
+    if force is not None:
+        return Decision('keyframe', force, PROV_KEYFRAME)
+    if since_keyframe >= config.keyframe_interval:
+        return Decision('keyframe', 'interval', PROV_KEYFRAME)
+    if staleness is not None and staleness >= config.staleness_max:
+        return Decision('keyframe', 'staleness', PROV_KEYFRAME)
+    return Decision('cheap', 'cheap', CHEAP_PROVENANCE[config.cheap_mode])
+
+
+class FrameScheduler:
+    """Per-session bookkeeping around :func:`decide`.
+
+    NOT thread-safe by itself — the owning StreamSession serializes
+    frames on its condition, so one thread at a time calls in here."""
+
+    def __init__(self, config: SchedulerConfig):
+        self.config = config
+        #: frames since the last keyframe, INCLUDING the one being
+        #: decided — so with interval K, keyframes land on every Kth
+        #: frame (0, K, 2K, ...), K-1 cheap frames between
+        self.since_keyframe = 0
+        self._force: Optional[str] = 'first'   # session's first frame
+
+    def next(self, staleness: Optional[float] = None) -> Decision:
+        """Decide the current frame and book-keep optimistically: a
+        keyframe decision resets the interval counter. If the keyframe
+        then *fails* (dropped/errored downstream), the caller must
+        :meth:`force` so the next frame retries the full network instead
+        of reusing a mask that was never refreshed."""
+        self.since_keyframe += 1
+        d = decide(self.since_keyframe, staleness, self._force,
+                   self.config)
+        self._force = None
+        if d.kind == 'keyframe':
+            self.since_keyframe = 0
+        return d
+
+    def force(self, reason: str = 'forced') -> None:
+        """Make the next decision a keyframe (migration landed here, or
+        the last keyframe never produced a mask)."""
+        self._force = reason
+
+    @property
+    def pending(self) -> Optional[str]:
+        """The queued force reason, if any (None between forces)."""
+        return self._force

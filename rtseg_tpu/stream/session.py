@@ -1,0 +1,310 @@
+"""Streaming session state: per-session ordering + the process table.
+
+Concurrency model (audited by segrace — rtseg_tpu/analysis/concurrency):
+
+  * Every :class:`StreamSession` owns ONE ``threading.Condition`` that
+    guards *all* of its mutable fields (stream cursor, mask cache,
+    counters, scheduler bookkeeping). HTTP handler threads serialize per
+    session on it: :meth:`wait_turn` parks a frame until its sequence
+    number is up, :meth:`complete` advances the cursor and notifies.
+    ``notify_all`` only ever runs with the condition held.
+  * :class:`SessionTable`'s lock guards only the id->session dict and is
+    **never held while a session's condition is taken** — sweep/close
+    pop under the table lock, then finalize the session outside it, so
+    the lock graph stays a two-level tree (table -> nothing,
+    session -> nothing).
+  * Pipeline submission, mask math and response I/O all happen outside
+    both locks (stream/frontend.py).
+
+Ordering semantics: frames carry a client-assigned sequence number. The
+session keeps a cursor (next expected seq). A frame ahead of the cursor
+waits — bounded by min(its deadline, ``reorder_wait_ms``) — for its
+predecessors; if they never arrive it is **dropped late** (504) and the
+cursor skips past it, so one lost frame costs one drop, never a growing
+backlog. A frame behind the cursor is **stale** (its slot was already
+given up on). A frame more than ``reorder_window`` ahead snaps the
+cursor forward (the gap is declared lost) so a burst of loss cannot park
+a window's worth of handler threads.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from .protocol import (FRAME_DROPPED_LATE, FRAME_ERROR, FRAME_OK,
+                       FRAME_STALE, PROV_KEYFRAME)
+from .scheduler import Decision, FrameScheduler, SchedulerConfig
+
+
+class SessionClosed(Exception):
+    """The session was closed/expired while this frame was in flight."""
+
+
+class SessionExists(Exception):
+    """POST /session with an id that is already open."""
+
+
+class SessionLimit(Exception):
+    """The table is at max_sessions (the open answers 503)."""
+
+
+@dataclass(frozen=True)
+class StreamConfig:
+    """Session-plane knobs (scheduler knobs ride along so one object
+    configures a replica's whole stream frontend)."""
+    keyframe_interval: int = 8
+    cheap_mode: str = 'reuse'
+    staleness_max: float = 0.25
+    frame_deadline_ms: Optional[float] = 1000.0   # default per-frame SLO
+    reorder_window: int = 8
+    reorder_wait_ms: float = 250.0
+    session_ttl_s: float = 120.0
+    max_sessions: int = 256
+
+    def scheduler_config(self) -> SchedulerConfig:
+        return SchedulerConfig(keyframe_interval=self.keyframe_interval,
+                               cheap_mode=self.cheap_mode,
+                               staleness_max=self.staleness_max)
+
+
+class StreamSession:
+    """One client's ordered frame stream, pinned to one bucket."""
+
+    def __init__(self, session_id: str, config: StreamConfig,
+                 bucket: Optional[Tuple[int, int]] = None,
+                 first_seq: int = 0, force_reason: str = 'first'):
+        self.session_id = session_id
+        self.config = config
+        self._cond = threading.Condition()
+        # --- everything below is guarded by _cond ---
+        self._bucket = bucket
+        self._scheduler = FrameScheduler(config.scheduler_config())
+        if force_reason != 'first':
+            self._scheduler.force(force_reason)
+        self._next_seq = first_seq
+        self._closed = False
+        self._last_active = time.monotonic()
+        self._last_mask = None           # np int8 — the keyframe mask
+        self._last_thumb = None          # small f32 gray (warp/staleness)
+        self._mask_age = 0               # frames since that keyframe
+        self._counts: Dict[str, int] = {
+            FRAME_OK: 0, FRAME_DROPPED_LATE: 0, FRAME_STALE: 0,
+            FRAME_ERROR: 0, 'reordered': 0, 'gap_skips': 0}
+        self._provenance: Dict[str, int] = {}
+
+    # --------------------------------------------------------- ordering
+    def wait_turn(self, seq: int, deadline_at: Optional[float]) -> str:
+        """Block until ``seq`` is at the cursor. Returns ``'run'`` (the
+        caller owns the stream until it calls :meth:`complete`),
+        ``'stale'`` (behind the cursor) or ``'late'`` (deadline expired
+        waiting — the cursor skips past this frame). Raises
+        :class:`SessionClosed` if the session goes away mid-wait."""
+        wait_until = time.monotonic() + self.config.reorder_wait_ms / 1e3
+        if deadline_at is not None:
+            wait_until = min(wait_until, deadline_at)
+        waited = False
+        with self._cond:
+            while True:
+                if self._closed:
+                    raise SessionClosed(self.session_id)
+                if seq < self._next_seq:
+                    self._counts[FRAME_STALE] += 1
+                    self._last_active = time.monotonic()
+                    return FRAME_STALE
+                if seq == self._next_seq:
+                    if waited:
+                        self._counts['reordered'] += 1
+                    return 'run'
+                if seq - self._next_seq > self.config.reorder_window:
+                    # too far ahead: snap the cursor forward, declare the
+                    # gap lost (arriving gap frames will read as stale)
+                    self._counts['gap_skips'] += 1
+                    self._next_seq = seq
+                    self._cond.notify_all()
+                    return 'run'
+                remaining = wait_until - time.monotonic()
+                if remaining <= 0:
+                    # predecessors never showed before the deadline:
+                    # drop THIS frame late and give up on the gap too,
+                    # so the successor isn't doomed to the same wait
+                    self._counts[FRAME_DROPPED_LATE] += 1
+                    self._next_seq = seq + 1
+                    self._last_active = time.monotonic()
+                    self._cond.notify_all()
+                    return FRAME_DROPPED_LATE
+                waited = True
+                self._cond.wait(remaining)
+
+    def plan(self, staleness: Optional[float] = None):
+        """Schedule the frame at the cursor. Returns ``(decision,
+        mask, thumb, mask_age)`` — the mask state the cheap path needs,
+        snapshotted under the lock. Only the thread that got ``'run'``
+        from :meth:`wait_turn` may call this (the cursor serializes)."""
+        with self._cond:
+            if self._last_mask is None:
+                # nothing to serve a cheap path from (first frame, or the
+                # last keyframe failed): retry the full network
+                self._scheduler.force(self._scheduler.pending or 'first')
+            d = self._scheduler.next(staleness)
+            return d, self._last_mask, self._last_thumb, self._mask_age
+
+    def complete(self, seq: int, status: str, decision: Decision,
+                 mask=None, thumb=None) -> int:
+        """Record the outcome of the frame at the cursor, advance it,
+        wake waiters. Returns the mask age to stamp in the response (0
+        for a fresh keyframe). A failed keyframe re-arms a force so the
+        next frame retries the full network."""
+        with self._cond:
+            self._counts[status] = self._counts.get(status, 0) + 1
+            age = self._mask_age
+            if status == FRAME_OK:
+                self._provenance[decision.provenance] = \
+                    self._provenance.get(decision.provenance, 0) + 1
+                if decision.provenance == PROV_KEYFRAME:
+                    self._last_mask = mask
+                    if thumb is not None:
+                        self._last_thumb = thumb
+                    self._mask_age = 0
+                    age = 0
+                else:
+                    # cheap frame: the source keyframe stays cached (warp
+                    # always re-warps FROM the keyframe — no drift
+                    # accumulation); the served mask just aged one frame
+                    self._mask_age += 1
+                    age = self._mask_age
+            elif decision.kind == 'keyframe':
+                self._scheduler.force('forced')
+            if self._next_seq == seq:
+                self._next_seq = seq + 1
+            self._last_active = time.monotonic()
+            self._cond.notify_all()
+            return age
+
+    def force_keyframe(self, reason: str = 'forced') -> None:
+        """Arm a forced keyframe for the next :meth:`plan` (thumbnail
+        staleness over threshold, or a migration hint)."""
+        with self._cond:
+            self._scheduler.force(reason)
+
+    # -------------------------------------------------------- lifecycle
+    def bucket(self) -> Optional[Tuple[int, int]]:
+        with self._cond:
+            return self._bucket
+
+    def set_bucket(self, bucket: Tuple[int, int]) -> None:
+        with self._cond:
+            self._bucket = bucket
+
+    def idle_s(self, now: Optional[float] = None) -> float:
+        now = time.monotonic() if now is None else now
+        with self._cond:
+            return now - self._last_active
+
+    def close(self) -> dict:
+        """Mark closed (waiters raise SessionClosed) and return final
+        stats. Idempotent."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+            return self._stats_locked()
+
+    def stats(self) -> dict:
+        with self._cond:
+            return self._stats_locked()
+
+    def _stats_locked(self) -> dict:
+        out = {'session': self.session_id,
+               'next_seq': self._next_seq,
+               'closed': self._closed,
+               'frames': dict(self._counts),
+               'provenance': dict(self._provenance),
+               'mask_age': self._mask_age}
+        if self._bucket is not None:
+            out['bucket'] = f'{self._bucket[0]}x{self._bucket[1]}'
+        return out
+
+
+class SessionTable:
+    """Process-global id->session registry shared by handler threads."""
+
+    def __init__(self, config: StreamConfig):
+        self.config = config
+        self._lock = threading.Lock()
+        # guarded by _lock; sessions themselves guard their own state
+        self._sessions: Dict[str, StreamSession] = {}
+
+    def open(self, session_id: str,
+             bucket: Optional[Tuple[int, int]] = None,
+             config: Optional[StreamConfig] = None) -> StreamSession:
+        sess = StreamSession(session_id, config or self.config,
+                             bucket=bucket)
+        with self._lock:
+            if session_id in self._sessions:
+                raise SessionExists(session_id)
+            if len(self._sessions) >= self.config.max_sessions:
+                raise SessionLimit(len(self._sessions))
+            self._sessions[session_id] = sess
+        return sess
+
+    def get(self, session_id: str) -> Optional[StreamSession]:
+        with self._lock:
+            return self._sessions.get(session_id)
+
+    def adopt(self, session_id: str,
+              first_seq: int = 0) -> Tuple[StreamSession, bool]:
+        """Get-or-create for a frame whose session this replica has never
+        seen (router migrated it here, or it expired). A freshly adopted
+        session starts at the arriving seq with a forced keyframe — the
+        mask cache is empty, so the cheap path has nothing to reuse."""
+        sess = StreamSession(session_id, self.config,
+                             first_seq=first_seq, force_reason='migrate')
+        with self._lock:
+            cur = self._sessions.get(session_id)
+            if cur is not None:
+                return cur, False
+            if len(self._sessions) >= self.config.max_sessions:
+                raise SessionLimit(len(self._sessions))
+            self._sessions[session_id] = sess
+        return sess, True
+
+    def close(self, session_id: str) -> Optional[dict]:
+        with self._lock:
+            sess = self._sessions.pop(session_id, None)
+        # finalize outside the table lock (session cond is a leaf)
+        return sess.close() if sess is not None else None
+
+    def sweep(self, ttl_s: Optional[float] = None) -> List[dict]:
+        """Expire sessions idle for longer than the TTL. Called
+        opportunistically from the open/frame paths — no background
+        thread to leak. Returns the closed sessions' stats."""
+        ttl = self.config.session_ttl_s if ttl_s is None else ttl_s
+        now = time.monotonic()
+        with self._lock:
+            items = list(self._sessions.items())
+        expired = [sid for sid, sess in items if sess.idle_s(now) >= ttl]
+        out = []
+        for sid in expired:
+            with self._lock:
+                sess = self._sessions.pop(sid, None)
+            if sess is not None:
+                stats = sess.close()
+                stats['expired'] = True
+                out.append(stats)
+        return out
+
+    def active(self) -> int:
+        with self._lock:
+            return len(self._sessions)
+
+    def stats(self) -> dict:
+        with self._lock:
+            sessions = list(self._sessions.values())
+        per = [s.stats() for s in sessions]
+        totals: Dict[str, int] = {}
+        for s in per:
+            for k, v in s['frames'].items():
+                totals[k] = totals.get(k, 0) + v
+        return {'active': len(per), 'frames': totals, 'sessions': per}

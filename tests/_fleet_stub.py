@@ -109,15 +109,31 @@ def main() -> int:
                     help='stamped as X-Artifact-Version (segship tests)')
     ap.add_argument('--mask-value', type=int, default=0,
                     help='int8 fill of the fake mask (output divergence)')
+    ap.add_argument('--stream', action='store_true',
+                    help='mount the segstream session plane (/session, '
+                         '/frame) over the fake pipeline')
+    ap.add_argument('--keyframe-interval', type=int, default=4)
+    ap.add_argument('--cheap-mode', default='reuse')
+    ap.add_argument('--frame-deadline-ms', type=float, default=1000.0)
+    ap.add_argument('--session-ttl-s', type=float, default=120.0)
     args = ap.parse_args()
     if args.start_delay_s > 0:
         time.sleep(args.start_delay_s)
     pipe = FakePipeline(args.delay_ms, ctl_file=args.ctl_file,
                         mask_value=args.mask_value)
+    stream_config = None
+    if args.stream:
+        from rtseg_tpu.stream.session import StreamConfig
+        stream_config = StreamConfig(
+            keyframe_interval=args.keyframe_interval,
+            cheap_mode=args.cheap_mode,
+            frame_deadline_ms=args.frame_deadline_ms,
+            session_ttl_s=args.session_ttl_s)
     cmap = np.zeros((256, 3), np.uint8)
     server = make_server(pipe, host=args.host, port=args.port,
                          colormap=cmap, replica_id=args.replica_id,
-                         artifact_version=args.artifact_version)
+                         artifact_version=args.artifact_version,
+                         stream_config=stream_config)
     port = server.server_address[1]
     if args.port_file:
         tmp = args.port_file + '.tmp'

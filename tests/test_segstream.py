@@ -1,0 +1,620 @@
+"""segstream (rtseg_tpu/stream/): the streaming video session plane.
+
+Pins, layer by layer:
+
+  * fleet/split.py keyed_share — ONE hashing code path behind canary
+    trace splits and session affinity (bit-exact values, so a hash
+    change can't silently re-home every session and re-bucket every
+    canary at once), rendezvous affinity_pick stickiness + minimal
+    migration on replica death;
+  * the pure keyframe policy table (decide) with clean twins, and the
+    FrameScheduler cadence (interval K -> keyframes every Kth frame);
+  * temporal-quality math (mask_agreement / temporal_consistency / miou
+    / quality_delta) on fixed masks;
+  * StreamSession ordering: reorder wait, drop-late cursor advance,
+    gap skip, stale, close semantics, failed-keyframe force re-arm;
+  * the HTTP session protocol over the REAL serve front-end with a fake
+    pipeline (open/frame/close, provenance + mask-age headers, per-open
+    overrides, adoption of unknown sessions, deadline drop-late);
+  * session-affinity routing + migrate-on-kill over real subprocess
+    replicas (tests/_fleet_stub.py --stream) behind the fleet router;
+  * the video loadgen report keys and the segscope report/diff/live
+    streaming sections.
+"""
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from rtseg_tpu import obs
+from rtseg_tpu.fleet import FleetManager, ReplicaGroup, make_router
+from rtseg_tpu.fleet.split import affinity_pick, keyed_share, trace_share
+from rtseg_tpu.stream import (Decision, FrameScheduler, SchedulerConfig,
+                              SessionClosed, SessionTable, StreamConfig,
+                              StreamSession, decide, mask_agreement,
+                              miou, quality_delta, temporal_consistency)
+from rtseg_tpu.stream.protocol import (MASK_AGE_HEADER, MIGRATED_HEADER,
+                                       PROVENANCE_HEADER, SEQ_HEADER,
+                                       SESSION_HEADER)
+
+STUB = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                    '_fleet_stub.py')
+SID = '00112233445566778899aabbccddeeff'
+SID2 = 'ffeeddccbbaa99887766554433221100'
+
+
+def stub_cmd(*extra):
+    def cmd(rid, port_file):
+        return [sys.executable, STUB, '--port-file', port_file,
+                '--replica-id', rid, *extra]
+    return cmd
+
+
+def http_json(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def http_post(url, data=b'', headers=None, timeout=30):
+    req = urllib.request.Request(url, data=data, method='POST',
+                                 headers=headers or {})
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+@pytest.fixture()
+def sink(tmp_path):
+    path = str(tmp_path / 'events-000.jsonl')
+    s = obs.EventSink(path)
+    obs.set_sink(s)
+    yield path
+    obs.set_sink(None)
+    s.close()
+
+
+# ------------------------------------------------------- keyed_share pins
+def test_keyed_share_bit_exact_and_trace_share_alias():
+    # bit-exact: canary splits and session affinity share this hash; a
+    # "harmless" change would re-bucket every canary AND re-home every
+    # session in one deploy
+    assert keyed_share('abc') == pytest.approx(0.728394910460338,
+                                               abs=1e-15)
+    assert keyed_share('abc', salt='r1') == pytest.approx(
+        0.1175933638587594, abs=1e-15)
+    assert trace_share(SID) == keyed_share(SID)
+    assert trace_share(SID) == pytest.approx(0.3487524844240397,
+                                             abs=1e-15)
+    # salted != unsalted, and values stay in [0, 1)
+    assert keyed_share('abc') != keyed_share('abc', salt='r1')
+    for k in ('', 'x', SID):
+        assert 0.0 <= keyed_share(k) < 1.0
+
+
+def test_affinity_pick_sticky_balanced_minimal_move():
+    cands = ['r1', 'r2', 'r3']
+    keys = [f'sess-{i:02d}' for i in range(40)]
+    home = {k: affinity_pick(k, cands) for k in keys}
+    # deterministic and order/duplicate insensitive
+    assert affinity_pick('s1', cands) == 'r2'
+    assert all(affinity_pick(k, ['r3', 'r2', 'r1', 'r2']) == home[k]
+               for k in keys)
+    # every replica gets a share (rendezvous spreads)
+    assert {home[k] for k in keys} == set(cands)
+    # kill r2: ONLY r2's sessions move (rendezvous minimal migration —
+    # mod-N hashing would re-home almost everything)
+    survivors = {k: affinity_pick(k, ['r1', 'r3']) for k in keys}
+    for k in keys:
+        if home[k] != 'r2':
+            assert survivors[k] == home[k]
+        else:
+            assert survivors[k] in ('r1', 'r3')
+    assert affinity_pick('s1', []) is None
+
+
+# ------------------------------------------------------- scheduler policy
+def test_decide_policy_table_with_clean_twins():
+    cfg = SchedulerConfig(keyframe_interval=4, cheap_mode='warp',
+                          staleness_max=0.25)
+    # force always wins, and stamps its reason
+    assert decide(0, 0.9, 'migrate', cfg) == \
+        Decision('keyframe', 'migrate', 'keyframe')
+    # interval fires at K (clean twin: K-1 does not)
+    assert decide(4, None, None, cfg).reason == 'interval'
+    assert decide(3, None, None, cfg) == \
+        Decision('cheap', 'cheap', 'warped')
+    # staleness fires at the threshold (clean twin: just under doesn't)
+    assert decide(1, 0.25, None, cfg).reason == 'staleness'
+    assert decide(1, 0.2499, None, cfg).kind == 'cheap'
+    # cheap provenance follows the mode
+    assert decide(1, None, None,
+                  SchedulerConfig(cheap_mode='reuse')).provenance \
+        == 'reused'
+    assert decide(1, None, None,
+                  SchedulerConfig(cheap_mode='light')).provenance \
+        == 'light'
+    with pytest.raises(ValueError):
+        SchedulerConfig(keyframe_interval=0)
+    with pytest.raises(ValueError):
+        SchedulerConfig(cheap_mode='nope')
+
+
+def test_frame_scheduler_cadence_and_force_rearm():
+    s = FrameScheduler(SchedulerConfig(keyframe_interval=3,
+                                       cheap_mode='reuse'))
+    provs = [s.next().provenance for _ in range(9)]
+    # first frame forced, then exactly K-1 cheap frames between keyframes
+    assert provs == ['keyframe', 'reused', 'reused'] * 3
+    # interval=1 is the keyframe-every-frame reference baseline
+    ref = FrameScheduler(SchedulerConfig(keyframe_interval=1))
+    assert [ref.next().kind for _ in range(4)] == ['keyframe'] * 4
+    # force re-arms: next decision is a keyframe with the given reason,
+    # and the force is consumed (clean twin: the one after is cheap)
+    s.force('forced')
+    assert s.pending == 'forced'
+    assert s.next() == Decision('keyframe', 'forced', 'keyframe')
+    assert s.pending is None
+    assert s.next().kind == 'cheap'
+
+
+# ---------------------------------------------------------- quality math
+def test_quality_math_on_fixed_masks():
+    a = np.array([[0, 0], [1, 1]], np.int8)
+    b = np.array([[0, 0], [1, 2]], np.int8)
+    assert mask_agreement(a, a) == 1.0
+    assert mask_agreement(a, b) == 0.75
+    with pytest.raises(ValueError):
+        mask_agreement(a, np.zeros((3, 3), np.int8))
+    assert temporal_consistency([a]) is None
+    assert temporal_consistency([a, a, b]) == pytest.approx((1 + .75) / 2)
+    # miou over the union of observed classes; identical = 1, disjoint = 0
+    assert miou(a, a) == 1.0
+    assert miou(np.zeros((2, 2), np.int8),
+                np.ones((2, 2), np.int8)) == 0.0
+    # class 2 present only in b: IoU(0)=1, IoU(1)=1/2, IoU(2)=0
+    assert miou(a, b) == pytest.approx((1.0 + 0.5 + 0.0) / 3)
+    # num_class bounds the class axis (ids >= num_class drop out)
+    assert miou(a, b, num_class=2) == pytest.approx((1.0 + 0.5) / 2)
+    d = quality_delta({(0, 0): a, (0, 1): a, (1, 9): a},
+                      {(0, 0): a, (0, 1): b})       # (1,9) unmatched
+    assert d['frames_compared'] == 2
+    assert d['min_miou'] == pytest.approx(0.5, abs=1e-4)
+    assert d['per_frame'][0] == {'session': 0, 'seq': 0, 'miou': 1.0}
+    assert quality_delta({}, {})['mean_miou'] is None
+
+
+# ------------------------------------------------------- session ordering
+def _cfg(**kw):
+    kw.setdefault('keyframe_interval', 4)
+    kw.setdefault('reorder_wait_ms', 80.0)
+    kw.setdefault('reorder_window', 4)
+    return StreamConfig(**kw)
+
+
+def test_session_reorder_wait_then_run():
+    sess = StreamSession(SID, _cfg())
+    out = {}
+
+    def late_zero():
+        time.sleep(0.02)
+        assert sess.wait_turn(0, None) == 'run'
+        d, *_ = sess.plan()
+        sess.complete(0, 'ok', d, mask=np.zeros((2, 2), np.int8))
+
+    t = threading.Thread(target=late_zero)
+    t.start()
+    # seq 1 arrives first: it must WAIT for 0, then run, flagged reordered
+    out['turn'] = sess.wait_turn(1, None)
+    t.join()
+    assert out['turn'] == 'run'
+    assert sess.stats()['frames']['reordered'] == 1
+    assert sess.stats()['next_seq'] == 1   # 1 holds the cursor until done
+
+
+def test_session_drop_late_advances_cursor_and_stale():
+    sess = StreamSession(SID, _cfg(reorder_wait_ms=30.0))
+    # seq 1 waits for 0, which never arrives -> dropped late, cursor 2
+    assert sess.wait_turn(1, None) == 'dropped_late'
+    assert sess.stats()['next_seq'] == 2
+    # seq 0 now arrives behind the cursor -> stale
+    assert sess.wait_turn(0, None) == 'stale'
+    # the per-frame deadline bounds the wait below reorder_wait_ms
+    t0 = time.monotonic()
+    assert sess.wait_turn(3, time.monotonic() + 0.01) == 'dropped_late'
+    assert time.monotonic() - t0 < 0.5
+    counts = sess.stats()['frames']
+    assert counts['dropped_late'] == 2 and counts['stale'] == 1
+
+
+def test_session_gap_skip_and_close():
+    sess = StreamSession(SID, _cfg(reorder_window=4))
+    # a frame > reorder_window ahead snaps the cursor (gap declared lost)
+    assert sess.wait_turn(7, None) == 'run'
+    assert sess.stats()['frames']['gap_skips'] == 1
+    d, *_ = sess.plan()
+    sess.complete(7, 'ok', d, mask=np.zeros((2, 2), np.int8))
+    assert sess.stats()['next_seq'] == 8
+    # waiters raise SessionClosed when the session goes away mid-wait
+    box = {}
+
+    def waiter():
+        try:
+            sess.wait_turn(9, None)
+        except SessionClosed:
+            box['raised'] = True
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.02)
+    stats = sess.close()
+    t.join(timeout=5)
+    assert box.get('raised') is True
+    assert stats['closed'] is True
+    assert sess.close()['closed'] is True          # idempotent
+
+
+def test_session_failed_keyframe_rearms_force():
+    sess = StreamSession(SID, _cfg(keyframe_interval=4))
+    assert sess.wait_turn(0, None) == 'run'
+    d, mask, _thumb, _age = sess.plan()
+    assert d.kind == 'keyframe' and mask is None
+    # the keyframe FAILED: no mask was cached, so the next frame must
+    # retry the full network instead of reusing nothing
+    sess.complete(0, 'error', d)
+    assert sess.wait_turn(1, None) == 'run'
+    d2, *_ = sess.plan()
+    assert d2.kind == 'keyframe'
+    m = np.ones((2, 2), np.int8)
+    assert sess.complete(1, 'ok', d2, mask=m) == 0       # fresh mask
+    # cheap frames age the mask; the keyframe source never changes
+    assert sess.wait_turn(2, None) == 'run'
+    d3, mask3, _t, _a = sess.plan()
+    assert d3.kind == 'cheap' and mask3 is m
+    assert sess.complete(2, 'ok', d3) == 1
+
+
+def test_session_table_open_adopt_sweep_limits():
+    table = SessionTable(_cfg(max_sessions=2, session_ttl_s=0.01))
+    table.open(SID, bucket=(4, 4))
+    with pytest.raises(Exception):
+        table.open(SID)                               # SessionExists
+    table.open(SID2)
+    with pytest.raises(Exception):
+        table.open('a' * 32)                          # SessionLimit
+    # adopt returns the live session when present, creates otherwise
+    sess, created = table.adopt(SID)
+    assert created is False and sess.bucket() == (4, 4)
+    time.sleep(0.03)
+    swept = table.sweep()
+    assert len(swept) == 2 and all(s['expired'] for s in swept)
+    sess, created = table.adopt(SID, first_seq=5)
+    assert created is True
+    # adopted sessions start at the arriving seq with a forced keyframe
+    assert sess.wait_turn(5, None) == 'run'
+    d, *_ = sess.plan()
+    assert (d.kind, d.reason) == ('keyframe', 'migrate')
+
+
+# --------------------------------------------------- HTTP session protocol
+@pytest.fixture()
+def stream_server():
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from _fleet_stub import FakePipeline
+    from rtseg_tpu.serve.server import make_server
+    pipe = FakePipeline(2.0)
+    srv = make_server(pipe, host='127.0.0.1', port=0,
+                      colormap=np.zeros((256, 3), np.uint8),
+                      replica_id='r0',
+                      stream_config=_cfg(keyframe_interval=3,
+                                         frame_deadline_ms=2000.0))
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield f'http://127.0.0.1:{srv.server_address[1]}'
+    srv.shutdown()
+
+
+def _open_session(url, sid=None, **overrides):
+    body = {'h': 4, 'w': 4, **overrides}
+    headers = {SESSION_HEADER: sid} if sid else {}
+    with http_post(url + '/session', json.dumps(body).encode(),
+                   headers) as r:
+        return json.loads(r.read())
+
+
+def _send_frame(url, sid, seq, raw=True, extra=None):
+    q = '?raw=1' if raw else ''
+    try:
+        resp = http_post(url + f'/frame{q}', b'png-ish',
+                         {SESSION_HEADER: sid, SEQ_HEADER: str(seq),
+                          **(extra or {})})
+        return resp.status, dict(resp.headers), resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+def test_http_session_lifecycle_and_provenance(stream_server):
+    url = stream_server
+    opened = _open_session(url, sid=SID)
+    assert opened['session'] == SID
+    assert opened['bucket'] == '4x4'
+    assert opened['keyframe_interval'] == 3
+    # duplicate open -> 409
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _open_session(url, sid=SID)
+    assert ei.value.code == 409
+    # K=3 cadence with provenance + monotone mask-age headers
+    provs, ages = [], []
+    for seq in range(6):
+        code, hdrs, body = _send_frame(url, SID, seq)
+        assert code == 200
+        provs.append(hdrs[PROVENANCE_HEADER])
+        ages.append(int(hdrs[MASK_AGE_HEADER]))
+        assert hdrs[SESSION_HEADER] == SID
+        assert hdrs[SEQ_HEADER] == str(seq)
+        assert hdrs['X-Mask-Shape'] == '4,4'
+        assert len(body) == 16                      # 4x4 int8 raw
+    assert provs == ['keyframe', 'reused', 'reused'] * 2
+    assert ages == [0, 1, 2, 0, 1, 2]
+    # close returns the session's frame/provenance stats
+    with http_post(url + f'/session/{SID}/close') as r:
+        stats = json.loads(r.read())
+    assert stats['closed'] is True
+    assert stats['frames']['ok'] == 6
+    assert stats['provenance'] == {'keyframe': 2, 'reused': 4}
+    # closing again: no-op 200 (the session is simply unknown now)
+    with http_post(url + f'/session/{SID}/close') as r:
+        assert json.loads(r.read())['closed'] is False
+
+
+def test_http_frame_validation_and_adoption(stream_server):
+    url = stream_server
+    # /frame without a session header, or with a bad seq -> 400
+    code, _, _ = _send_frame(url, 'not-a-session-id', 0)
+    assert code == 400
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        http_post(url + '/frame', b'x', {SESSION_HEADER: SID})
+    assert ei.value.code == 400
+    # a frame for a session this replica never saw is ADOPTED (forced
+    # keyframe), not errored — that is what makes migration zero-error
+    code, hdrs, _ = _send_frame(url, SID2, 7,
+                                extra={MIGRATED_HEADER: '1'})
+    assert code == 200
+    assert hdrs[PROVENANCE_HEADER] == 'keyframe'
+    assert hdrs[MIGRATED_HEADER] == '1'
+    # the adopted stream continues from the arriving seq
+    code, hdrs, _ = _send_frame(url, SID2, 8)
+    assert code == 200 and hdrs[PROVENANCE_HEADER] == 'reused'
+    # a frame behind the adopted cursor is stale -> 504 with status body
+    code, _, body = _send_frame(url, SID2, 3)
+    assert code == 504
+    assert json.loads(body)['status'] == 'stale'
+    # /stats carries the session table
+    stats = http_json(url + '/stats')
+    assert stats['sessions']['active'] >= 1
+    assert stats['sessions']['frames']['ok'] >= 2
+
+
+def test_http_deadline_drop_late(stream_server):
+    url = stream_server
+    _open_session(url, sid=SID)
+    # an out-of-order frame whose deadline expires waiting -> 504
+    # dropped_late, and the cursor skips so the NEXT frame still runs
+    code, _, body = _send_frame(url, SID, 2,
+                                extra={'X-Deadline-Ms': '40'})
+    assert code == 504
+    assert json.loads(body)['status'] == 'dropped_late'
+    code, hdrs, _ = _send_frame(url, SID, 3)
+    assert code == 200 and hdrs[PROVENANCE_HEADER] == 'keyframe'
+    http_post(url + f'/session/{SID}/close').close()
+
+
+def test_http_stream_not_mounted_404():
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from _fleet_stub import FakePipeline
+    from rtseg_tpu.serve.server import make_server
+    srv = make_server(FakePipeline(1.0), host='127.0.0.1', port=0,
+                      colormap=np.zeros((256, 3), np.uint8))
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    url = f'http://127.0.0.1:{srv.server_address[1]}'
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            http_post(url + '/session', b'{"h":4,"w":4}')
+        assert ei.value.code == 404
+    finally:
+        srv.shutdown()
+
+
+# ------------------------------------------- affinity routing (subprocess)
+def test_router_affinity_sticky_and_migrate_on_kill(tmp_path, sink):
+    group = ReplicaGroup('stream',
+                         stub_cmd('--stream', '--keyframe-interval', '4'),
+                         min_replicas=2, max_replicas=2)
+    manager = FleetManager([group], run_dir=str(tmp_path / 'fleet'),
+                           poll_s=0.05, restart_backoff_s=30.0,
+                           health_timeout_s=2.0)
+    manager.start()
+    router = None
+    try:
+        replicas = manager.wait_ready('stream', 2, timeout_s=30)
+        router = make_router({'stream': group}, host='127.0.0.1', port=0)
+        threading.Thread(target=router.serve_forever,
+                         daemon=True).start()
+        url = f'http://127.0.0.1:{router.server_address[1]}'
+        # open 4 sessions; every frame of a session lands on ONE replica
+        sids, homes = [], {}
+        for i in range(4):
+            with http_post(url + '/session',
+                           json.dumps({'h': 4, 'w': 4}).encode()) as r:
+                sid = json.loads(r.read())['session']
+            sids.append(sid)
+        for sid in sids:
+            seen = set()
+            for seq in range(3):
+                code, hdrs, _ = _send_frame(url, sid, seq)
+                assert code == 200
+                seen.add(hdrs['X-Replica-Id'])
+            assert len(seen) == 1, f'session {sid} bounced: {seen}'
+            homes[sid] = seen.pop()
+        assert router.bound_sessions() == 4
+        # SIGKILL the replica hosting sids[0]: the next frame must be
+        # answered by the survivor — forced keyframe, migrated header,
+        # zero client-visible errors
+        victim_rid = homes[sids[0]]
+        victim = next(r for r in replicas
+                      if r.replica_id == victim_rid)
+        os.kill(victim.pid, signal.SIGKILL)
+        time.sleep(0.3)
+        code, hdrs, _ = _send_frame(url, sids[0], 3)
+        assert code == 200
+        assert hdrs[PROVENANCE_HEADER] == 'keyframe'
+        assert hdrs[MIGRATED_HEADER] == '1'
+        assert hdrs['X-Replica-Id'] != victim_rid
+        # the re-homed session is sticky again (no migrated header)
+        code, hdrs2, _ = _send_frame(url, sids[0], 4)
+        assert code == 200
+        assert hdrs2['X-Replica-Id'] == hdrs['X-Replica-Id']
+        assert MIGRATED_HEADER not in hdrs2
+        # router accounting: sessions opened/migrated + frame statuses
+        stats = http_json(url + '/stats')
+        g = stats['groups']['stream']
+        assert g['session_events']['open'] == 4
+        assert g['session_events']['migrate'] >= 1
+        assert g['frames']['ok'] == 4 * 3 + 2
+        assert g['frames'].get('error', 0) == 0
+        for sid in sids:
+            http_post(url + f'/session/{sid}/close').close()
+        assert http_json(url + '/stats')['bound_sessions'] == 0
+    finally:
+        if router is not None:
+            router.shutdown()
+        manager.stop(drain=False)
+    # the router's sink carries the migration event with from/to
+    with open(sink) as f:
+        events = [json.loads(line) for line in f if line.strip()]
+    migs = [e for e in events if e.get('event') == 'session_migrate']
+    assert len(migs) >= 1
+    assert migs[0]['session'] == sids[0]
+    assert migs[0]['from'] == victim_rid
+    assert migs[0]['to'] == hdrs['X-Replica-Id']
+
+
+# --------------------------------------------------------- loadgen video
+def test_bench_video_report_keys(stream_server):
+    from rtseg_tpu.serve import (bench_video, check_video_report,
+                                 format_video_report,
+                                 make_video_payloads)
+    payloads = make_video_payloads((4, 4), sessions=2, frames=9, seed=3)
+    store = {}
+    rep = bench_video(stream_server, payloads, fps=50.0, bucket=(4, 4),
+                      mask_store=store)
+    assert rep['sessions'] == 2 and rep['requests'] == 18
+    assert rep['ok'] == 18 and rep['errors'] == 0
+    # K=3 (server default): 3 keyframes per 9-frame session
+    assert rep['keyframe_ratio'] == pytest.approx(3 / 9, abs=1e-3)
+    assert rep['freshness'] == pytest.approx(1.0)
+    assert len(store) == 18
+    assert rep['consistency'] is not None
+    assert len(rep['per_session']) == 2
+    row = rep['per_session'][0]
+    assert row['ok'] == 9 and row['keyframes'] == 3
+    assert row['replicas'] == ['r0']
+    assert rep['per_replica'] == {'r0': 18}
+    assert check_video_report(rep, keyframe_band=(0.2, 0.5),
+                              expect_sessions=2) == []
+    assert check_video_report(rep, keyframe_band=(0.5, 1.0)) != []
+    assert check_video_report({'errors': 3}) != []
+    assert 'keyframe ratio' in format_video_report(rep)
+
+
+def test_synth_video_is_deterministic_and_temporally_redundant():
+    from rtseg_tpu.serve import synth_video
+    a = synth_video((16, 16), 4, seed=1)
+    b = synth_video((16, 16), 4, seed=1)
+    assert all(np.array_equal(x, y) for x, y in zip(a, b))
+    # consecutive frames are near-identical (rolled), distinct frames not
+    assert np.array_equal(np.roll(a[0], 2, axis=0), a[1])
+    assert not np.array_equal(a[0], a[1])
+
+
+# ------------------------------------------------- segscope integrations
+def _frame_event(sess, seq, status='ok', prov='reused', age=1, e2e=5.0):
+    return {'event': 'frame', 'ts': 1000.0 + seq, 'session': sess,
+            'seq': seq, 'status': status, 'provenance': prov,
+            'mask_age': age, 'e2e_ms': e2e}
+
+
+def test_report_streaming_section_and_diff_rows():
+    from rtseg_tpu.obs.report import (diff_rows, format_summary,
+                                      summarize)
+    events = [
+        {'event': 'run_start', 'ts': 999.0, 'host': 0},
+        {'event': 'session', 'ts': 999.5, 'action': 'open',
+         'session': 'a'},
+        _frame_event('a', 0, prov='keyframe', age=0, e2e=20.0),
+        _frame_event('a', 1, e2e=4.0),
+        _frame_event('a', 2, e2e=6.0),
+        _frame_event('a', 3, status='dropped_late'),
+        {'event': 'session_migrate', 'ts': 1004.0, 'session': 'a',
+         'from': 'r1', 'to': 'r2'},
+        {'event': 'session', 'ts': 1005.0, 'action': 'close',
+         'session': 'a'},
+    ]
+    s = summarize(events)
+    st = s['streaming']
+    assert st['frames'] == 4 and st['ok'] == 3
+    assert st['dropped_late'] == 1 and st['sessions'] == 1
+    assert st['migrations'] == 1
+    assert st['keyframe_ratio'] == pytest.approx(1 / 3)
+    assert st['freshness'] == pytest.approx((0 + 1 + 1) / 3)
+    assert st['session_actions'] == {'open': 1, 'close': 1}
+    # flat keys feed the diff table
+    assert s['frame_p99_ms'] is not None
+    assert s['frame_dropped_late'] == 1
+    assert 'streaming' in format_summary(s)
+    # a worse B regresses: more drops + higher keyframe ratio
+    b_events = [e for e in events] + [
+        _frame_event('a', 4, prov='keyframe', age=0, e2e=21.0),
+        _frame_event('a', 5, status='dropped_late'),
+    ]
+    rows = {r['key']: r for r in diff_rows(s, summarize(b_events))}
+    assert rows['frame_dropped_late']['regressed'] is True
+    assert rows['keyframe_ratio']['regressed'] is True
+    # runs without streaming render as absent, never as zero-regression
+    plain = summarize([{'event': 'run_start', 'ts': 1.0, 'host': 0}])
+    assert plain['streaming'] is None
+    assert {r['key']: r for r in diff_rows(plain, plain)}[
+        'frame_p99_ms']['a'] is None
+
+
+def test_live_tailer_streaming_section(tmp_path):
+    from rtseg_tpu.obs.live import SinkTailer, check_frame, format_frame
+    path = tmp_path / 'events-000.jsonl'
+    now = time.time()
+    events = [
+        {'event': 'session', 'ts': now, 'action': 'open', 'session': 'a'},
+        {**_frame_event('a', 0, prov='keyframe', age=0), 'ts': now},
+        {**_frame_event('a', 1), 'ts': now},
+        {**_frame_event('a', 2, status='dropped_late'), 'ts': now},
+        {'event': 'session_migrate', 'ts': now, 'session': 'a',
+         'from': 'r1', 'to': 'r2'},
+    ]
+    path.write_text(''.join(json.dumps(e) + '\n' for e in events))
+    tailer = SinkTailer(str(path))
+    frame = tailer.poll()
+    st = frame['streaming']
+    assert st['ok'] == 2 and st['dropped_late'] == 1
+    assert st['sessions'] == {'open': 1} and st['migrations'] == 1
+    assert st['keyframe_ratio'] == 0.5
+    assert st['frame_p50_ms'] is not None
+    assert 'frames' in format_frame(frame)
+    assert check_frame(frame) == []            # streaming IS activity
+    # frame errors fail the gate
+    with open(path, 'a') as f:
+        f.write(json.dumps({**_frame_event('a', 3, status='error'),
+                            'ts': time.time()}) + '\n')
+    assert check_frame(tailer.poll()) != []

@@ -10,7 +10,13 @@ events, and `diff` flags serve-p99/RPS regressions alongside the training
 rows. segpipe runs add an h2d stage row (host->device transfer seconds;
 "overlapped" when data-wait is ~0) and a packed-cache hit-rate line from
 the loaders' per-epoch cache events; `diff` marks data-wait/h2d
-regressions >5% as REGRESSED. Pure stdlib+numpy: works on machines
+regressions >5% as REGRESSED. Streaming runs (tools/segstream.py bench
+--obs-dir) get a streaming section — frame p50/p99, inter-frame jitter,
+freshness (mean mask age), dropped-late/stale counts, keyframe ratio,
+session opens/migrations and a provenance breakdown — from their
+frame/session/session_migrate events, and `diff`/`live` carry the same
+rows (frame p99, jitter, freshness, dropped-late, keyframe ratio) as
+REGRESSED-markable gates. Pure stdlib+numpy: works on machines
 without jax (e.g. a laptop holding synced run dirs).
 
 Runs with segprof sampled profiling on (`config.profile_every`) or

@@ -163,13 +163,32 @@ def cmd_serve(args) -> int:
         if version is None:
             version = manifest['version']
     else:
+        if args.stream and args.cheap_mode == 'light':
+            # the light cheap path re-encodes frames through a half-res
+            # executable: seal those buckets into the table up front —
+            # the table never grows at serve time (retraces=0 gate)
+            full = parse_buckets(args.buckets)
+            half = [(max(h // 2, 1), max(w // 2, 1)) for h, w in full]
+            args.buckets = ','.join(
+                f'{h}x{w}' for h, w in dict.fromkeys(full + half))
         cfg = _build_config(args)
         engine = _build_engine(args, cfg)
     pipeline = _build_pipeline(args, cfg, engine)
+    stream_config = None
+    if args.stream:
+        from rtseg_tpu.stream import StreamConfig
+        stream_config = StreamConfig(
+            keyframe_interval=args.keyframe_interval,
+            cheap_mode=args.cheap_mode,
+            staleness_max=args.staleness_max,
+            frame_deadline_ms=args.frame_deadline_ms,
+            session_ttl_s=args.session_ttl_s,
+            reorder_window=args.reorder_window)
     server = make_server(pipeline, host=args.host, port=args.port,
                          colormap=get_colormap(cfg),
                          replica_id=args.replica_id,
-                         artifact_version=version)
+                         artifact_version=version,
+                         stream_config=stream_config)
     host, port = server.server_address[:2]
     if args.port_file:
         # --port 0 binds an ephemeral port; a fleet manager discovers it
@@ -182,9 +201,11 @@ def cmd_serve(args) -> int:
     rid = f' | replica {args.replica_id}' if args.replica_id else ''
     if version:
         rid += f' | version {version}'
+    extra = ' /session /frame' if stream_config is not None else ''
     print(f'segserve: {cfg.model} on http://{host}:{port}{rid} | buckets '
-          f'{args.buckets} x batch {engine.batch} | POST /predict /drain '
-          f'/debug/profile?ms=, GET /healthz /stats /metrics', flush=True)
+          f'{args.buckets} x batch {engine.batch} | POST /predict{extra} '
+          f'/drain /debug/profile?ms=, GET /healthz /stats /metrics',
+          flush=True)
     try:
         server.serve_forever()
     except KeyboardInterrupt:
@@ -323,6 +344,28 @@ def main(argv=None) -> int:
     sp.add_argument('--obs-dir', default=None,
                     help='stream segscope ingress/request/batch events '
                          'here (tail with `segscope.py live`)')
+    sp.add_argument('--stream', action='store_true',
+                    help='mount the segstream video session plane '
+                         '(POST /session, /frame — tools/segstream.py)')
+    sp.add_argument('--keyframe-interval', type=int, default=8,
+                    help='full network pass every K frames per session '
+                         '(1 = keyframe every frame)')
+    sp.add_argument('--cheap-mode', default='reuse',
+                    choices=('reuse', 'warp', 'light'),
+                    help='between keyframes: reuse the last mask, warp '
+                         'it by estimated motion, or run a half-res '
+                         'light pass')
+    sp.add_argument('--staleness-max', type=float, default=0.25,
+                    help='thumbnail mean-abs-diff vs the keyframe that '
+                         'forces an early keyframe (warp/light modes)')
+    sp.add_argument('--frame-deadline-ms', type=float, default=1000.0,
+                    help='default per-frame deadline; late frames are '
+                         'dropped (504), never served stale')
+    sp.add_argument('--session-ttl-s', type=float, default=120.0,
+                    help='idle sessions are swept after this long')
+    sp.add_argument('--reorder-window', type=int, default=8,
+                    help='max sequence-number gap buffered for '
+                         'out-of-order frames before skipping ahead')
 
     bp = sub.add_parser('bench', help='open-loop Poisson load test')
     _add_engine_args(bp)

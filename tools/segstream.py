@@ -1,0 +1,444 @@
+#!/usr/bin/env python
+"""segstream — streaming video segmentation bench (rtseg_tpu/stream/).
+
+Usage:
+    # the streaming e2e gate (CI + BENCHMARKS.md "Video serving
+    # methodology"): N replicas behind the affinity router, 4 video
+    # sessions at a fixed fps, SIGKILL a replica mid-stream (affinity
+    # re-homes its sessions with a forced keyframe: 0 client errors,
+    # >= 1 session_migrate), exact router-vs-replica-vs-loadgen frame
+    # reconciliation, 0 retraces, then a keyframe-every-frame reference
+    # pass over the SAME payloads for the honest quality/throughput
+    # trade table (mIoU delta + temporal consistency + speedup)
+    python tools/segstream.py bench --replicas 2 --sessions 4 \
+        --buckets 64x64 --batch 4 --fps 10 --frames 32 --check
+
+Replicas are real `tools/segserve.py serve --stream` subprocesses; the
+router is the segfleet front door with session-affinity routing
+(rendezvous hash over ready replicas), so every phase exercises the
+production code path end to end. Reports follow the segfleet/segship
+house style: --json, --report-json PATH, --check gates.
+
+Exit codes: 0 ok, 1 --check failed, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from rtseg_tpu import obs                                      # noqa: E402
+from rtseg_tpu.fleet import (FleetManager, ReplicaGroup,       # noqa: E402
+                             get_policy, make_router)
+from rtseg_tpu.obs.live import scrape_counter_sum              # noqa: E402
+from rtseg_tpu.serve import (bench_video, check_video_report,  # noqa: E402
+                             format_video_report,
+                             make_video_payloads, parse_buckets)
+from rtseg_tpu.stream import quality_delta                     # noqa: E402
+
+_SEGSERVE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         'segserve.py')
+
+
+# ------------------------------------------------------------------ plumbing
+def make_spawn_cmd(args, obs_root=None):
+    """argv builder handed to the ReplicaGroup: each replica is a real
+    segserve process with the session plane mounted, warm through the
+    shared compile cache."""
+    def cmd(rid: str, port_file: str):
+        argv = [sys.executable, _SEGSERVE, 'serve', '--stream',
+                '--model', args.model,
+                '--num_class', str(args.num_class),
+                '--buckets', args.buckets,
+                '--batch', str(args.batch),
+                '--max-wait-ms', str(args.max_wait_ms),
+                '--max-queue', str(args.max_queue),
+                '--workers', str(args.workers),
+                '--keyframe-interval', str(args.keyframe_interval),
+                '--cheap-mode', args.cheap_mode,
+                '--frame-deadline-ms', str(args.frame_deadline_ms),
+                '--session-ttl-s', str(args.session_ttl_s),
+                '--host', '127.0.0.1', '--port', '0',
+                '--port-file', port_file,
+                '--replica-id', rid]
+        if args.compute_dtype:
+            argv += ['--compute_dtype', args.compute_dtype]
+        if args.compile_cache:
+            argv += ['--compile-cache', args.compile_cache]
+        if args.ckpt:
+            argv += ['--ckpt', args.ckpt]
+        if obs_root:
+            argv += ['--obs-dir', os.path.join(obs_root,
+                                               f'replica-{rid}')]
+        return argv
+    return cmd
+
+
+def _frame_counts(router_url, replicas, group: str) -> dict:
+    """The two counter legs of the frame reconciliation: the router's
+    fleet_frames_total{ok} and the sum of replica-side
+    stream_frames_total{ok} (frontend-incremented — cheap frames never
+    reach the batcher, so serve_requests_total can't stand in)."""
+    return {
+        'router_ok': scrape_counter_sum(router_url, 'fleet_frames_total',
+                                        group=group, status='ok'),
+        'replica_ok': scrape_counter_sum([r.url for r in replicas],
+                                         'stream_frames_total',
+                                         status='ok'),
+    }
+
+
+def _replica_engine_stats(replicas) -> dict:
+    import urllib.request
+    out = {}
+    for r in replicas:
+        if not r.url:
+            continue
+        try:
+            with urllib.request.urlopen(r.url + '/stats',
+                                        timeout=10) as resp:
+                stats = json.loads(resp.read())
+        except OSError:
+            continue
+        eng = stats.get('engine') or {}
+        out[r.replica_id] = {'retraces': eng.get('retraces'),
+                             'executables': eng.get('executables')}
+    return out
+
+
+def _sink_events(obs_dir: str) -> list:
+    events = []
+    for name in sorted(os.listdir(obs_dir)):
+        if name.startswith('events-') and name.endswith('.jsonl'):
+            with open(os.path.join(obs_dir, name)) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        events.append(json.loads(line))
+    return events
+
+
+# -------------------------------------------------------------------- bench
+def cmd_bench(args) -> int:
+    obs_dir = args.obs_dir or '/tmp/segstream_bench/segscope'
+    sink = obs.init_run(obs_dir, meta={
+        'stream': True, 'bench': True, 'model': args.model,
+        'buckets': args.buckets, 'batch': args.batch,
+        'replicas': args.replicas, 'sessions': args.sessions,
+        'keyframe_interval': args.keyframe_interval,
+        'cheap_mode': args.cheap_mode})
+    obs.set_sink(sink)
+    group = ReplicaGroup('stream', make_spawn_cmd(args, obs_root=obs_dir),
+                         min_replicas=1, max_replicas=args.replicas)
+    manager = FleetManager([group], run_dir=args.run_dir,
+                           drain_grace_s=args.drain_grace_s)
+    buckets = parse_buckets(args.buckets)
+    bucket = buckets[0]
+    payloads = make_video_payloads(bucket, args.sessions, args.frames,
+                                   seed=args.seed)
+    problems = []
+    report = {'buckets': args.buckets, 'batch': args.batch,
+              'replicas': args.replicas, 'sessions': args.sessions,
+              'frames': args.frames, 'fps': args.fps,
+              'keyframe_interval': args.keyframe_interval,
+              'cheap_mode': args.cheap_mode}
+    router = None
+    t_start = time.perf_counter()
+    try:
+        # ---- spin-up: first replica fills the shared compile cache,
+        # the rest warm-start from it
+        manager.start()
+        manager.wait_ready('stream', 1, timeout_s=args.ready_timeout_s)
+        if args.replicas > 1:
+            manager.scale_to('stream', args.replicas,
+                             reason='bench spin-up')
+        replicas = manager.wait_ready('stream', args.replicas,
+                                      timeout_s=args.ready_timeout_s)
+        report['spinup'] = {r.replica_id: round(r.ready_s, 2)
+                           for r in replicas}
+        print(f'segstream bench — {args.replicas}x {args.model} '
+              f'{args.buckets} batch {args.batch} | spin-up '
+              + ' '.join(f'{k}={v}s'
+                         for k, v in report['spinup'].items()),
+              flush=True)
+        router = make_router({'stream': group}, host='127.0.0.1',
+                             port=args.port,
+                             policy=get_policy(args.policy),
+                             max_outstanding=args.max_outstanding)
+        threading.Thread(target=router.serve_forever,
+                         daemon=True).start()
+        host, port = router.server_address[:2]
+        url = f'http://{host}:{port}'
+        print(f'  router         : {url} | session-affinity over '
+              f'{args.replicas} replicas', flush=True)
+
+        # ---- phase A: steady streaming — N sessions at fixed fps, no
+        # faults. Gates: zero losses, keyframe ratio in band, EXACT
+        # frame reconciliation (every ok the loadgen saw is one router
+        # forward and one replica frontend answer — no slack)
+        before = _frame_counts(url, replicas, 'stream')
+        sched_masks: dict = {}
+        steady = bench_video(
+            url, payloads, args.fps, bucket,
+            frame_deadline_ms=args.frame_deadline_ms,
+            timeout_s=args.timeout_s, mask_store=sched_masks)
+        report['steady'] = steady
+        print(format_video_report(steady), flush=True)
+        expect_ratio = 1.0 / args.keyframe_interval
+        band = (args.keyframe_band_lo or 0.8 * expect_ratio,
+                args.keyframe_band_hi or
+                min(1.0, 1.6 * expect_ratio))
+        report['keyframe_band'] = list(band)
+        problems += check_video_report(
+            steady, p99_ms=args.p99_ms, keyframe_band=band,
+            max_dropped_late=args.max_dropped_late,
+            expect_sessions=args.sessions)
+        after = _frame_counts(url, replicas, 'stream')
+        recon = {'loadgen_ok': steady['ok'],
+                 'router_ok_delta': after['router_ok']
+                 - before['router_ok'],
+                 'replica_ok_delta': after['replica_ok']
+                 - before['replica_ok']}
+        report['reconciliation'] = recon
+        if len(set(recon.values())) != 1:
+            problems.append(f'frame reconciliation mismatch: {recon}')
+        print(f'  reconciliation : loadgen {recon["loadgen_ok"]} == '
+              f'router {recon["router_ok_delta"]} == replicas '
+              f'{recon["replica_ok_delta"]}', flush=True)
+
+        # ---- phase B: SIGKILL a replica mid-stream. Affinity re-homes
+        # its sessions onto survivors with a forced keyframe; the gate
+        # is zero client-visible errors and at least one migration.
+        box = {}
+
+        def _run_kill():
+            box['r'] = bench_video(
+                url, payloads, args.fps, bucket,
+                frame_deadline_ms=args.frame_deadline_ms,
+                timeout_s=args.timeout_s)
+
+        t = threading.Thread(target=_run_kill)
+        t.start()
+        time.sleep((args.frames / args.fps) * 0.4)
+        victim = replicas[-1]
+        os.kill(victim.pid, signal.SIGKILL)
+        t.join(timeout=600)
+        kill = box.get('r')
+        if kill is None:
+            problems.append('kill phase did not complete')
+            report['kill'] = None
+        else:
+            report['kill'] = kill
+            print(f'  kill mid-stream: SIGKILL {victim.replica_id} at '
+                  f'40% of the stream -> {kill["ok"]} ok | '
+                  f'{kill["errors"]} errors | {kill["dropped_late"]} '
+                  f'dropped-late | {kill["sessions_migrated"]} sessions '
+                  f'migrated', flush=True)
+            if kill['errors'] or kill['rejected']:
+                problems.append(
+                    f'kill phase saw client-visible failures: '
+                    f'{kill["errors"]} errors, {kill["rejected"]} '
+                    f'rejected (want 0)')
+            if kill['sessions_migrated'] < 1:
+                problems.append('no session migrated across the kill '
+                                '(affinity re-home did not happen)')
+            if kill['dropped_late'] > args.max_kill_dropped_late:
+                problems.append(
+                    f'{kill["dropped_late"]} dropped-late frames across '
+                    f'the kill > {args.max_kill_dropped_late}')
+        deadline = time.monotonic() + args.ready_timeout_s
+        while victim.state != 'ready' and time.monotonic() < deadline:
+            time.sleep(0.1)
+        report['victim_restarted'] = victim.state == 'ready'
+        if not report['victim_restarted']:
+            problems.append('killed replica was not restarted in time')
+        replicas = manager.wait_ready('stream', args.replicas,
+                                      timeout_s=args.ready_timeout_s)
+
+        # ---- phase C: the honest quality/throughput table — a
+        # keyframe-every-frame reference pass over the SAME payloads
+        # (per-session override keyframe_interval=1), then per-frame
+        # mIoU of scheduled-vs-reference masks. Temporal consistency is
+        # reported for both but never alone: a scheduler that reuses
+        # masks is *by construction* more consistent, so the mIoU delta
+        # is what keeps the claim honest.
+        ref_masks: dict = {}
+        reference = bench_video(
+            url, payloads, args.fps, bucket, keyframe_interval=1,
+            frame_deadline_ms=args.frame_deadline_ms,
+            timeout_s=args.timeout_s, mask_store=ref_masks)
+        report['reference'] = reference
+        delta = quality_delta(sched_masks, ref_masks,
+                              num_class=args.num_class)
+        report['quality'] = {
+            'frames_compared': delta['frames_compared'],
+            'mean_miou': delta['mean_miou'],
+            'min_miou': delta['min_miou'],
+            'consistency_scheduled': steady.get('consistency'),
+            'keyframe_ratio_scheduled': steady.get('keyframe_ratio'),
+            'keyframe_ratio_reference': reference.get('keyframe_ratio'),
+        }
+        p50_s, p50_r = steady.get('frame_p50_ms'), \
+            reference.get('frame_p50_ms')
+        # same offered load both passes (open loop): the ratio includes
+        # any queueing the K=1 pass builds — that IS the point, a
+        # keyframe-every-frame fleet saturating at this fps is the cost
+        # the scheduler avoids
+        speedup = (round(p50_r / p50_s, 2)
+                   if p50_s and p50_r else None)
+        report['quality']['frame_p50_speedup'] = speedup
+        print(f'  reference      : keyframe-every-frame over the same '
+              f'payloads at the same fps -> p50 {p50_r:.1f} ms '
+              f'(scheduled {p50_s:.1f} ms, {speedup}x)', flush=True)
+        print(f'  quality        : mean mIoU vs reference '
+              f'{delta["mean_miou"]:.4f} (min {delta["min_miou"]:.4f}) '
+              f'over {delta["frames_compared"]} frames | consistency '
+              f'{steady.get("consistency")}', flush=True)
+        if delta['frames_compared'] == 0:
+            problems.append('quality pass compared 0 frames '
+                            '(mask collection broke)')
+        if args.min_miou is not None and delta['mean_miou'] is not None \
+                and delta['mean_miou'] < args.min_miou:
+            problems.append(f'scheduled-vs-reference mean mIoU '
+                            f'{delta["mean_miou"]} < --min-miou '
+                            f'{args.min_miou}')
+        if args.min_speedup is not None and speedup is not None \
+                and speedup < args.min_speedup:
+            problems.append(f'frame p50 speedup {speedup}x < '
+                            f'--min-speedup {args.min_speedup}x')
+        if reference.get('errors') or reference.get('rejected'):
+            problems.append(
+                f'reference pass saw failures: '
+                f'{reference.get("errors")} errors, '
+                f'{reference.get("rejected")} rejected')
+
+        # ---- retrace gate: the session plane must never grow the
+        # sealed executable table — per-session bucket pinning is the
+        # zero-retrace mechanism, this is its measurement
+        engines = _replica_engine_stats(replicas)
+        report['engines'] = engines
+        retraces = sum(e['retraces'] or 0 for e in engines.values())
+        if retraces:
+            problems.append(f'{retraces} retraces across the fleet '
+                            f'(want 0)')
+        print(f'  engines        : '
+              + ' '.join(f'{rid} retraces={e["retraces"]} '
+                         f'executables={e["executables"]}'
+                         for rid, e in sorted(engines.items())),
+              flush=True)
+    finally:
+        if router is not None:
+            router.shutdown()
+        manager.stop(drain=False)
+        sink.emit({'event': 'run_end'})
+        sink.close()
+        if obs.get_sink() is sink:
+            obs.set_sink(None)
+
+    # ---- sink story: the router must have emitted the migration
+    events = _sink_events(obs_dir)
+    migrations = [e for e in events
+                  if e.get('event') == 'session_migrate']
+    report['session_migrate_events'] = len(migrations)
+    report['wall_s'] = round(time.perf_counter() - t_start, 1)
+    print(f'  sink           : {len(migrations)} session_migrate '
+          f'event(s) ({obs_dir})', flush=True)
+    if not migrations:
+        problems.append('no session_migrate event reached the sink')
+    if args.report_json:
+        with open(args.report_json, 'w') as f:
+            json.dump(report, f, indent=2)
+    if args.json:
+        print(json.dumps(report, indent=2))
+    if args.check:
+        if problems:
+            print('segstream check FAILED: ' + '; '.join(problems),
+                  file=sys.stderr, flush=True)
+            return 1
+        q = report['quality']
+        print(f'segstream check OK: {args.sessions} sessions x '
+              f'{args.frames} frames | steady '
+              f'{report["steady"]["ok"]} ok, keyframe ratio '
+              f'{report["steady"]["keyframe_ratio"]} | kill absorbed '
+              f'({report["kill"]["sessions_migrated"]} migrated, 0 '
+              f'errors) | exact frame reconciliation | 0 retraces | '
+              f'mIoU vs K=1 {q["mean_miou"]} at '
+              f'{q["frame_p50_speedup"]}x p50 | {report["wall_s"]}s',
+              flush=True)
+    return 0
+
+
+# --------------------------------------------------------------------- main
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog='segstream', description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = ap.add_subparsers(dest='cmd', required=True)
+
+    bp = sub.add_parser('bench',
+                        help='the streaming e2e gate (see docstring)')
+    bp.add_argument('--model', default='fastscnn')
+    bp.add_argument('--num_class', type=int, default=19)
+    bp.add_argument('--compute_dtype', default=None)
+    bp.add_argument('--ckpt', default=None)
+    bp.add_argument('--buckets', default='64x64',
+                    help='session buckets; video payloads use the first')
+    bp.add_argument('--batch', type=int, default=4)
+    bp.add_argument('--max-wait-ms', type=float, default=2.0)
+    bp.add_argument('--max-queue', type=int, default=128)
+    bp.add_argument('--workers', type=int, default=2)
+    bp.add_argument('--compile-cache', default=None, metavar='DIR')
+    bp.add_argument('--replicas', type=int, default=2)
+    bp.add_argument('--sessions', type=int, default=4)
+    bp.add_argument('--frames', type=int, default=32,
+                    help='frames per session per phase')
+    bp.add_argument('--fps', type=float, default=10.0,
+                    help='per-session frame rate (open loop)')
+    bp.add_argument('--keyframe-interval', type=int, default=4)
+    bp.add_argument('--cheap-mode', default='reuse',
+                    choices=('reuse', 'warp', 'light'))
+    bp.add_argument('--frame-deadline-ms', type=float, default=5000.0)
+    bp.add_argument('--session-ttl-s', type=float, default=120.0)
+    bp.add_argument('--seed', type=int, default=0)
+    bp.add_argument('--p99-ms', type=float, default=5000.0)
+    bp.add_argument('--max-dropped-late', type=int, default=0,
+                    help='steady-phase dropped-late budget')
+    bp.add_argument('--max-kill-dropped-late', type=int, default=4,
+                    help='kill-phase dropped-late budget (frames in '
+                         'flight to the corpse may miss their deadline)')
+    bp.add_argument('--keyframe-band-lo', type=float, default=None,
+                    help='steady keyframe-ratio gate floor (default '
+                         '0.8/K)')
+    bp.add_argument('--keyframe-band-hi', type=float, default=None,
+                    help='steady keyframe-ratio gate ceiling (default '
+                         '1.6/K)')
+    bp.add_argument('--min-miou', type=float, default=None,
+                    help='gate: scheduled-vs-reference mean mIoU floor')
+    bp.add_argument('--min-speedup', type=float, default=None,
+                    help='gate: frame p50 speedup floor vs K=1')
+    bp.add_argument('--timeout-s', type=float, default=30.0)
+    bp.add_argument('--policy', default='least-outstanding',
+                    choices=('least-outstanding', 'round-robin'))
+    bp.add_argument('--max-outstanding', type=int, default=256)
+    bp.add_argument('--port', type=int, default=0)
+    bp.add_argument('--run-dir', default=None)
+    bp.add_argument('--ready-timeout-s', type=float, default=600.0)
+    bp.add_argument('--drain-grace-s', type=float, default=30.0)
+    bp.add_argument('--obs-dir', default=None)
+    bp.add_argument('--json', action='store_true')
+    bp.add_argument('--report-json', default=None, metavar='PATH')
+    bp.add_argument('--check', action='store_true')
+
+    args = ap.parse_args(argv)
+    return cmd_bench(args)
+
+
+if __name__ == '__main__':
+    sys.exit(main())
