@@ -4,11 +4,13 @@ Three tiers (see tools/segcheck.py for the CLI):
 
   * AST lint (pure stdlib `ast`, no jax import): import hygiene, registry
     consistency, trace purity, evidence citations, obs purity, warm-key
-    coverage, and the segrace concurrency auditor (concurrency.py +
+    coverage, the segrace concurrency auditor (concurrency.py +
     lockgraph.py: lock-discipline inference, the SEGRACE.json lock-order
     gate, atomicity lints — all over the shared entry-point walker in
-    walker.py).  Each rule is a function `check_*(root) -> list[Finding]`
-    in its own module.
+    walker.py), and the segcontract cross-plane contract auditor
+    (contracts.py + schema_extract.py: event schemas, metric families,
+    wire headers, gated by the committed SEGCONTRACT.json).  Each rule
+    is a function `check_*(root) -> list[Finding]` in its own module.
   * trace audit (imports jax, still CPU-safe): `jax.eval_shape` sweep over
     the whole model zoo (shape_audit) and the runtime recompile guard
     (recompile) that the trainer hooks behind config.recompile_guard.
@@ -35,6 +37,7 @@ from .lint_warm import check_warm_key_coverage
 from .concurrency import (build_lockgraph, check_concurrency,
                           update_lockgraph)
 from .lockgraph import LockGraph
+from .contracts import check_contracts, update_contracts
 # audit modules defer their jax imports to call time, so importing the
 # package stays jax-free
 from .recompile import (PIN_ATTRS, RecompileError, RecompileGuard,
@@ -59,6 +62,7 @@ __all__ = [
     'check_warm_key_coverage',
     'check_concurrency', 'build_lockgraph', 'update_lockgraph',
     'LockGraph',
+    'check_contracts', 'update_contracts',
     'PIN_ATTRS', 'RecompileError', 'RecompileGuard', 'guard_step',
     'introspectable',
     'AuditResult', 'audit_model', 'audit_zoo', 'zoo_variants',

@@ -1,0 +1,435 @@
+"""segcontract — the cross-plane contract auditor behind
+``tools/segcheck.py --rules contracts``.
+
+The runtime planes talk to each other through three stringly-typed
+surfaces that no type checker sees: JSONL **event** dicts (producers
+everywhere, consumers in obs/report.py and obs/live.py), Prometheus
+**metric families** (registered at runtime, referenced by live.py, the
+scrape helpers in tools/, and the CI reconcile snippets), and HTTP
+**wire headers** (the X-* spellings in serve/headers.py). A typo'd key
+or a renamed family fails silently — the consumer just reads nothing.
+
+This rule makes those surfaces load-bearing, in four passes over the
+pure-AST extraction in schema_extract.py:
+
+  1. **events** — every consumed ``(event type, key)`` must be produced
+     by some emit site (or be sink-stamped / the type open); report.py's
+     ``_DIFF_ROWS`` keys must exist in ``summarize()``'s output dict.
+  2. **metrics** — one family, one shape: every registration of a name
+     agrees on kind + label set, and every reference (live.py helpers,
+     ``scrape_counter_sum``, ``parsed[...]`` lookups, CI yaml text)
+     resolves to a registered family with a compatible label subset.
+  3. **headers** — every wire header has both a writer and a reader
+     (tests count), no constant is dead, and no raw ``X-*`` literal
+     appears outside serve/headers.py.
+  4. **sidecar** — the whole observed contract is pinned in the
+     committed SEGCONTRACT.json (house style: SEGAUDIT.json budget,
+     SEGRACE.json lock order); any drift in either direction is a
+     finding until reviewed and re-pinned with
+     ``tools/segcheck.py --update-contracts``. Re-pinning refuses while
+     passes 1–3 still have findings: the sidecar pins a *coherent*
+     contract, it never grandfathers an orphan consumer.
+
+Suppression is per line like every rule: ``# segcheck:
+disable=contracts`` with a justification comment.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import json
+import os
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from . import schema_extract as sx
+from .core import (Finding, RULE_CONTRACTS, SourceFile, load_tree,
+                   suppressed_at)
+
+#: the committed sidecar, repo-root relative
+SEGCONTRACT_FILE = 'SEGCONTRACT.json'
+
+_RawFinding = Tuple[Optional[SourceFile], str, int, str]
+
+
+# ----------------------------------------------------------------- observe
+class Observed:
+    """Everything the extractor sees in one tree, ready to gate."""
+
+    def __init__(self, root: str, files: Sequence[SourceFile]):
+        self.root = root
+        self.files = list(files)
+        self.by_path = {sf.relpath: sf for sf in self.files}
+        self.sites = sx.extract_event_producers(self.files)
+        self.events = sx.merge_event_schemas(self.sites)
+        self.consumed = sx.extract_event_consumers(self.files)
+        self.diff_keys = sx.extract_diff_keys(self.files)
+        self.summary_keys = sx.extract_summary_keys(self.files)
+        self.metric_regs = sx.extract_metric_registrations(self.files)
+        self.metric_refs = (sx.extract_metric_references(self.files)
+                            + sx.extract_yaml_metric_references(root))
+        self.header_consts = sx.extract_header_constants(self.files)
+        self.header_lines = self._header_const_lines()
+        test_files = _load_test_tree(root)
+        self.header_uses = (
+            sx.extract_header_uses(self.files, self.header_consts)
+            + sx.extract_header_uses(test_files, self.header_consts,
+                                     count_raw=True))
+        self.raw_literals = sx.extract_raw_header_literals(self.files)
+
+    def _header_const_lines(self) -> Dict[str, int]:
+        sf = self.by_path.get(sx.HEADERS_MODULE)
+        lines: Dict[str, int] = {}
+        if sf is None:
+            return lines
+        import ast
+        for node in sf.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and node.targets[0].id in self.header_consts:
+                lines[node.targets[0].id] = node.lineno
+        return lines
+
+    # ------------------------------------------------------- derived shapes
+    def metric_families(self) -> Dict[str, Tuple[str, Tuple[str, ...]]]:
+        """name -> (kind, labels) from the first registration site; shape
+        conflicts are findings, not silent merges."""
+        fams: Dict[str, Tuple[str, Tuple[str, ...]]] = {}
+        for reg in self.metric_regs:
+            fams.setdefault(reg.name, (reg.kind, reg.labels))
+        return fams
+
+    def header_surface(self) -> Dict[str, Dict[str, object]]:
+        """header value -> {constant, writers, readers} with test files
+        collapsed to one 'tests' entry."""
+        name_of = {v: k for k, v in self.header_consts.items()}
+        out: Dict[str, Dict[str, object]] = {
+            v: {'constant': k, 'writers': set(), 'readers': set()}
+            for k, v in self.header_consts.items()}
+        for use in self.header_uses:
+            entry = out.get(use.header)
+            if entry is None:      # raw literal in tests for an unpinned
+                continue           # header: the raw-literal pass owns it
+            mod = ('tests' if use.path.startswith('tests')
+                   else use.path)
+            if use.mode in ('write', 'forward'):
+                entry['writers'].add(mod)
+            if use.mode in ('read', 'forward'):
+                entry['readers'].add(mod)
+        return {
+            h: {'constant': e['constant'],
+                'writers': sorted(e['writers']),
+                'readers': sorted(e['readers'])}
+            for h, e in sorted(out.items())
+        }
+
+    def to_sidecar(self) -> Dict:
+        """The pinnable contract. Raises ValueError while passes 1–3
+        still have (unsuppressed) findings — nothing is written."""
+        problems = [str(_as_finding(rf))
+                    for rf in _surface_findings(self)
+                    if _as_finding(rf) is not None]
+        if problems:
+            raise ValueError(
+                'refusing to pin SEGCONTRACT.json while the contract '
+                'itself is incoherent; fix these first:\n  '
+                + '\n  '.join(problems))
+        return {
+            '_comment': (
+                'segcontract sidecar: the committed cross-plane contract '
+                '- event schemas (required/optional keys per type, open '
+                'types may carry extras), metric families (kind + label '
+                'set), and wire headers (writer/reader modules). Any '
+                'drift fails `segcheck --rules contracts`; review and '
+                're-pin with `tools/segcheck.py --update-contracts`.'),
+            'events': {t: self.events[t] for t in sorted(self.events)},
+            'metrics': {
+                name: {'kind': kind, 'labels': list(labels)}
+                for name, (kind, labels)
+                in sorted(self.metric_families().items())},
+            'headers': self.header_surface(),
+        }
+
+
+def _load_test_tree(root: str) -> List[SourceFile]:
+    try:
+        return load_tree(root, subdirs=('tests',))
+    except SyntaxError:            # a broken test file is not this
+        return []                  # rule's problem
+
+
+# ------------------------------------------------------------- sidecar IO
+def sidecar_path(root: str) -> str:
+    return os.path.join(root, SEGCONTRACT_FILE)
+
+
+def load_sidecar(root: str) -> Optional[Dict]:
+    path = sidecar_path(root)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def save_sidecar(root: str, obs: Observed) -> Dict:
+    data = obs.to_sidecar()        # raises on incoherence, nothing written
+    with open(sidecar_path(root), 'w') as f:
+        json.dump(data, f, indent=1, sort_keys=False)
+        f.write('\n')
+    return data
+
+
+def update_contracts(root: str,
+                     files: Optional[Sequence[SourceFile]] = None) -> Dict:
+    """Re-pin SEGCONTRACT.json from the current tree (the --update-
+    contracts entry point). Refuses orphan consumers et al.: see
+    Observed.to_sidecar."""
+    obs = Observed(root, files if files is not None else load_tree(root))
+    return save_sidecar(root, obs)
+
+
+# ------------------------------------------------------- passes 1–3 (tree)
+def _event_findings(obs: Observed) -> List[_RawFinding]:
+    out: List[_RawFinding] = []
+    for site in obs.sites:
+        if site.event is None:
+            out.append((obs.by_path.get(site.path), site.path, site.line,
+                        "emit site has no statically resolvable 'event' "
+                        'key; name the event type with a literal so its '
+                        'schema can be audited'))
+    implicit = set(sx.IMPLICIT_EVENT_KEYS)
+    for c in obs.consumed:
+        schema = obs.events.get(c.event)
+        sf = obs.by_path.get(c.path)
+        if schema is None:
+            out.append((sf, c.path, c.line,
+                        f"consumes event type '{c.event}' that no emit "
+                        'site produces'))
+            continue
+        known = set(schema['required']) | set(schema['optional']) | implicit
+        if c.key not in known and not schema['open']:
+            out.append((sf, c.path, c.line,
+                        f"consumes key '{c.key}' of event '{c.event}' "
+                        'but no emit site produces it (produced: '
+                        f"{sorted(known - implicit)})"))
+    summary = sorted(obs.summary_keys)
+    for path, line, pattern in obs.diff_keys:
+        ok = any(pattern == k or fnmatch.fnmatch(k, pattern)
+                 or fnmatch.fnmatch(pattern, k) for k in summary)
+        if not ok:
+            out.append((obs.by_path.get(path), path, line,
+                        f"diff row '{pattern}' has no matching key in "
+                        'summarize() output'))
+    return out
+
+
+def _metric_findings(obs: Observed) -> List[_RawFinding]:
+    out: List[_RawFinding] = []
+    fams = obs.metric_families()
+    first: Dict[str, sx.MetricReg] = {}
+    for reg in obs.metric_regs:
+        prior = first.setdefault(reg.name, reg)
+        kind, labels = fams[reg.name]
+        if (reg.kind, reg.labels) != (kind, labels):
+            out.append((obs.by_path.get(reg.path), reg.path, reg.line,
+                        f"metric family '{reg.name}' registered as "
+                        f"{reg.kind}{list(reg.labels)} here but "
+                        f"{kind}{list(labels)} at {prior.path}:"
+                        f'{prior.line}; one family, one shape'))
+    for ref in obs.metric_refs:
+        base, kind_ok = _resolve_family(ref.name, fams)
+        sf = obs.by_path.get(ref.path)
+        if base is None:
+            out.append((sf, ref.path, ref.line,
+                        f"references metric family '{ref.name}' that is "
+                        'never registered'))
+            continue
+        if not kind_ok:
+            out.append((sf, ref.path, ref.line,
+                        f"references derived series '{ref.name}' but "
+                        f"'{base}' is a {fams[base][0]}, not a "
+                        'histogram'))
+            continue
+        extra = (set(ref.labels) - set(sx._SYNTHETIC_LABELS)
+                 - set(fams[base][1]))
+        if extra:
+            out.append((sf, ref.path, ref.line,
+                        f"references metric family '{base}' with "
+                        f'label(s) {sorted(extra)} outside its '
+                        f'registered label set {list(fams[base][1])}'))
+    return out
+
+
+def _resolve_family(name: str,
+                    fams: Dict[str, Tuple[str, Tuple[str, ...]]]
+                    ) -> Tuple[Optional[str], bool]:
+    """(base family, kind-compatible) for a reference name, resolving
+    the derived-series suffixes render_prometheus emits for histograms."""
+    if name in fams:
+        return name, True
+    for suffix in sx.HISTOGRAM_SUFFIXES:
+        if name.endswith(suffix):
+            base = name[:-len(suffix)]
+            if base in fams:
+                return base, fams[base][0] == 'histogram'
+    return None, False
+
+
+def _header_findings(obs: Observed) -> List[_RawFinding]:
+    out: List[_RawFinding] = []
+    sf = obs.by_path.get(sx.HEADERS_MODULE)
+    for header, entry in obs.header_surface().items():
+        const = entry['constant']
+        line = obs.header_lines.get(const, 1)
+        if not entry['writers'] and not entry['readers']:
+            out.append((sf, sx.HEADERS_MODULE, line,
+                        f"header constant {const} ('{header}') is never "
+                        'used; delete it or wire up the producer and '
+                        'consumer'))
+        elif not entry['readers']:
+            out.append((sf, sx.HEADERS_MODULE, line,
+                        f"header '{header}' ({const}) is written by "
+                        f"{entry['writers']} but never read; drop it or "
+                        'add the consumer'))
+        elif not entry['writers']:
+            out.append((sf, sx.HEADERS_MODULE, line,
+                        f"header '{header}' ({const}) is read by "
+                        f"{entry['readers']} but never written; drop the "
+                        'read or add the producer'))
+    for raw_sf, line, literal in obs.raw_literals:
+        out.append((raw_sf, raw_sf.relpath, line,
+                    f"raw wire-header literal '{literal}' outside "
+                    'serve/headers.py; spell it via the serve.headers '
+                    'constant'))
+    return out
+
+
+def _surface_findings(obs: Observed) -> List[_RawFinding]:
+    return (_event_findings(obs) + _metric_findings(obs)
+            + _header_findings(obs))
+
+
+# --------------------------------------------------------- pass 4 (sidecar)
+def compare(obs: Observed, sidecar: Optional[Dict]) -> List[_RawFinding]:
+    """Gate the observed contract against the committed sidecar, both
+    directions, all three surfaces."""
+    repin = 'review the change and re-pin with --update-contracts'
+    out: List[_RawFinding] = []
+    observed = {
+        'events': {t: obs.events[t] for t in sorted(obs.events)},
+        'metrics': {name: {'kind': kind, 'labels': list(labels)}
+                    for name, (kind, labels)
+                    in sorted(obs.metric_families().items())},
+        'headers': obs.header_surface(),
+    }
+    if sidecar is None:
+        n = (len(observed['events']), len(observed['metrics']),
+             len(observed['headers']))
+        if any(n):
+            out.append((None, SEGCONTRACT_FILE, 1,
+                        f'{SEGCONTRACT_FILE} is missing but the tree has '
+                        f'{n[0]} event type(s), {n[1]} metric family(ies) '
+                        f'and {n[2]} wire header(s); pin the contract '
+                        f'with `tools/segcheck.py --update-contracts` '
+                        'and commit it'))
+        return out
+
+    locate = {
+        'events': _event_locator(obs),
+        'metrics': _metric_locator(obs),
+        'headers': _header_locator(obs),
+    }
+    nouns = {'events': 'event type', 'metrics': 'metric family',
+             'headers': 'wire header'}
+    for surface in ('events', 'metrics', 'headers'):
+        pinned = sidecar.get(surface, {})
+        seen = observed[surface]
+        for name in sorted(set(seen) - set(pinned)):
+            sf, path, line = locate[surface](name, obs)
+            out.append((sf, path, line,
+                        f"new {nouns[surface]} '{name}' is not in the "
+                        f'committed {SEGCONTRACT_FILE}; {repin}'))
+        for name in sorted(set(pinned) - set(seen)):
+            out.append((None, SEGCONTRACT_FILE, 1,
+                        f"{nouns[surface]} '{name}' is pinned in "
+                        f'{SEGCONTRACT_FILE} but gone from the tree; '
+                        f'{repin}'))
+        for name in sorted(set(seen) & set(pinned)):
+            if seen[name] != pinned[name]:
+                sf, path, line = locate[surface](name, obs)
+                out.append((sf, path, line,
+                            f"{nouns[surface]} '{name}' drifted from the "
+                            f'committed {SEGCONTRACT_FILE} (pinned '
+                            f'{json.dumps(pinned[name], sort_keys=True)} '
+                            f'vs observed '
+                            f'{json.dumps(seen[name], sort_keys=True)}); '
+                            f'{repin}'))
+    return out
+
+
+def _event_locator(obs: Observed):
+    sites = {}
+    for s in obs.sites:
+        if s.event is not None:
+            sites.setdefault(s.event, (s.path, s.line))
+    def locate(name, obs):
+        path, line = sites.get(name, (SEGCONTRACT_FILE, 1))
+        return obs.by_path.get(path), path, line
+    return locate
+
+
+def _metric_locator(obs: Observed):
+    regs = {}
+    for r in obs.metric_regs:
+        regs.setdefault(r.name, (r.path, r.line))
+    def locate(name, obs):
+        path, line = regs.get(name, (SEGCONTRACT_FILE, 1))
+        return obs.by_path.get(path), path, line
+    return locate
+
+
+def _header_locator(obs: Observed):
+    def locate(name, obs):
+        const = {v: k for k, v in obs.header_consts.items()}.get(name)
+        line = obs.header_lines.get(const, 1)
+        return (obs.by_path.get(sx.HEADERS_MODULE), sx.HEADERS_MODULE,
+                line)
+    return locate
+
+
+# ----------------------------------------------------------------- the rule
+def _as_finding(rf: _RawFinding) -> Optional[Finding]:
+    sf, path, line, msg = rf
+    if sf is not None:
+        return sf.finding(RULE_CONTRACTS, line, msg)
+    return Finding(rule=RULE_CONTRACTS, path=path, line=line, message=msg)
+
+
+def check_contracts(root: str,
+                    files: Optional[Sequence[SourceFile]] = None
+                    ) -> List[Finding]:
+    """All four passes; suppression via ``# segcheck:
+    disable=contracts`` like every other rule."""
+    obs = Observed(root, files if files is not None else load_tree(root))
+    raw = _surface_findings(obs) + compare(obs, load_sidecar(root))
+    findings: List[Finding] = []
+    seen: Set[Tuple[str, int, str]] = set()
+    for rf in raw:
+        f = _as_finding(rf)
+        if f is not None and (f.path, f.line, f.message) not in seen:
+            seen.add((f.path, f.line, f.message))
+            findings.append(f)
+    return sorted(findings, key=lambda f: (f.path, f.line, f.message))
+
+
+def suppression_count(root: str,
+                      files: Optional[Sequence[SourceFile]] = None) -> int:
+    """How many lines in the runtime tree carry a contracts suppression —
+    pinned by tests so the escape hatch stays an escape hatch."""
+    sfs = files if files is not None else load_tree(root)
+    count = 0
+    for sf in sfs:
+        for line, rules in sf.suppressed.items():
+            if RULE_CONTRACTS in rules or 'all' in rules:
+                if suppressed_at(root, sf.relpath, line, RULE_CONTRACTS):
+                    count += 1
+    return count

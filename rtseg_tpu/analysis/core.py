@@ -26,8 +26,9 @@ RULE_EVIDENCE = 'evidence-citation'
 RULE_OBS = 'obs-purity'
 RULE_WARM = 'warm-key'
 RULE_CONCURRENCY = 'concurrency'
+RULE_CONTRACTS = 'contracts'
 ALL_RULES = (RULE_IMPORTS, RULE_REGISTRY, RULE_TRACE, RULE_EVIDENCE,
-             RULE_OBS, RULE_WARM, RULE_CONCURRENCY)
+             RULE_OBS, RULE_WARM, RULE_CONCURRENCY, RULE_CONTRACTS)
 
 #: deep (jaxpr/HLO-level) rule identifiers — the segaudit family. These
 #: trace and compile the real step artifacts instead of walking source
@@ -155,6 +156,7 @@ def run_lints(root: Optional[str] = None,
     from .lint_obs import check_obs_purity
     from .lint_warm import check_warm_key_coverage
     from .concurrency import check_concurrency
+    from .contracts import check_contracts
     table: Dict[str, Callable[..., List[Finding]]] = {
         RULE_IMPORTS: check_import_hygiene,
         RULE_REGISTRY: check_registry_consistency,
@@ -163,6 +165,7 @@ def run_lints(root: Optional[str] = None,
         RULE_OBS: check_obs_purity,
         RULE_WARM: check_warm_key_coverage,
         RULE_CONCURRENCY: check_concurrency,
+        RULE_CONTRACTS: check_contracts,
     }
     root = root or repo_root()
     selected = list(rules) if rules is not None else list(ALL_RULES)

@@ -1,0 +1,1143 @@
+"""segcontract extraction: pure-AST inference of the three stringly-typed
+cross-plane surfaces the contracts rule gates (contracts.py).
+
+  * **event schemas** — every ``sink.emit({...})`` site's key set (event
+    producers) and every key ``obs/report.py`` / ``obs/live.py`` read off
+    a typed event (consumers). Producer inference follows the dict
+    through the emitting function: literal keys, ``ev['k'] = v``
+    augmentation, ``ev.update({...})``, ``setdefault`` (optional), helper
+    calls that return a dict (``DeviceProfile.to_event``), and one level
+    of wrapper resolution (``StreamFrontend._emit``). A ``**spread`` or
+    ``update(<non-literal>)`` makes the site *open* — consumers may rely
+    only on the explicit keys. Consumer inference attributes key reads to
+    an event type through the repo's own idioms: comprehension filters
+    (``[e for e in events if e.get('event') == 'step']``), ``kind =
+    e.get('event')`` branch chains, ``next(genexp)``, and one level of
+    same-module call parameter tagging (``_summarize_device(profs, ...)``).
+    Accesses on variables the tagger cannot type are ignored — this
+    extractor trades recall for precision, so every finding it feeds is
+    real.
+  * **metric families** — every ``counter/gauge/histogram`` registration
+    (name + label-kwarg names) and every reference shape the consumers
+    use: ``_family_value``/``_family_sum``, suffix helpers (live.py
+    ``_q`` -> ``<family>_window``), ``scrape_counter_sum``, literal
+    subscripts of a ``parsed`` mapping, and the CI yaml's reconcile
+    snippets (text regex — yaml is not Python).
+  * **wire headers** — the canonical constants in serve/headers.py,
+    every read/write/forward site per constant (tests included, as
+    readers/writers), and every raw ``X-*`` string literal outside the
+    constants module.
+
+Everything here is stdlib ``ast`` — no jax, no imports of the scanned
+modules — so the contracts rule runs at the bare ``--lint-only`` tier.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import SourceFile
+
+#: keys EventSink stamps on every event (obs/core.py: ts at emit, host
+#: from the sink's static dict) — implicitly producible for every type
+IMPLICIT_EVENT_KEYS = ('ts', 'host', 'event')
+
+#: registration kwargs that are metric configuration, not label names
+_NON_LABEL_KWARGS = ('help', 'bounds', 'window')
+
+#: label names synthesized by render_prometheus on derived series
+_SYNTHETIC_LABELS = ('le', 'quantile')
+
+#: derived-series suffixes render_prometheus emits for one histogram
+HISTOGRAM_SUFFIXES = ('_bucket', '_count', '_sum', '_window')
+
+#: a full-string wire-header literal (implicit-concat fragments fold at
+#: parse time, so prose/help-text mentions never fully match)
+HEADER_RE = re.compile(r'^X-[A-Za-z][A-Za-z0-9-]*$')
+
+#: the one module allowed to spell X-* literals
+HEADERS_MODULE = 'rtseg_tpu/serve/headers.py'
+
+
+# --------------------------------------------------------------- ast helpers
+def _const_str(node: Optional[ast.AST]) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _terminal_name(node: ast.AST) -> str:
+    """The rightmost simple name of a call receiver / func expression
+    (``self._obs_sink`` -> ``_obs_sink``, ``get_sink()`` -> ``get_sink``)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Call):
+        return _terminal_name(node.func)
+    return ''
+
+
+def _functions(tree: ast.AST):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def module_str_constants(files: Sequence[SourceFile]) -> Dict[str, str]:
+    """Module-level ``NAME = 'literal'`` constants across the tree, used
+    to resolve Name-valued dict keys (``ev[TRACE_KEY] = ...``). A name
+    bound to different values in different modules is ambiguous and
+    dropped."""
+    out: Dict[str, str] = {}
+    clash: Set[str] = set()
+    for sf in files:
+        for node in sf.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                val = _const_str(node.value)
+                if val is None:
+                    continue
+                name = node.targets[0].id
+                if name in out and out[name] != val:
+                    clash.add(name)
+                out.setdefault(name, val)
+    for name in clash:
+        out.pop(name, None)
+    return out
+
+
+# ------------------------------------------------------------ event schemas
+@dataclass
+class EmitSite:
+    """One resolved producer site: the key sets one ``sink.emit`` ships."""
+    path: str
+    line: int
+    event: Optional[str]            # None: type undeterminable -> finding
+    required: Set[str] = field(default_factory=set)
+    optional: Set[str] = field(default_factory=set)
+    open: bool = False              # **spread / dynamic keys present
+
+
+@dataclass(frozen=True)
+class ConsumedKey:
+    """One consumer read: ``<event type>.<key>`` at a source location."""
+    path: str
+    line: int
+    event: str
+    key: str
+
+
+def _branch_path(func: ast.AST, target: ast.AST) -> Optional[Tuple]:
+    """The chain of (container id, field) choices leading to ``target``
+    inside ``func`` — two statements share a guaranteed execution order
+    iff one's path is a prefix of the other's."""
+    def walk(node, path):
+        for fname, value in ast.iter_fields(node):
+            kids = value if isinstance(value, list) else [value]
+            for kid in kids:
+                if not isinstance(kid, ast.AST):
+                    continue
+                if kid is target:
+                    return path + ((id(node), fname),)
+                found = walk(kid, path + ((id(node), fname),))
+                if found is not None:
+                    return found
+        return None
+    return walk(func, ())
+
+
+class _SchemaCtx:
+    """Shared resolution context: the function-def index (helpers by bare
+    name) and the module-level string-constant table."""
+
+    def __init__(self, files: Sequence[SourceFile]):
+        self.consts = module_str_constants(files)
+        self.defs: Dict[str, List[Tuple[SourceFile, ast.AST]]] = {}
+        for sf in files:
+            for fn in _functions(sf.tree):
+                self.defs.setdefault(fn.name, []).append((sf, fn))
+
+    def key_of(self, node: ast.AST) -> Optional[str]:
+        """Resolve a dict-key expression to a string, through the
+        constant table for Name keys; None = dynamic."""
+        lit = _const_str(node)
+        if lit is not None:
+            return lit
+        if isinstance(node, ast.Name):
+            return self.consts.get(node.id)
+        return None
+
+
+def _dict_literal_schema(node: ast.Dict, ctx: _SchemaCtx) -> EmitSite:
+    site = EmitSite(path='', line=node.lineno, event=None)
+    for k, v in zip(node.keys, node.values):
+        if k is None:                     # **spread inside the literal
+            site.open = True
+            continue
+        key = ctx.key_of(k)
+        if key is None:
+            site.open = True              # dynamic key
+            continue
+        site.required.add(key)
+        if key == 'event':
+            site.event = _const_str(v)
+    return site
+
+
+def _helper_schema(call: ast.Call, ctx: _SchemaCtx,
+                   depth: int) -> Optional[EmitSite]:
+    """Schema of ``helper(...)`` when ``helper`` is a scanned def that
+    returns a dict: the helper's return schema, plus call-site keyword
+    names when the helper folds ``**kwargs`` into the dict."""
+    if depth > 2:
+        return None
+    name = _terminal_name(call.func)
+    for sf, fn in ctx.defs.get(name, ()):
+        ret = next((n for n in ast.walk(fn)
+                    if isinstance(n, ast.Return) and n.value is not None),
+                   None)
+        if ret is None:
+            continue
+        schema = _value_schema(ret.value, fn, sf, ctx, depth + 1,
+                               anchor=ret)
+        if schema is None:
+            continue
+        kwargs_param = fn.args.kwarg.arg if fn.args.kwarg else None
+        if kwargs_param is not None and kwargs_param in \
+                getattr(schema, '_updated_names', ()):
+            # the helper folded its **kwargs in: call-site keyword names
+            # become this site's keys, and only a **spread AT the call
+            # site makes it open
+            schema.open = False
+            for kw in call.keywords:
+                if kw.arg is None:
+                    schema.open = True
+                else:
+                    schema.required.add(kw.arg)
+        return schema
+    return None
+
+
+def _value_schema(value: ast.AST, func: ast.AST, sf: SourceFile,
+                  ctx: _SchemaCtx, depth: int = 0,
+                  anchor: Optional[ast.AST] = None) -> Optional[EmitSite]:
+    """Schema of the expression ``value`` as seen at ``anchor`` (the emit
+    or return statement) inside ``func``."""
+    if isinstance(value, ast.Dict):
+        base = _dict_literal_schema(value, ctx)
+    elif isinstance(value, ast.Call):
+        base = _helper_schema(value, ctx, depth)
+        if base is None:
+            return None
+    elif isinstance(value, ast.Name):
+        return _name_schema(value.id, func, sf, ctx, depth, anchor)
+    else:
+        return None
+    base.path, base.line = sf.relpath, value.lineno
+    return base
+
+
+def _binds(node: ast.AST, name: str) -> bool:
+    """Whether a statement (re)binds ``name`` to a value — plain or
+    annotated assignment."""
+    if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+            and isinstance(node.targets[0], ast.Name):
+        return node.targets[0].id == name
+    if isinstance(node, ast.AnnAssign) and node.value is not None \
+            and isinstance(node.target, ast.Name):
+        return node.target.id == name
+    return False
+
+
+def _name_schema(name: str, func: ast.AST, sf: SourceFile, ctx: _SchemaCtx,
+                 depth: int, anchor: Optional[ast.AST]) -> Optional[EmitSite]:
+    """Follow a local dict variable through the emitting function:
+    base assignment, subscript stores, update()/setdefault() calls."""
+    params = {a.arg for a in (func.args.args + func.args.posonlyargs
+                              + func.args.kwonlyargs)}
+    kwargs_param = func.args.kwarg.arg if func.args.kwarg else None
+    anchor_path = _branch_path(func, anchor) if anchor is not None else None
+    site: Optional[EmitSite] = None
+    updated_names: List[str] = []
+    if depth > 5 or name in params or name == kwargs_param:
+        return None                 # parameter: resolved by the caller
+
+    def unconditional(stmt_node: ast.AST) -> bool:
+        if anchor_path is None:
+            return True
+        p = _branch_path(func, stmt_node)
+        return p is not None and anchor_path[:len(p)] == p
+
+    for node in ast.walk(func):
+        if anchor is not None and getattr(node, 'lineno', 0) \
+                > getattr(anchor, 'lineno', 1 << 30):
+            continue
+        # ev = {...} / ev: Dict[...] = {...} / ev = helper(...)
+        if _binds(node, name):
+            site = _value_schema(node.value, func, sf, ctx, depth + 1,
+                                 anchor=node)
+            if site is None:
+                site = EmitSite(path=sf.relpath, line=node.lineno,
+                                event=None, open=True)
+        # ev['k'] = v
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Subscript) \
+                and isinstance(node.targets[0].value, ast.Name) \
+                and node.targets[0].value.id == name and site is not None:
+            key = ctx.key_of(node.targets[0].slice)
+            if key is None:
+                site.open = True
+            elif unconditional(node):
+                site.required.add(key)
+                if key == 'event' and site.event is None:
+                    site.event = _const_str(node.value)
+            else:
+                site.optional.add(key)
+        # ev.update(...) / ev.setdefault('k', v)
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id == name and site is not None:
+            if node.func.attr == 'update' and node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Dict):
+                    sub = _dict_literal_schema(arg, ctx)
+                    dest = site.required if unconditional(node) \
+                        else site.optional
+                    dest.update(sub.required)
+                    site.open = site.open or sub.open
+                else:
+                    site.open = True
+                    if isinstance(arg, ast.Name):
+                        updated_names.append(arg.id)
+            elif node.func.attr == 'setdefault' and node.args:
+                key = ctx.key_of(node.args[0])
+                if key is None:
+                    site.open = True
+                else:
+                    site.optional.add(key)
+    if site is not None:
+        site.optional -= site.required
+        # stash which names were folded in, for **kwargs resolution
+        site._updated_names = tuple(updated_names)  # type: ignore[attr-defined]
+    return site
+
+
+def extract_event_producers(files: Sequence[SourceFile]
+                            ) -> List[EmitSite]:
+    """Every resolved ``sink.emit`` site in the tree, wrappers included."""
+    ctx = _SchemaCtx(files)
+    sites: List[EmitSite] = []
+    for sf in files:
+        for func in _functions(sf.tree):
+            for node in ast.walk(func):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == 'emit' and node.args
+                        and 'sink' in _terminal_name(node.func.value)):
+                    continue
+                arg = node.args[0]
+                params = {a.arg for a in (func.args.args
+                                          + func.args.posonlyargs
+                                          + func.args.kwonlyargs)}
+                if isinstance(arg, ast.Name) and arg.id in params:
+                    sites.extend(_wrapper_sites(sf, func, arg.id, ctx,
+                                                node.lineno))
+                    continue
+                schema = _value_schema(arg, func, sf, ctx, anchor=node)
+                if schema is None:
+                    schema = EmitSite(path=sf.relpath, line=node.lineno,
+                                      event=None, open=True)
+                schema.path, schema.line = sf.relpath, node.lineno
+                sites.append(schema)
+    # ast.walk reaches nested defs both standalone and under their parent
+    # function: keep one site per source location
+    uniq: Dict[Tuple[str, int], EmitSite] = {}
+    for s in sites:
+        uniq.setdefault((s.path, s.line), s)
+    return [uniq[k] for k in sorted(uniq)]
+
+
+def _wrapper_sites(sf: SourceFile, wrapper: ast.AST, param: str,
+                   ctx: _SchemaCtx, emit_line: int) -> List[EmitSite]:
+    """``def _emit(self, event): sink.emit(event)`` — the real producer
+    sites are the same-file callers; the wrapper's own mutations on the
+    parameter (``setdefault``) ride along as optional keys. A wrapper
+    with no resolvable caller is itself an unresolved emit site."""
+    extra = EmitSite(path=sf.relpath, line=wrapper.lineno, event=None)
+    for node in ast.walk(wrapper):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id == param \
+                and node.func.attr == 'setdefault' and node.args:
+            key = ctx.key_of(node.args[0])
+            if key is None:
+                extra.open = True
+            else:
+                extra.optional.add(key)
+    sites: List[EmitSite] = []
+    for func in _functions(sf.tree):
+        if func is wrapper:
+            continue
+        for node in ast.walk(func):
+            if isinstance(node, ast.Call) \
+                    and _terminal_name(node.func) == wrapper.name \
+                    and node.args:
+                schema = _value_schema(node.args[0], func, sf, ctx,
+                                       anchor=node)
+                if schema is None:
+                    schema = EmitSite(path=sf.relpath, line=node.lineno,
+                                      event=None, open=True)
+                schema.path, schema.line = sf.relpath, node.lineno
+                schema.optional |= extra.optional - schema.required
+                schema.open = schema.open or extra.open
+                sites.append(schema)
+    if not sites:
+        sites.append(EmitSite(path=sf.relpath, line=emit_line,
+                              event=None, open=True))
+    return sites
+
+
+def merge_event_schemas(sites: Sequence[EmitSite]
+                        ) -> Dict[str, Dict[str, object]]:
+    """Per-type observed schema: required = keys every site of the type
+    always ships; optional = everything else any site may ship; open =
+    any site open. Implicit sink-stamped keys ride as optional."""
+    by_type: Dict[str, List[EmitSite]] = {}
+    for s in sites:
+        if s.event is not None:
+            by_type.setdefault(s.event, []).append(s)
+    out: Dict[str, Dict[str, object]] = {}
+    for etype, group in by_type.items():
+        required = set.intersection(*(s.required for s in group))
+        seen = set.union(*(s.required | s.optional for s in group))
+        optional = (seen - required) | set(IMPLICIT_EVENT_KEYS) - required
+        out[etype] = {
+            'required': sorted(required),
+            'optional': sorted(optional - required),
+            'open': any(s.open for s in group),
+        }
+    return out
+
+
+# ----------------------------------------------------------- event consumers
+class _Tag:
+    """A variable's inferred event binding: an event type plus whether
+    the variable is one event (``item``) or a collection (``list``)."""
+    __slots__ = ('etype', 'kind')
+
+    def __init__(self, etype: str, kind: str):
+        self.etype, self.kind = etype, kind
+
+
+def _filter_event_type(test: ast.AST, var: str,
+                       op=ast.Eq) -> Optional[str]:
+    """Event type pinned on ``var`` by a filter expression:
+    ``var.get('event') == 'x'`` / ``var['event'] == 'x'`` (possibly a
+    BoolOp conjunct)."""
+    if isinstance(test, ast.BoolOp) and isinstance(test.op, ast.And):
+        for v in test.values:
+            t = _filter_event_type(v, var, op)
+            if t is not None:
+                return t
+        return None
+    if not (isinstance(test, ast.Compare) and len(test.ops) == 1
+            and isinstance(test.ops[0], op)):
+        return None
+    left, right = test.left, test.comparators[0]
+    etype = _const_str(right)
+    if etype is None:
+        return None
+    return etype if _is_event_access(left, var) else None
+
+
+def _is_event_access(node: ast.AST, var: str) -> bool:
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+            and node.func.attr == 'get' and node.args \
+            and _const_str(node.args[0]) == 'event' \
+            and isinstance(node.func.value, ast.Name) \
+            and node.func.value.id == var:
+        return True
+    if isinstance(node, ast.Subscript) \
+            and isinstance(node.value, ast.Name) and node.value.id == var \
+            and _const_str(node.slice) == 'event':
+        return True
+    return False
+
+
+class _ConsumerScan:
+    """One function's consumed-key walk (see module docstring)."""
+
+    def __init__(self, sf: SourceFile, ctx: '_SchemaCtx',
+                 out: List[ConsumedKey], call_depth: int = 0):
+        self.sf = sf
+        self.ctx = ctx
+        self.out = out
+        self.call_depth = call_depth
+        #: for-loop targets over literal string tuples: name -> keys
+        self.key_sets: Dict[str, Tuple[str, ...]] = {}
+        #: selector vars: name -> event-carrying var ('kind = e.get(..)')
+        self.selectors: Dict[str, str] = {}
+
+    # ------------------------------------------------------------- driver
+    def run(self, func: ast.AST, tags: Dict[str, _Tag]) -> None:
+        for arg in func.args.args + func.args.posonlyargs:
+            tags.setdefault(arg.arg, None)  # params shadow outer names
+        self._stmts(func.body, dict(tags))
+
+    def _stmts(self, body: List[ast.stmt], tags: Dict[str, _Tag]) -> None:
+        for i, stmt in enumerate(body):
+            self._stmt(stmt, tags, body[i + 1:])
+
+    def _stmt(self, stmt: ast.stmt, tags: Dict[str, _Tag],
+              rest: List[ast.stmt]) -> None:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name):
+            name = stmt.targets[0].id
+            self._expr(stmt.value, tags)
+            tags[name] = self._tag_of(stmt.value, tags)
+            # kind = e.get('event'): remember the selector var so later
+            # `if kind == 'x':` branches type `e`
+            src = self._event_source(stmt.value)
+            if src is not None:
+                self.selectors[name] = src.id
+            return
+        if isinstance(stmt, ast.For):
+            self._expr(stmt.iter, tags)
+            inner = dict(tags)
+            if isinstance(stmt.target, ast.Name):
+                keys = _literal_str_seq(stmt.iter)
+                if keys is not None:
+                    self.key_sets[stmt.target.id] = keys
+                it_tag = self._tag_of(stmt.iter, tags)
+                inner[stmt.target.id] = (_Tag(it_tag.etype, 'item')
+                                         if it_tag is not None
+                                         and it_tag.kind == 'list'
+                                         else None)
+            self._stmts(stmt.body, inner)
+            self._stmts(stmt.orelse, dict(tags))
+            return
+        if isinstance(stmt, ast.If):
+            self._expr(stmt.test, tags)
+            body_tags = dict(tags)
+            # if kind == 'x': / if e.get('event') == 'x':
+            pinned = self._pinned_var(stmt.test, ast.Eq)
+            if pinned is not None:
+                var, etype = pinned
+                body_tags[var] = _Tag(etype, 'item')
+            self._stmts(stmt.body, body_tags)
+            self._stmts(stmt.orelse, dict(tags))
+            # if e.get('event') != 'x': continue  -> rest is typed
+            pinned = self._pinned_var(stmt.test, ast.NotEq)
+            if pinned is not None and stmt.body and isinstance(
+                    stmt.body[-1], (ast.Continue, ast.Return)):
+                var, etype = pinned
+                tags[var] = _Tag(etype, 'item')
+            return
+        if isinstance(stmt, (ast.While, ast.With, ast.Try)):
+            for fname, value in ast.iter_fields(stmt):
+                kids = value if isinstance(value, list) else [value]
+                sub = [k for k in kids if isinstance(k, ast.stmt)]
+                if sub:
+                    self._stmts(sub, dict(tags))
+                else:
+                    for k in kids:
+                        if isinstance(k, ast.expr):
+                            self._expr(k, tags)
+            for handler in getattr(stmt, 'handlers', ()):
+                self._stmts(handler.body, dict(tags))
+            return
+        for node in ast.iter_child_nodes(stmt):
+            if isinstance(node, ast.expr):
+                self._expr(node, tags)
+            elif isinstance(node, ast.stmt):
+                self._stmt(node, tags, [])
+
+    # -------------------------------------------------------------- tagging
+    def _event_source(self, value: ast.AST) -> Optional[ast.Name]:
+        """The Name whose 'event' key ``value`` reads, if any."""
+        if isinstance(value, ast.Call) \
+                and isinstance(value.func, ast.Attribute) \
+                and value.func.attr == 'get' and value.args \
+                and _const_str(value.args[0]) == 'event' \
+                and isinstance(value.func.value, ast.Name):
+            return value.func.value
+        return None
+
+    def _pinned_var(self, test: ast.AST, op) -> Optional[Tuple[str, str]]:
+        """(var, etype) pinned by ``kind == 'x'`` or a direct
+        ``e.get('event') == 'x'`` comparison. An ``and`` conjunct pins
+        for Eq (taken branch implies it); an ``or`` disjunct pins for
+        NotEq (the continue-guard idiom: not taking it implies Eq)."""
+        if isinstance(test, ast.BoolOp) and (
+                isinstance(test.op, ast.And) if op is ast.Eq
+                else isinstance(test.op, ast.Or)):
+            for v in test.values:
+                p = self._pinned_var(v, op)
+                if p is not None:
+                    return p
+            return None
+        if not (isinstance(test, ast.Compare) and len(test.ops) == 1
+                and isinstance(test.ops[0], op)):
+            return None
+        left, right = test.left, test.comparators[0]
+        etype = _const_str(right)
+        if etype is None:
+            return None
+        if isinstance(left, ast.Name) and left.id in self.selectors:
+            return (self.selectors[left.id], etype)
+        src = self._event_source(left)
+        if src is not None:
+            return (src.id, etype)
+        if isinstance(left, ast.Subscript) \
+                and isinstance(left.value, ast.Name) \
+                and _const_str(left.slice) == 'event':
+            return (left.value.id, etype)
+        return None
+
+    def _tag_of(self, value: ast.AST, tags: Dict[str, _Tag]
+                ) -> Optional[_Tag]:
+        if isinstance(value, ast.Name):
+            return tags.get(value.id)
+        if isinstance(value, (ast.ListComp, ast.GeneratorExp,
+                              ast.SetComp)):
+            etag = self._comp_tags(value, tags).get(
+                getattr(value.elt, 'id', None))
+            if etag is not None and isinstance(value.elt, ast.Name):
+                return _Tag(etag.etype, 'list')
+            return None
+        if isinstance(value, ast.Call):
+            fname = _terminal_name(value.func)
+            if fname in ('sorted', 'list', 'reversed', 'tuple') \
+                    and value.args:
+                return self._tag_of(value.args[0], tags)
+            if fname == 'next' and value.args:
+                t = self._tag_of(value.args[0], tags)
+                return _Tag(t.etype, 'item') if t is not None else None
+            return None
+        if isinstance(value, ast.BinOp) and isinstance(value.op, ast.Add):
+            lt = self._tag_of(value.left, tags)
+            rt = self._tag_of(value.right, tags)
+            if lt is not None and rt is not None and lt.etype == rt.etype:
+                return _Tag(lt.etype, 'list')
+            return None
+        if isinstance(value, ast.BoolOp) and isinstance(value.op, ast.Or):
+            for v in value.values:
+                t = self._tag_of(v, tags)
+                if t is not None:
+                    return t
+            return None
+        if isinstance(value, ast.Subscript) \
+                and isinstance(value.slice, (ast.Constant, ast.UnaryOp)):
+            t = self._tag_of(value.value, tags)
+            if t is not None and t.kind == 'list':
+                return _Tag(t.etype, 'item')
+            return None
+        return None
+
+    def _comp_tags(self, comp: ast.AST, tags: Dict[str, _Tag]
+                   ) -> Dict[str, _Tag]:
+        """Element-var tags inside a comprehension: from the iterable's
+        tag or the comprehension's own ``event ==`` filter."""
+        inner = dict(tags)
+        for gen in comp.generators:
+            if not isinstance(gen.target, ast.Name):
+                continue
+            var = gen.target.id
+            it_tag = self._tag_of(gen.iter, inner)
+            tag = (_Tag(it_tag.etype, 'item')
+                   if it_tag is not None and it_tag.kind == 'list'
+                   else None)
+            for cond in gen.ifs:
+                etype = _filter_event_type(cond, var)
+                if etype is not None:
+                    tag = _Tag(etype, 'item')
+            inner[var] = tag
+        return inner
+
+    # ------------------------------------------------------------- accesses
+    def _expr(self, node: ast.AST, tags: Dict[str, _Tag]) -> None:
+        if node is None:
+            return
+        if isinstance(node, (ast.ListComp, ast.GeneratorExp, ast.SetComp,
+                             ast.DictComp)):
+            inner = self._comp_tags(node, tags)
+            for gen in node.generators:
+                self._expr(gen.iter, tags)
+                for cond in gen.ifs:
+                    self._expr(cond, inner)
+            for part in ((node.key, node.value)
+                         if isinstance(node, ast.DictComp)
+                         else (node.elt,)):
+                self._expr(part, inner)
+            return
+        self._access(node, tags)
+        if isinstance(node, ast.Call):
+            self._same_module_call(node, tags)
+        for kid in ast.iter_child_nodes(node):
+            if isinstance(kid, ast.expr):
+                self._expr(kid, tags)
+            elif isinstance(kid, ast.keyword):
+                self._expr(kid.value, tags)
+            elif isinstance(kid, ast.comprehension):   # pragma: no cover
+                pass
+
+    def _emit_key(self, var: str, key: str, line: int,
+                  tags: Dict[str, _Tag]) -> None:
+        tag = tags.get(var)
+        if tag is not None and tag.kind == 'item':
+            self.out.append(ConsumedKey(self.sf.relpath, line,
+                                        tag.etype, key))
+
+    @staticmethod
+    def _recv_var(node: ast.AST) -> Optional[str]:
+        """Receiver variable of a key access: a bare Name, or the first
+        Name operand of an ``(x or {})`` default guard."""
+        if isinstance(node, ast.Name):
+            return node.id
+        if isinstance(node, ast.BoolOp) and isinstance(node.op, ast.Or):
+            for v in node.values:
+                if isinstance(v, ast.Name):
+                    return v.id
+        return None
+
+    def _access(self, node: ast.AST, tags: Dict[str, _Tag]) -> None:
+        # e.get('k') / e['k'] / 'k' in e / e[loop_key] / (e or {}).get('k')
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in ('get', 'setdefault') and node.args:
+            var = self._recv_var(node.func.value)
+            key = _const_str(node.args[0])
+            if var is not None and key is not None:
+                self._emit_key(var, key, node.lineno, tags)
+        elif isinstance(node, ast.Subscript) \
+                and isinstance(node.ctx, ast.Load):
+            var = self._recv_var(node.value)
+            if var is None:
+                return
+            key = _const_str(node.slice)
+            if key is not None:
+                self._emit_key(var, key, node.lineno, tags)
+            elif isinstance(node.slice, ast.Name):
+                for k in self.key_sets.get(node.slice.id, ()):
+                    self._emit_key(var, k, node.lineno, tags)
+        elif isinstance(node, ast.Compare) and len(node.ops) == 1 \
+                and isinstance(node.ops[0], (ast.In, ast.NotIn)) \
+                and isinstance(node.comparators[0], ast.Name):
+            key = _const_str(node.left)
+            if key is not None:
+                self._emit_key(node.comparators[0].id, key, node.lineno,
+                               tags)
+
+    def _same_module_call(self, node: ast.Call,
+                          tags: Dict[str, _Tag]) -> None:
+        """One level of param tagging: calling a same-module def with
+        tagged args scans the callee under those bindings."""
+        if self.call_depth >= 1:
+            return
+        arg_tags = [self._tag_of(a, tags) for a in node.args]
+        if not any(arg_tags):
+            return
+        name = _terminal_name(node.func)
+        for sf, fn in self.ctx.defs.get(name, ()):
+            if sf is not self.sf:
+                continue
+            params = fn.args.posonlyargs + fn.args.args
+            bound: Dict[str, _Tag] = {}
+            for p, t in zip(params, arg_tags):
+                if t is not None:
+                    bound[p.arg] = t
+            if bound:
+                sub = _ConsumerScan(self.sf, self.ctx, self.out,
+                                    self.call_depth + 1)
+                sub.run(fn, bound)
+            break
+
+
+def _literal_str_seq(node: ast.AST) -> Optional[Tuple[str, ...]]:
+    if isinstance(node, (ast.Tuple, ast.List)):
+        vals = [_const_str(e) for e in node.elts]
+        if vals and all(v is not None for v in vals):
+            return tuple(vals)
+    return None
+
+
+def extract_event_consumers(files: Sequence[SourceFile],
+                            only: Sequence[str] = ('rtseg_tpu/obs/report.py',
+                                                   'rtseg_tpu/obs/live.py')
+                            ) -> List[ConsumedKey]:
+    """Typed key reads in the consumer modules (report/live)."""
+    ctx = _SchemaCtx(files)
+    out: List[ConsumedKey] = []
+    for sf in files:
+        if sf.relpath not in only:
+            continue
+        for func in _functions(sf.tree):
+            if _is_nested(sf.tree, func):
+                continue        # nested defs scan with their parent
+            _ConsumerScan(sf, ctx, out).run(func, {})
+    # dedupe (same type/key read at many lines: keep first per pair)
+    seen: Dict[Tuple[str, str], ConsumedKey] = {}
+    for c in out:
+        seen.setdefault((c.event, c.key), c)
+    return sorted(seen.values(), key=lambda c: (c.path, c.line, c.key))
+
+
+def _is_nested(tree: ast.AST, func: ast.AST) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node is not func:
+            if any(n is func for n in ast.walk(node)):
+                return True
+    return False
+
+
+# --------------------------------------------------------- diff_rows gate
+def extract_diff_keys(files: Sequence[SourceFile]
+                      ) -> List[Tuple[str, int, str]]:
+    """(path, line, key-pattern) for each _DIFF_ROWS row in report.py;
+    f-string keys become ``*`` wildcards (``dev_*_ms``)."""
+    out: List[Tuple[str, int, str]] = []
+    for sf in files:
+        if not sf.relpath.endswith('obs/report.py'):
+            continue
+        for node in sf.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and node.targets[0].id == '_DIFF_ROWS':
+                for elt in node.value.elts:
+                    if isinstance(elt, ast.Tuple) and elt.elts:
+                        pat = _key_pattern(elt.elts[0])
+                        if pat is not None:
+                            out.append((sf.relpath, elt.lineno, pat))
+                    elif isinstance(elt, ast.Starred):
+                        gen = elt.value
+                        if isinstance(gen, (ast.GeneratorExp,
+                                            ast.ListComp)) \
+                                and isinstance(gen.elt, ast.Tuple) \
+                                and gen.elt.elts:
+                            pat = _key_pattern(gen.elt.elts[0])
+                            if pat is not None:
+                                out.append((sf.relpath, elt.lineno, pat))
+    return out
+
+
+def extract_summary_keys(files: Sequence[SourceFile]) -> Set[str]:
+    """Key patterns of the dict ``summarize()`` returns (f-string keys
+    and spread dict-comps become wildcards)."""
+    keys: Set[str] = set()
+    for sf in files:
+        if not sf.relpath.endswith('obs/report.py'):
+            continue
+        fn = next((f for f in _functions(sf.tree)
+                   if f.name == 'summarize'), None)
+        if fn is None:
+            continue
+        ret = next((n for n in ast.walk(fn) if isinstance(n, ast.Return)
+                    and isinstance(n.value, ast.Dict)), None)
+        if ret is None:
+            continue
+        spread_names: List[str] = []
+        for k in ret.value.keys:
+            if k is None:
+                continue
+            pat = _key_pattern(k)
+            if pat is not None:
+                keys.add(pat)
+        for k, v in zip(ret.value.keys, ret.value.values):
+            if k is None and isinstance(v, ast.Name):
+                spread_names.append(v.id)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt = node.targets[0]
+                if isinstance(tgt, ast.Name) and tgt.id in spread_names \
+                        and isinstance(node.value, ast.DictComp):
+                    pat = _key_pattern(node.value.key)
+                    if pat is not None:
+                        keys.add(pat)
+                elif isinstance(tgt, ast.Subscript) \
+                        and isinstance(tgt.value, ast.Name) \
+                        and tgt.value.id in spread_names:
+                    pat = _key_pattern(tgt.slice)
+                    if pat is not None:
+                        keys.add(pat)
+    return keys
+
+
+def _key_pattern(node: ast.AST) -> Optional[str]:
+    lit = _const_str(node)
+    if lit is not None:
+        return lit
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant):
+                parts.append(str(v.value))
+            else:
+                parts.append('*')
+        return ''.join(parts)
+    return None
+
+
+# ------------------------------------------------------------ metric families
+@dataclass(frozen=True)
+class MetricReg:
+    path: str
+    line: int
+    kind: str                       # counter | gauge | histogram
+    name: str
+    labels: Tuple[str, ...]
+
+
+@dataclass(frozen=True)
+class MetricRef:
+    path: str
+    line: int
+    name: str
+    labels: Tuple[str, ...]
+
+
+def extract_metric_registrations(files: Sequence[SourceFile]
+                                 ) -> List[MetricReg]:
+    out: List[MetricReg] = []
+    for sf in files:
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in ('counter', 'gauge',
+                                           'histogram') \
+                    and node.args:
+                name = _const_str(node.args[0])
+                if name is None:
+                    continue
+                labels = tuple(sorted(
+                    kw.arg for kw in node.keywords
+                    if kw.arg is not None
+                    and kw.arg not in _NON_LABEL_KWARGS))
+                out.append(MetricReg(sf.relpath, node.lineno,
+                                     node.func.attr, name, labels))
+    return out
+
+
+def _suffix_helpers(files: Sequence[SourceFile]) -> Dict[str, Tuple[str,
+                                                                    Tuple]]:
+    """Defs that wrap ``_family_value(parsed, <param> + '<suffix>',
+    label=...)`` (live.py ``_q``): helper name -> (suffix, label names).
+    Calls to them with a literal family reference ``family+suffix``."""
+    out: Dict[str, Tuple[str, Tuple]] = {}
+    for sf in files:
+        for fn in _functions(sf.tree):
+            ret = next((n for n in ast.walk(fn)
+                        if isinstance(n, ast.Return)
+                        and isinstance(n.value, ast.Call)), None)
+            if ret is None:
+                continue
+            call = ret.value
+            if _terminal_name(call.func) not in ('_family_value',
+                                                 '_family_sum'):
+                continue
+            if len(call.args) < 2:
+                continue
+            arg = call.args[1]
+            if isinstance(arg, ast.BinOp) and isinstance(arg.op, ast.Add) \
+                    and isinstance(arg.left, ast.Name):
+                suffix = _const_str(arg.right)
+                if suffix is None:
+                    continue
+                labels = tuple(sorted(kw.arg for kw in call.keywords
+                                      if kw.arg is not None))
+                out[fn.name] = (suffix, labels)
+    return out
+
+
+def extract_metric_references(files: Sequence[SourceFile]
+                              ) -> List[MetricRef]:
+    helpers = _suffix_helpers(files)
+    out: List[MetricRef] = []
+    for sf in files:
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call):
+                fname = _terminal_name(node.func)
+                if fname in ('_family_value', '_family_sum') \
+                        and len(node.args) >= 2:
+                    name = _const_str(node.args[1])
+                    if name is not None:
+                        labels = tuple(sorted(
+                            kw.arg for kw in node.keywords
+                            if kw.arg is not None))
+                        out.append(MetricRef(sf.relpath, node.lineno,
+                                             name, labels))
+                elif fname == 'scrape_counter_sum' and len(node.args) >= 2:
+                    name = _const_str(node.args[1])
+                    if name is not None:
+                        labels = tuple(sorted(
+                            kw.arg for kw in node.keywords
+                            if kw.arg is not None
+                            and kw.arg != 'timeout_s'))
+                        out.append(MetricRef(sf.relpath, node.lineno,
+                                             name, labels))
+                elif fname in helpers and node.args:
+                    name = _const_str(node.args[0])
+                    if name is not None:
+                        suffix, labels = helpers[fname]
+                        out.append(MetricRef(sf.relpath, node.lineno,
+                                             name + suffix, labels))
+            # parsed['family'] / parsed.get('family') / 'family' in parsed
+            name = _parsed_key(node)
+            if name is not None:
+                out.append(MetricRef(sf.relpath, node.lineno, name, ()))
+    return out
+
+
+def _parsed_key(node: ast.AST) -> Optional[str]:
+    """Literal family lookups on a mapping conventionally named
+    ``parsed`` (parse_prometheus output)."""
+    if isinstance(node, ast.Subscript) and isinstance(node.ctx, ast.Load) \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id == 'parsed':
+        return _const_str(node.slice)
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+            and node.func.attr == 'get' \
+            and isinstance(node.func.value, ast.Name) \
+            and node.func.value.id == 'parsed' and node.args:
+        return _const_str(node.args[0])
+    if isinstance(node, ast.Compare) and len(node.ops) == 1 \
+            and isinstance(node.ops[0], ast.In) \
+            and isinstance(node.comparators[0], ast.Name) \
+            and node.comparators[0].id == 'parsed':
+        return _const_str(node.left)
+    return None
+
+
+_YAML_REF_RES = (
+    re.compile(r"parsed\[['\"]([A-Za-z0-9_]+)['\"]\]"),
+    re.compile(r"parsed\.get\(['\"]([A-Za-z0-9_]+)['\"]"),
+    re.compile(r"scrape_counter_sum\([^,\n]+,\s*['\"]([A-Za-z0-9_]+)"),
+)
+
+
+def extract_yaml_metric_references(root: str) -> List[MetricRef]:
+    """Family references inside CI yaml python heredocs (text regex —
+    the yaml is not importable Python)."""
+    import glob
+    import os
+    out: List[MetricRef] = []
+    for path in sorted(glob.glob(os.path.join(
+            root, '.github', 'workflows', '*.yml'))):
+        rel = os.path.relpath(path, root)
+        with open(path) as f:
+            for lineno, line in enumerate(f, start=1):
+                for rx in _YAML_REF_RES:
+                    for m in rx.finditer(line):
+                        out.append(MetricRef(rel, lineno, m.group(1), ()))
+    return out
+
+
+# --------------------------------------------------------------- wire headers
+@dataclass
+class HeaderUse:
+    path: str
+    line: int
+    header: str
+    mode: str                       # read | write | forward
+
+
+def extract_header_constants(files: Sequence[SourceFile]
+                             ) -> Dict[str, str]:
+    """serve/headers.py module-level ``NAME = 'X-...'`` constants."""
+    for sf in files:
+        if sf.relpath != HEADERS_MODULE:
+            continue
+        out: Dict[str, str] = {}
+        for node in sf.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                val = _const_str(node.value)
+                if val is not None and HEADER_RE.match(val):
+                    out[node.targets[0].id] = val
+        return out
+    return {}
+
+
+def extract_header_uses(files: Sequence[SourceFile],
+                        constants: Dict[str, str],
+                        count_raw: bool = False) -> List[HeaderUse]:
+    """Classified read/write/forward sites per header constant. With
+    ``count_raw`` (test trees), raw full-match X-* literals classify the
+    same way — a test asserting on the wire spelling is a reader."""
+    uses: List[HeaderUse] = []
+    for sf in files:
+        if sf.relpath == HEADERS_MODULE:
+            continue
+        parents: Dict[int, ast.AST] = {}
+        for node in ast.walk(sf.tree):
+            for kid in ast.iter_child_nodes(node):
+                parents[id(kid)] = node
+        for node in ast.walk(sf.tree):
+            header = None
+            if isinstance(node, ast.Name) and node.id in constants:
+                header = constants[node.id]
+            elif isinstance(node, ast.Attribute) \
+                    and node.attr in constants \
+                    and not isinstance(parents.get(id(node)),
+                                       ast.Attribute):
+                header = constants[node.attr]
+            elif count_raw and isinstance(node, ast.Constant) \
+                    and isinstance(node.value, str) \
+                    and HEADER_RE.match(node.value):
+                header = node.value
+            if header is None:
+                continue
+            mode = _classify_use(node, parents)
+            if mode is not None:
+                uses.append(HeaderUse(sf.relpath, node.lineno, header,
+                                      mode))
+    return uses
+
+
+def _classify_use(node: ast.AST, parents: Dict[int, ast.AST]
+                  ) -> Optional[str]:
+    parent = parents.get(id(node))
+    if isinstance(parent, ast.Dict) and any(k is node
+                                            for k in parent.keys):
+        return 'write'
+    if isinstance(parent, ast.Subscript) and parent.slice is node:
+        return 'write' if isinstance(parent.ctx, ast.Store) else 'read'
+    if isinstance(parent, ast.Call) and node in parent.args:
+        fname = _terminal_name(parent.func)
+        idx = parent.args.index(node)
+        if fname in ('get', 'pop', 'setdefault') and idx == 0:
+            return 'read'
+        if fname in ('send_header', 'putheader', 'add_header') \
+                and idx == 0:
+            return 'write'
+        return 'read'               # passed along: header name consumed
+    if isinstance(parent, ast.Compare):
+        return 'read'
+    if isinstance(parent, (ast.Tuple, ast.List)):
+        gp = parents.get(id(parent))
+        if isinstance(gp, ast.Assign):
+            return 'forward'        # _PASS_HEADERS-style copy tables
+        return 'read'
+    return None
+
+
+def extract_raw_header_literals(files: Sequence[SourceFile]
+                                ) -> List[Tuple[SourceFile, int, str]]:
+    """Full-match raw X-* string constants outside serve/headers.py —
+    each one is a lint finding unless suppressed."""
+    out: List[Tuple[SourceFile, int, str]] = []
+    for sf in files:
+        if sf.relpath == HEADERS_MODULE:
+            continue
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Constant) \
+                    and isinstance(node.value, str) \
+                    and HEADER_RE.match(node.value):
+                out.append((sf, node.lineno, node.value))
+    return out

@@ -75,18 +75,18 @@ from typing import Dict, List, Optional, Tuple, Union
 
 from ..obs import get_sink
 from ..obs.metrics import Histogram, MetricsRegistry, render_prometheus
-from ..obs.tracing import (TRACE_HEADER, new_trace_id, valid_trace_id)
-from ..serve.server import DEADLINE_HEADER, REPLICA_HEADER, VERSION_HEADER
-from ..stream.protocol import (MASK_AGE_HEADER, MIGRATED_HEADER,
-                               PROVENANCE_HEADER, SEQ_HEADER,
-                               SESSION_HEADER)
+from ..obs.tracing import new_trace_id, valid_trace_id
+from ..serve.headers import (DEADLINE_HEADER, MASK_AGE_HEADER,
+                             MASK_DTYPE_HEADER, MASK_SHAPE_HEADER,
+                             MIGRATED_HEADER, MODEL_HEADER,  # noqa: F401
+                             PROVENANCE_HEADER, REPLICA_HEADER,
+                             SEQ_HEADER, SESSION_HEADER, STATE_DRAINING,
+                             STATE_HEADER, TIMING_HEADER, TRACE_HEADER,
+                             VERSION_HEADER)
 from .manager import ReplicaGroup
 from .policy import LeastOutstanding, RoutingPolicy
 from .replica import ReplicaProcess
 from .split import Arm, TrafficSplit, affinity_pick
-
-#: request header selecting the model group (the path segment wins)
-MODEL_HEADER = 'X-Model'
 
 #: replica-mirroring statuses (reconcile 1:1 with replica scrapes).
 #: `client_error` is a replica-spoken 4xx (bad payload, no bucket fits —
@@ -110,7 +110,7 @@ _SHADOW_RESULTS = ('agree', 'disagree', 'error', 'skipped')
 _MAX_MIRRORS = 8
 
 #: response headers copied verbatim from the replica to the client
-_PASS_HEADERS = ('X-Serve-Timing', 'X-Mask-Shape', 'X-Mask-Dtype')
+_PASS_HEADERS = (TIMING_HEADER, MASK_SHAPE_HEADER, MASK_DTYPE_HEADER)
 
 #: ...plus the segstream frame headers (provenance/freshness/session)
 _STREAM_PASS_HEADERS = _PASS_HEADERS + (PROVENANCE_HEADER,
@@ -779,8 +779,8 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 continue
             finally:
                 srv.note_done(rid)
-            if code == 503 and headers.get('X-Replica-State') \
-                    == 'draining':
+            if code == 503 and headers.get(STATE_HEADER) \
+                    == STATE_DRAINING:
                 # lifecycle race, not backpressure: the replica was
                 # picked before its drain state propagated. It never
                 # admitted the request (no serve_requests_total entry),
@@ -888,8 +888,8 @@ class _RouterHandler(BaseHTTPRequestHandler):
                     continue
                 finally:
                     srv.note_done(rid)
-                if code == 503 and headers.get('X-Replica-State') \
-                        == 'draining':
+                if code == 503 and headers.get(STATE_HEADER) \
+                        == STATE_DRAINING:
                     tried = tried + (rid,)
                     continue
                 if code == 200:
@@ -978,8 +978,8 @@ class _RouterHandler(BaseHTTPRequestHandler):
                     continue
                 finally:
                     srv.note_done(rid)
-                if code == 503 and headers.get('X-Replica-State') \
-                        == 'draining':
+                if code == 503 and headers.get(STATE_HEADER) \
+                        == STATE_DRAINING:
                     tried = tried + (rid,)
                     continue
                 if rid != bound:

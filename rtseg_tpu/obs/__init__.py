@@ -22,7 +22,7 @@ from .watchdog import StallWatchdog, dump_all_stacks
 from .report import (diff_table, format_summary, load_events, summarize)
 from .metrics import (MetricsRegistry, get_registry, render_prometheus,
                       set_registry)
-from .tracing import (TRACE_HEADER, TRACE_KEY, ensure_trace, new_trace_id,
+from .tracing import (TRACE_KEY, ensure_trace, new_trace_id,
                       valid_trace_id)
 from .profile import (CaptureBusy, DeviceProfile, SampledProfiler,
                       capture_window, parse_trace)
@@ -33,7 +33,7 @@ __all__ = [
     'StepCollector', 'StallWatchdog', 'dump_all_stacks',
     'diff_table', 'format_summary', 'load_events', 'summarize',
     'MetricsRegistry', 'get_registry', 'set_registry', 'render_prometheus',
-    'TRACE_HEADER', 'TRACE_KEY', 'ensure_trace', 'new_trace_id',
+    'TRACE_KEY', 'ensure_trace', 'new_trace_id',
     'valid_trace_id',
     'CaptureBusy', 'DeviceProfile', 'SampledProfiler', 'capture_window',
     'parse_trace',

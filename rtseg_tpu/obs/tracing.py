@@ -27,11 +27,11 @@ import itertools
 import os
 from typing import Any, Dict, Optional
 
-#: meta / event / header-JSON key a trace id travels under
+#: meta / event / header-JSON key a trace id travels under. The HTTP
+#: header spelling (X-Trace-Id) lives with the other wire headers in
+#: serve/headers.py — obs stays import-light (no serve dependency), and
+#: the segcontract lint keeps all X-* literals in that one module.
 TRACE_KEY = 'trace_id'
-
-#: HTTP header carrying the trace id in both directions
-TRACE_HEADER = 'X-Trace-Id'
 
 _PREFIX = os.urandom(4).hex()
 _SEQ = itertools.count(1)

@@ -204,7 +204,11 @@ def replay_golden_http(url: str, bundle_dir: str,
         try:
             with urllib.request.urlopen(req, timeout=timeout_s) as resp:
                 body = resp.read()
-                shape = resp.headers.get('X-Mask-Shape', '')
+                # spelled raw, not via serve.headers.MASK_SHAPE_HEADER:
+                # registry verify/replay must import on jax-less bakers
+                # and the serve package pulls jax at import time
+                shape = resp.headers.get(
+                    'X-Mask-Shape', '')  # segcheck: disable=contracts
         except Exception as e:   # noqa: BLE001 — reported, gated on
             mismatches.append(f'pair {i}: {type(e).__name__}: {e}')
             continue

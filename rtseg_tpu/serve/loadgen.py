@@ -29,11 +29,12 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
-from ..obs.tracing import TRACE_HEADER, TRACE_KEY, new_trace_id
+from ..obs.tracing import TRACE_KEY, new_trace_id
 from .batcher import ServeDrop, ServeReject
 from .engine import Bucket, ServeEngine, assemble_batch, select_bucket
+from .headers import (MASK_SHAPE_HEADER, REPLICA_HEADER, TIMING_HEADER,
+                      TRACE_HEADER, VERSION_HEADER)
 from .pipeline import ServePipeline
-from .server import REPLICA_HEADER, VERSION_HEADER
 
 _STAGES = ('queue_ms', 'assemble_ms', 'device_ms', 'post_ms', 'decode_ms')
 
@@ -173,7 +174,7 @@ def bench_http(url, payloads: Sequence[bytes], requests: int,
             with urlreq.urlopen(req, timeout=timeout_s) as resp:
                 resp.read()
                 timing = json.loads(
-                    resp.headers.get('X-Serve-Timing') or '{}')
+                    resp.headers.get(TIMING_HEADER) or '{}')
                 # e2e is anchored at the SCHEDULED arrival, not worker
                 # pickup: time spent queued in the client's own thread
                 # pool is part of what the user would have waited
@@ -293,10 +294,10 @@ def bench_video(url: str, payloads: Sequence[Sequence[bytes]],
     ok raw mask lands under ``(session_index, seq)`` — the quality pass
     feeds them to rtseg_tpu/stream/quality.py."""
     from urllib import error, request as urlreq
-    from ..stream.protocol import (MASK_AGE_HEADER, MIGRATED_HEADER,
-                                   PROVENANCE_HEADER, PROV_KEYFRAME,
-                                   SEQ_HEADER, SESSION_HEADER)
-    from .server import DEADLINE_HEADER
+    from ..stream.protocol import PROV_KEYFRAME
+    from .headers import (DEADLINE_HEADER, MASK_AGE_HEADER,
+                          MIGRATED_HEADER, PROVENANCE_HEADER, SEQ_HEADER,
+                          SESSION_HEADER)
 
     sessions = len(payloads)
     frames = len(payloads[0]) if sessions else 0
@@ -349,7 +350,7 @@ def bench_video(url: str, payloads: Sequence[Sequence[bytes]],
             except ValueError:
                 out['mask_age'] = 0
             if mask_store is not None and 'raw=1' in query:
-                shape = hdrs.get('X-Mask-Shape')
+                shape = hdrs.get(MASK_SHAPE_HEADER)
                 if shape:
                     h, w = (int(x) for x in shape.split(','))
                     mask_store[(s, i)] = np.frombuffer(

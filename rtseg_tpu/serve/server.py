@@ -70,25 +70,16 @@ from ..obs import get_sink
 from ..obs.core import update_memory_gauges
 from ..obs.metrics import render_prometheus
 from ..obs.profile import CaptureBusy, capture_window
-from ..obs.tracing import (TRACE_HEADER, TRACE_KEY, new_trace_id,
-                           valid_trace_id)
+from ..obs.tracing import TRACE_KEY, new_trace_id, valid_trace_id
 from .batcher import ServeDrop, ServeReject
 from .engine import UnknownBucket
 from .pipeline import ServePipeline
-
-#: response header attributing a response to the replica that served it
-REPLICA_HEADER = 'X-Replica-Id'
-
-#: request header carrying the caller's remaining latency budget in ms;
-#: becomes the request's queue deadline (504 when it expires in queue)
-DEADLINE_HEADER = 'X-Deadline-Ms'
-
-#: response header naming the artifact version that produced the answer
-#: (segship: a replica serving a registry bundle stamps the bundle's
-#: content-hash version; the fleet router forwards it — or stamps the
-#: routed arm's version — so load-gen and clients can attribute every
-#: response to a model version during canary/shadow rollouts)
-VERSION_HEADER = 'X-Artifact-Version'
+# canonical X-* spellings live in serve/headers.py (segcontract);
+# re-exported here because this module defined them for 12 PRs
+from .headers import (DEADLINE_HEADER, MASK_DTYPE_HEADER,   # noqa: F401
+                      MASK_SHAPE_HEADER, REPLICA_HEADER, STATE_DRAINING,
+                      STATE_HEADER, TIMING_HEADER, TRACE_HEADER,
+                      VERSION_HEADER)
 
 
 class ServeHTTPServer(ThreadingHTTPServer):
@@ -277,7 +268,7 @@ class _Handler(BaseHTTPRequestHandler):
             if not self.server.try_admit():
                 self._send_json(503, {'error': 'replica draining'},
                                 {**trace_hdr,
-                                 'X-Replica-State': 'draining'})
+                                 STATE_HEADER: STATE_DRAINING})
                 return
             try:
                 self.server.stream.handle_post(self, path, data, tid,
@@ -320,7 +311,7 @@ class _Handler(BaseHTTPRequestHandler):
             # batcher's queue-full 503 (backpressure: must surface)
             self._send_json(503, {'error': 'replica draining'},
                             {**trace_hdr,
-                             'X-Replica-State': 'draining'})
+                             STATE_HEADER: STATE_DRAINING})
             return
         try:
             self._predict(data, deadline_ms, tid, trace_hdr)
@@ -361,8 +352,9 @@ class _Handler(BaseHTTPRequestHandler):
             h, w = res.mask.shape
             self._send(200, np.ascontiguousarray(res.mask).tobytes(),
                        'application/octet-stream',
-                       {'X-Mask-Shape': f'{h},{w}', 'X-Mask-Dtype': 'int8',
-                        'X-Serve-Timing': timing, **trace_hdr})
+                       {MASK_SHAPE_HEADER: f'{h},{w}',
+                        MASK_DTYPE_HEADER: 'int8',
+                        TIMING_HEADER: timing, **trace_hdr})
             return
         cmap = self.server.colormap
         if cmap is None:
@@ -373,7 +365,7 @@ class _Handler(BaseHTTPRequestHandler):
         buf = io.BytesIO()
         Image.fromarray(cmap[res.mask]).save(buf, format='PNG')
         self._send(200, buf.getvalue(), 'image/png',
-                   {'X-Serve-Timing': timing, **trace_hdr})
+                   {TIMING_HEADER: timing, **trace_hdr})
 
     def _debug_profile(self, trace_hdr: dict) -> None:
         """segprof on-demand capture under live traffic (obs/profile.py

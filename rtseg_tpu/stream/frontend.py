@@ -44,10 +44,12 @@ from ..obs import get_sink
 from ..obs.tracing import TRACE_KEY, new_trace_id, valid_trace_id
 from ..serve.batcher import ServeDrop, ServeReject
 from ..serve.engine import UnknownBucket, select_bucket
+from ..serve.headers import (DEADLINE_HEADER, MASK_AGE_HEADER,
+                             MASK_DTYPE_HEADER, MASK_SHAPE_HEADER,
+                             MIGRATED_HEADER, PROVENANCE_HEADER,
+                             SEQ_HEADER, SESSION_HEADER, TIMING_HEADER)
 from .protocol import (FRAME_DROPPED_LATE, FRAME_ERROR, FRAME_OK,
-                       FRAME_STALE, MASK_AGE_HEADER, MIGRATED_HEADER,
-                       PROVENANCE_HEADER, PROV_KEYFRAME, SEQ_HEADER,
-                       SESSION_HEADER)
+                       FRAME_STALE, PROV_KEYFRAME)
 from .session import (SessionClosed, SessionExists, SessionLimit,
                       SessionTable, StreamConfig)
 
@@ -334,7 +336,6 @@ class StreamFrontend:
                         last_thumb, thumb, data, t0, base_hdr, migrated)
 
     def _deadline_ms(self, handler, sess) -> Optional[float]:
-        from ..serve.server import DEADLINE_HEADER
         raw = handler.headers.get(DEADLINE_HEADER)
         if raw is not None:
             try:
@@ -470,7 +471,7 @@ class StreamFrontend:
                              **{k: round(v, 3)
                                 for k, v in (timings or {}).items()}})
         extra = {**base_hdr, PROVENANCE_HEADER: decision.provenance,
-                 MASK_AGE_HEADER: str(age), 'X-Serve-Timing': timing}
+                 MASK_AGE_HEADER: str(age), TIMING_HEADER: timing}
         if migrated:
             extra[MIGRATED_HEADER] = '1'
         import urllib.parse
@@ -480,8 +481,8 @@ class StreamFrontend:
             h, w = mask.shape
             handler._send(200, np.ascontiguousarray(mask).tobytes(),
                           'application/octet-stream',
-                          {'X-Mask-Shape': f'{h},{w}',
-                           'X-Mask-Dtype': 'int8', **extra})
+                          {MASK_SHAPE_HEADER: f'{h},{w}',
+                           MASK_DTYPE_HEADER: 'int8', **extra})
             return
         cmap = handler.server.colormap
         if cmap is None:

@@ -1,9 +1,11 @@
 """segstream wire protocol: header names and shared constants.
 
-Kept in its own stdlib-only module so the fleet router (which speaks the
+Kept in its own light module so the fleet router (which speaks the
 protocol but holds no session state beyond the affinity binding) can
 import the names without pulling the numpy-backed session/frontend
-machinery.
+machinery. The ``X-*`` spellings themselves live with every other wire
+header in serve/headers.py (segcontract); this module re-exports the
+streaming ones next to the frame-outcome and provenance vocabularies.
 
 Protocol summary (full prose in README "Streaming video"):
 
@@ -28,22 +30,9 @@ freshness signal). A router that re-homed the session mid-stream stamps
 
 from __future__ import annotations
 
-#: request+response header carrying the session id (16 hex chars, same
-#: alphabet/validation as trace ids — obs/tracing.valid_trace_id)
-SESSION_HEADER = 'X-Session-Id'
-
-#: request header: this frame's position in the session's stream
-SEQ_HEADER = 'X-Frame-Seq'
-
-#: response header: which path produced this mask
-PROVENANCE_HEADER = 'X-Frame-Provenance'
-
-#: response header: frames since the mask's source keyframe (0 = fresh)
-MASK_AGE_HEADER = 'X-Mask-Age'
-
-#: router->replica hint + router->client echo: the session was re-homed
-#: (bound replica drained/died); the new replica forces a keyframe
-MIGRATED_HEADER = 'X-Session-Migrated'
+from ..serve.headers import (MASK_AGE_HEADER, MIGRATED_HEADER,  # noqa: F401
+                             PROVENANCE_HEADER, SEQ_HEADER,
+                             SESSION_HEADER)
 
 #: frame outcome vocabulary — shared by replica counters, router
 #: counters, the loadgen video report and segscope's session section

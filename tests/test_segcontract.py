@@ -1,0 +1,552 @@
+"""segcontract (analysis/contracts.py + schema_extract.py): the static
+cross-plane contract auditor must be green on the real tree, the
+committed SEGCONTRACT.json must reconcile exactly with the observed
+contract in both directions, every pass must catch its seeded violation
+(a lint that cannot fail its negative test is decoration, not
+enforcement), --update-contracts must refuse to pin an incoherent
+contract, and the suppression budget may only go down.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from rtseg_tpu.analysis import check_contracts, update_contracts
+from rtseg_tpu.analysis.contracts import (SEGCONTRACT_FILE, Observed,
+                                          load_sidecar, suppression_count)
+from rtseg_tpu.analysis.core import (ALL_RULES, RULE_CONTRACTS, load_tree,
+                                     repo_root)
+from rtseg_tpu.analysis import schema_extract as sx
+
+REPO = repo_root()
+
+
+def _write(root, relpath, text):
+    path = os.path.join(str(root), relpath)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, 'w') as f:
+        f.write(textwrap.dedent(text))
+
+
+def _msgs(findings):
+    return '\n'.join(str(f) for f in findings)
+
+
+@pytest.fixture(scope='module')
+def real_obs():
+    return Observed(REPO, load_tree(REPO))
+
+
+# ---------------------------------------------------------- positive gates
+def test_real_tree_contracts_clean():
+    """The committed tree passes the contracts rule — the CI gate. Every
+    true finding was fixed or carries a justified suppression."""
+    fs = check_contracts(REPO)
+    assert fs == [], _msgs(fs)
+
+
+def test_rule_registered():
+    assert RULE_CONTRACTS in ALL_RULES
+
+
+def test_real_tree_matches_sidecar_exactly(real_obs):
+    """The committed SEGCONTRACT.json is exactly the observed contract,
+    both directions on all three surfaces: every observed event type /
+    metric family / header is pinned (the clean gate proves drift fails)
+    AND nothing pinned has quietly left the tree."""
+    sidecar = load_sidecar(REPO)
+    assert sidecar is not None, f'{SEGCONTRACT_FILE} must be committed'
+    observed = real_obs.to_sidecar()     # raises if incoherent
+    for surface in ('events', 'metrics', 'headers'):
+        assert observed[surface] == sidecar[surface], surface
+
+
+def test_real_tree_event_schemas_grounded(real_obs):
+    """Spot-checks pinning the extractor's dataflow against known emit
+    shapes: wrapper resolution (StreamFrontend._emit's replica
+    setdefault), helper resolution (DeviceProfile.to_event), conditional
+    keys as optional, **spread as open."""
+    ev = real_obs.events
+    assert {'session', 'seq', 'status'} <= set(ev['frame']['required'])
+    assert 'replica' in ev['session']['optional']      # wrapper setdefault
+    assert ev['compile']['open']                       # ev.update(**attrs)
+    assert 'busy_frac' in ev['profile']['required']    # via to_event()
+    assert 'trace_id' in ev['request']['optional']     # conditional store
+    assert not ev['frame']['open']
+
+
+def test_real_tree_consumers_grounded(real_obs):
+    """report.py/live.py key reads resolve to typed events — the
+    consumption side of the gate is live, not vacuously empty."""
+    consumed = {(c.event, c.key) for c in real_obs.consumed}
+    assert ('step', 'dur_s') in consumed
+    assert ('frame', 'provenance') in consumed
+    assert ('rollout', 'reason') in consumed
+    assert ('request', 'queue_ms') in consumed     # loop-over-keys idiom
+    assert ('span', 'dur_s') in consumed           # continue-guard idiom
+    assert len(consumed) > 40
+
+
+def test_no_raw_header_literals_outside_headers_module(real_obs):
+    """Zero raw X-* string literals in the runtime tree outside
+    serve/headers.py — except the one justified, suppressed site
+    (registry/bundle.py: verify/replay must import on jax-less bakers and
+    serve pulls jax at import time)."""
+    raws = [(sf.relpath, line) for sf, line, _ in real_obs.raw_literals]
+    assert raws == [('rtseg_tpu/registry/bundle.py', 211)], raws
+
+
+def test_suppression_budget_only_goes_down():
+    """One justified `# segcheck: disable=contracts` in the tree (the
+    bundle.py raw header literal). Fixing a site lowers this number;
+    never raise it without a justification comment on the line."""
+    assert suppression_count(REPO) == 1
+
+
+def test_sidecar_pins_core_surfaces():
+    sidecar = load_sidecar(REPO)
+    assert 'status' in sidecar['events']['request']['required']
+    assert sidecar['metrics']['serve_requests_total'] == {
+        'kind': 'counter', 'labels': ['status']}
+    assert sidecar['metrics']['serve_request_e2e_ms']['kind'] == 'histogram'
+    tr = sidecar['headers']['X-Trace-Id']
+    assert tr['constant'] == 'TRACE_HEADER'
+    assert tr['writers'] and tr['readers']
+
+
+# ------------------------------------------------- pass 1: event seeds
+_PRODUCER = '''
+    def ship(sink):
+        sink.emit({'event': 'thing', 'a': 1})
+    '''
+
+_CONSUMER_OK = '''
+    def scan(events):
+        rows = [e for e in events if e.get('event') == 'thing']
+        total = 0
+        for e in rows:
+            total += e.get('a', 0)
+        return total
+    '''
+
+_CONSUMER_PHANTOM = '''
+    def scan(events):
+        rows = [e for e in events if e.get('event') == 'thing']
+        total = 0
+        for e in rows:
+            total += e.get('b', 0)
+        return total
+    '''
+
+
+def test_phantom_consumed_key_flagged(tmp_path):
+    _write(tmp_path, 'rtseg_tpu/serve/seed.py', _PRODUCER)
+    _write(tmp_path, 'rtseg_tpu/obs/report.py', _CONSUMER_PHANTOM)
+    fs = check_contracts(str(tmp_path))
+    hits = [f for f in fs if "consumes key 'b'" in f.message]
+    assert len(hits) == 1, _msgs(fs)
+    assert hits[0].path == 'rtseg_tpu/obs/report.py'
+
+
+def test_consumed_key_with_producer_clean(tmp_path):
+    """The clean twin: same consumer shape, key actually emitted."""
+    _write(tmp_path, 'rtseg_tpu/serve/seed.py', _PRODUCER)
+    _write(tmp_path, 'rtseg_tpu/obs/report.py', _CONSUMER_OK)
+    update_contracts(str(tmp_path))
+    assert check_contracts(str(tmp_path)) == []
+
+
+def test_consumed_unknown_event_type_flagged(tmp_path):
+    _write(tmp_path, 'rtseg_tpu/serve/seed.py', _PRODUCER)
+    _write(tmp_path, 'rtseg_tpu/obs/report.py', '''
+        def scan(events):
+            rows = [e for e in events if e.get('event') == 'ghost']
+            return [e.get('a') for e in rows]
+        ''')
+    fs = check_contracts(str(tmp_path))
+    assert any("event type 'ghost' that no emit site" in f.message
+               for f in fs), _msgs(fs)
+
+
+def test_open_event_accepts_extra_keys(tmp_path):
+    """An emit site that folds **kwargs in is open: consumers may read
+    keys the auditor cannot enumerate."""
+    _write(tmp_path, 'rtseg_tpu/serve/seed.py', '''
+        def ship(sink, **attrs):
+            ev = {'event': 'thing', 'a': 1}
+            ev.update(attrs)
+            sink.emit(ev)
+        ''')
+    _write(tmp_path, 'rtseg_tpu/obs/report.py', _CONSUMER_PHANTOM)
+    update_contracts(str(tmp_path))
+    assert check_contracts(str(tmp_path)) == []
+
+
+def test_unresolvable_event_type_flagged(tmp_path):
+    _write(tmp_path, 'rtseg_tpu/serve/seed.py', '''
+        def ship(sink, payload):
+            sink.emit(payload)
+        ''')
+    fs = check_contracts(str(tmp_path))
+    assert any("no statically resolvable 'event' key" in f.message
+               for f in fs), _msgs(fs)
+
+
+def test_diff_row_without_summary_key_flagged(tmp_path):
+    _write(tmp_path, 'rtseg_tpu/obs/report.py', '''
+        _DIFF_ROWS = (
+            ('imgs_per_sec', 'imgs/s', '{:.1f}'),
+            ('ghost_metric', 'ghost', '{:.1f}'),
+        )
+
+        def summarize(events):
+            return {'imgs_per_sec': 1.0}
+        ''')
+    fs = check_contracts(str(tmp_path))
+    hits = [f for f in fs if "diff row 'ghost_metric'" in f.message]
+    assert len(hits) == 1, _msgs(fs)
+
+
+# ------------------------------------------------- pass 2: metric seeds
+def test_metric_kind_clash_flagged(tmp_path):
+    _write(tmp_path, 'rtseg_tpu/serve/seed.py', '''
+        def setup(reg):
+            reg.counter('widget_total', help='x', group='g')
+        ''')
+    _write(tmp_path, 'rtseg_tpu/obs/seed2.py', '''
+        def setup2(reg):
+            reg.histogram('widget_total', help='x', group='g')
+        ''')
+    fs = check_contracts(str(tmp_path))
+    hits = [f for f in fs if 'one family, one shape' in f.message]
+    assert len(hits) == 1, _msgs(fs)
+
+
+def test_unregistered_metric_reference_flagged(tmp_path):
+    _write(tmp_path, 'rtseg_tpu/serve/seed.py', '''
+        def setup(reg):
+            reg.counter('widget_total', help='x')
+
+        def peek(parsed):
+            return parsed['widget_totalz']
+        ''')
+    fs = check_contracts(str(tmp_path))
+    hits = [f for f in fs if "'widget_totalz' that is never registered"
+            in f.message]
+    assert len(hits) == 1, _msgs(fs)
+
+
+def test_metric_label_drift_flagged(tmp_path):
+    _write(tmp_path, 'rtseg_tpu/obs/live.py', '''
+        def _family_value(parsed, name, **want):
+            return 0.0
+
+        def setup(reg):
+            reg.counter('widget_total', help='x', group='g')
+
+        def peek(parsed):
+            return _family_value(parsed, 'widget_total', flavor='f')
+        ''')
+    fs = check_contracts(str(tmp_path))
+    hits = [f for f in fs if "label(s) ['flavor']" in f.message]
+    assert len(hits) == 1, _msgs(fs)
+
+
+def test_registered_and_referenced_metric_clean(tmp_path):
+    _write(tmp_path, 'rtseg_tpu/obs/live.py', '''
+        def _family_value(parsed, name, **want):
+            return 0.0
+
+        def setup(reg):
+            reg.histogram('widget_ms', help='x', group='g')
+
+        def peek(parsed):
+            return _family_value(parsed, 'widget_ms_count', group='g')
+        ''')
+    update_contracts(str(tmp_path))
+    assert check_contracts(str(tmp_path)) == []
+
+
+def test_derived_suffix_on_counter_flagged(tmp_path):
+    """_count/_window series only exist for histograms; deriving them
+    from a counter is a typo the scrape would silently miss."""
+    _write(tmp_path, 'rtseg_tpu/obs/live.py', '''
+        def setup(reg):
+            reg.counter('widget_total', help='x')
+
+        def peek(parsed):
+            return parsed.get('widget_total_count')
+        ''')
+    fs = check_contracts(str(tmp_path))
+    assert any('not a histogram' in f.message for f in fs), _msgs(fs)
+
+
+# ------------------------------------------------- pass 3: header seeds
+_HEADERS_MOD = '''
+    FOO_HEADER = 'X-Foo'
+    '''
+
+
+def test_unread_header_flagged(tmp_path):
+    _write(tmp_path, 'rtseg_tpu/serve/headers.py', _HEADERS_MOD)
+    _write(tmp_path, 'rtseg_tpu/serve/seed.py', '''
+        from .headers import FOO_HEADER
+
+        def respond(body):
+            return 200, {FOO_HEADER: 'yes'}, body
+        ''')
+    fs = check_contracts(str(tmp_path))
+    hits = [f for f in fs if 'but never read' in f.message]
+    assert len(hits) == 1, _msgs(fs)
+    assert hits[0].path == 'rtseg_tpu/serve/headers.py'
+
+
+def test_unwritten_header_flagged(tmp_path):
+    _write(tmp_path, 'rtseg_tpu/serve/headers.py', _HEADERS_MOD)
+    _write(tmp_path, 'rtseg_tpu/serve/seed.py', '''
+        from .headers import FOO_HEADER
+
+        def accept(headers):
+            return headers.get(FOO_HEADER)
+        ''')
+    fs = check_contracts(str(tmp_path))
+    assert any('but never written' in f.message for f in fs), _msgs(fs)
+
+
+def test_unused_header_constant_flagged(tmp_path):
+    _write(tmp_path, 'rtseg_tpu/serve/headers.py', _HEADERS_MOD)
+    fs = check_contracts(str(tmp_path))
+    assert any('is never used' in f.message for f in fs), _msgs(fs)
+
+
+def test_written_and_read_header_clean(tmp_path):
+    _write(tmp_path, 'rtseg_tpu/serve/headers.py', _HEADERS_MOD)
+    _write(tmp_path, 'rtseg_tpu/serve/seed.py', '''
+        from .headers import FOO_HEADER
+
+        def respond(body):
+            return 200, {FOO_HEADER: 'yes'}, body
+
+        def accept(headers):
+            return headers.get(FOO_HEADER)
+        ''')
+    update_contracts(str(tmp_path))
+    assert check_contracts(str(tmp_path)) == []
+
+
+def test_raw_header_literal_flagged_and_suppressible(tmp_path):
+    _write(tmp_path, 'rtseg_tpu/serve/headers.py', _HEADERS_MOD)
+    _write(tmp_path, 'rtseg_tpu/serve/seed.py', '''
+        from .headers import FOO_HEADER
+
+        def respond(body):
+            return 200, {FOO_HEADER: 'yes', 'X-Sneaky': '1'}, body
+
+        def accept(headers):
+            return headers.get(FOO_HEADER)
+        ''')
+    fs = check_contracts(str(tmp_path))
+    hits = [f for f in fs if "raw wire-header literal 'X-Sneaky'"
+            in f.message]
+    assert len(hits) == 1, _msgs(fs)
+    # suppressed twin: the literal line carries a justified disable
+    _write(tmp_path, 'rtseg_tpu/serve/seed.py', '''
+        from .headers import FOO_HEADER
+
+        def respond(body):
+            hdrs = {FOO_HEADER: 'yes',
+                    'X-Sneaky': '1'}  # segcheck: disable=contracts
+            return 200, hdrs, body
+
+        def accept(headers):
+            return headers.get(FOO_HEADER)
+        ''')
+    fs = check_contracts(str(tmp_path))
+    assert not any('X-Sneaky' in f.message for f in fs), _msgs(fs)
+
+
+def test_help_text_fragments_not_flagged(tmp_path):
+    """Implicit string concatenation folds at parse time, so a prose
+    mention like 'X-Foo (per-replica attribution)' never full-matches
+    the header literal pattern."""
+    _write(tmp_path, 'rtseg_tpu/serve/seed.py', '''
+        HELP = 'set X-Foo on every request'
+        MORE = ('X-Foo'
+                ' (per-replica attribution)')
+        ''')
+    fs = check_contracts(str(tmp_path))
+    assert not any('raw wire-header' in f.message for f in fs), _msgs(fs)
+
+
+# ------------------------------------------ pass 4: the sidecar lifecycle
+def test_missing_sidecar_then_repin_then_drift(tmp_path):
+    """The full SEGCONTRACT.json lifecycle: a contract with no sidecar
+    fails; --update-contracts pins it and the gate goes green; a NEW
+    event key fails against the committed schema until re-pinned; a
+    pinned surface leaving the tree also fails."""
+    _write(tmp_path, 'rtseg_tpu/serve/seed.py', _PRODUCER)
+    fs = check_contracts(str(tmp_path))
+    assert any(SEGCONTRACT_FILE in f.message and 'missing' in f.message
+               for f in fs), _msgs(fs)
+    data = update_contracts(str(tmp_path))
+    assert data['events']['thing']['required'] == ['a', 'event']
+    assert check_contracts(str(tmp_path)) == []
+    # drift: the producer grows a key the committed schema doesn't pin
+    _write(tmp_path, 'rtseg_tpu/serve/seed.py', '''
+        def ship(sink):
+            sink.emit({'event': 'thing', 'a': 1, 'z': 2})
+        ''')
+    fs = check_contracts(str(tmp_path))
+    drift = [f for f in fs if "'thing' drifted" in f.message]
+    assert len(drift) == 1, _msgs(fs)
+    assert drift[0].path == 'rtseg_tpu/serve/seed.py'
+    update_contracts(str(tmp_path))
+    assert check_contracts(str(tmp_path)) == []
+    # removal: the pinned type vanishes from the tree
+    os.remove(os.path.join(str(tmp_path), 'rtseg_tpu/serve/seed.py'))
+    fs = check_contracts(str(tmp_path))
+    assert any('pinned in SEGCONTRACT.json but gone' in f.message
+               for f in fs), _msgs(fs)
+
+
+def test_update_contracts_refuses_orphan_consumer(tmp_path):
+    """Re-pinning must not grandfather an incoherent contract: a
+    consumed key nobody emits refuses the pin, and nothing is written."""
+    _write(tmp_path, 'rtseg_tpu/serve/seed.py', _PRODUCER)
+    _write(tmp_path, 'rtseg_tpu/obs/report.py', _CONSUMER_PHANTOM)
+    with pytest.raises(ValueError, match='refusing to pin'):
+        update_contracts(str(tmp_path))
+    assert not os.path.exists(os.path.join(str(tmp_path),
+                                           SEGCONTRACT_FILE))
+
+
+def test_update_contracts_refuses_raw_literal(tmp_path):
+    _write(tmp_path, 'rtseg_tpu/serve/seed.py', '''
+        def respond(body):
+            return 200, {'X-Sneaky': '1'}, body
+        ''')
+    with pytest.raises(ValueError, match='refusing to pin'):
+        update_contracts(str(tmp_path))
+
+
+# ----------------------------------------------------------------- CLI e2e
+def test_cli_contracts_rule_green():
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, 'tools', 'segcheck.py'),
+         '--lint-only', '--rules', 'contracts'],
+        capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert '0 finding(s)' in r.stdout
+
+
+def test_cli_update_contracts(tmp_path):
+    _write(tmp_path, 'rtseg_tpu/serve/seed.py', _PRODUCER)
+    args = [sys.executable, os.path.join(REPO, 'tools', 'segcheck.py'),
+            '--root', str(tmp_path), '--lint-only',
+            '--rules', 'contracts']
+    r = subprocess.run(args, capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 1        # contract with no sidecar: gate fails
+    r = subprocess.run(args + ['--update-contracts'],
+                       capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert 're-pinned' in r.stdout
+    with open(os.path.join(str(tmp_path), SEGCONTRACT_FILE)) as f:
+        data = json.load(f)
+    assert 'thing' in data['events']
+    r = subprocess.run(args, capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+# ------------------------------------------------- extractor unit checks
+def test_wrapper_producer_resolution(tmp_path):
+    """A thin self._emit wrapper attributes schemas to its call sites,
+    and the wrapper's own conditional setdefault rides as optional."""
+    _write(tmp_path, 'rtseg_tpu/serve/seed.py', '''
+        class Front:
+            def _emit(self, event):
+                if self.replica_id is not None:
+                    event.setdefault('replica', self.replica_id)
+                sink = self.sink
+                sink.emit(event)
+
+            def open(self, sid):
+                self._emit({'event': 'thing', 'session': sid})
+        ''')
+    files = load_tree(str(tmp_path))
+    schemas = sx.merge_event_schemas(sx.extract_event_producers(files))
+    assert schemas['thing']['required'] == ['event', 'session']
+    assert 'replica' in schemas['thing']['optional']
+
+
+def test_helper_producer_resolution(tmp_path):
+    """sink.emit(obj.to_event(...)) resolves through the helper's return
+    dict, with call-site kwargs folded in as required keys."""
+    _write(tmp_path, 'rtseg_tpu/serve/seed.py', '''
+        class Prof:
+            def to_event(self, **extra):
+                ev = {'event': 'thing', 'base': 1}
+                ev.update(extra)
+                return ev
+
+        def ship(sink, prof):
+            ev = prof.to_event(source='debug')
+            sink.emit(ev)
+        ''')
+    files = load_tree(str(tmp_path))
+    schemas = sx.merge_event_schemas(sx.extract_event_producers(files))
+    assert schemas['thing']['required'] == ['base', 'event', 'source']
+    assert not schemas['thing']['open']
+
+
+def test_conditional_key_is_optional(tmp_path):
+    _write(tmp_path, 'rtseg_tpu/serve/seed.py', '''
+        def ship(sink, extra):
+            ev = {'event': 'thing', 'a': 1}
+            if extra is not None:
+                ev['b'] = extra
+            sink.emit(ev)
+        ''')
+    files = load_tree(str(tmp_path))
+    schemas = sx.merge_event_schemas(sx.extract_event_producers(files))
+    assert schemas['thing']['required'] == ['a', 'event']
+    assert 'b' in schemas['thing']['optional']
+
+
+def test_multi_site_merge_required_is_intersection(tmp_path):
+    _write(tmp_path, 'rtseg_tpu/serve/seed.py', '''
+        def ship_a(sink):
+            sink.emit({'event': 'thing', 'a': 1, 'b': 2})
+
+        def ship_b(sink):
+            sink.emit({'event': 'thing', 'a': 1, 'c': 3})
+        ''')
+    files = load_tree(str(tmp_path))
+    schemas = sx.merge_event_schemas(sx.extract_event_producers(files))
+    assert schemas['thing']['required'] == ['a', 'event']
+    assert {'b', 'c'} <= set(schemas['thing']['optional'])
+
+
+def test_branch_selector_consumer_tagging(tmp_path):
+    """The live.py idiom: kind = e.get('event') then an if/elif chain —
+    reads in each branch attribute to that branch's type."""
+    _write(tmp_path, 'rtseg_tpu/obs/live.py', '''
+        def tail(events):
+            a = b = 0
+            for e in events:
+                kind = e.get('event')
+                if kind == 'alpha':
+                    a += e.get('x', 0)
+                elif kind == 'beta':
+                    b += e.get('y', 0)
+            return a, b
+        ''')
+    files = load_tree(str(tmp_path))
+    consumed = {(c.event, c.key)
+                for c in sx.extract_event_consumers(files)}
+    assert ('alpha', 'x') in consumed
+    assert ('beta', 'y') in consumed
+    assert ('alpha', 'y') not in consumed

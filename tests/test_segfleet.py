@@ -32,7 +32,8 @@ from rtseg_tpu.fleet import (Autoscaler, AutoscalePolicy, FleetManager,
                              ReplicaProcess, RoundRobin, decide,
                              get_policy, make_router, serving_signals)
 from rtseg_tpu.obs.live import parse_prometheus
-from rtseg_tpu.obs.tracing import TRACE_HEADER, valid_trace_id
+from rtseg_tpu.obs.tracing import valid_trace_id
+from rtseg_tpu.serve.headers import TRACE_HEADER
 from rtseg_tpu.serve import (DEADLINE_HEADER, REPLICA_HEADER, bench_http,
                              check_report, replica_skew)
 

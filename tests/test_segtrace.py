@@ -23,8 +23,9 @@ from rtseg_tpu.config import SegConfig
 from rtseg_tpu.obs.live import (MetricsPoller, SinkTailer, check_frame,
                                 format_frame, parse_prometheus)
 from rtseg_tpu.obs.metrics import (MetricsRegistry, render_prometheus)
-from rtseg_tpu.obs.tracing import (TRACE_HEADER, TRACE_KEY, ensure_trace,
+from rtseg_tpu.obs.tracing import (TRACE_KEY, ensure_trace,
                                    new_trace_id, valid_trace_id)
+from rtseg_tpu.serve.headers import TRACE_HEADER
 
 BUCKETS = [(32, 32)]
 BATCH = 4

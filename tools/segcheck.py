@@ -9,6 +9,7 @@ Usage:
     python tools/segcheck.py --deep          # + jaxpr/HLO deep audits
     python tools/segcheck.py --deep --update-budget   # re-pin SEGAUDIT.json
     python tools/segcheck.py --update-lockgraph       # re-pin SEGRACE.json
+    python tools/segcheck.py --update-contracts       # re-pin SEGCONTRACT.json
 
 Rules (suppress one finding with `# segcheck: disable=<rule>` on its line):
     import-hygiene        torch/torchvision never import at module scope
@@ -23,6 +24,13 @@ Rules (suppress one finding with `# segcheck: disable=<rule>` on its line):
                           graph gated by SEGRACE.json, atomicity lints
                           (lockless +=, check-then-act, notify without
                           the condition, Thread.start publication races)
+    contracts             segcontract: cross-plane contract auditor —
+                          event schemas (emit sites vs report/live
+                          consumers), metric families (registrations vs
+                          references incl. CI yaml), wire headers (the
+                          serve/headers.py constants; raw X-* literals
+                          elsewhere are findings), all pinned in
+                          SEGCONTRACT.json
 
 Audit: jax.eval_shape sweep of every registry model (aux/detail variants
 included) asserting the [B, H, W, num_class] eval contract — no weights
@@ -83,6 +91,12 @@ def main(argv=None) -> int:
                     help='rewrite SEGRACE.json with the observed lock-'
                          'order graph (after review of a new edge) '
                          'before the lint gate runs; refuses on a cycle')
+    ap.add_argument('--update-contracts', action='store_true',
+                    help='rewrite SEGCONTRACT.json with the observed '
+                         'event/metric/header contract before the lint '
+                         'gate runs; refuses while the contract itself '
+                         'is incoherent (orphan consumers, unregistered '
+                         'metric references, raw X-* literals)')
     ap.add_argument('-q', '--quiet', action='store_true',
                     help='print findings only, no summary')
     args = ap.parse_args(argv)
@@ -94,6 +108,9 @@ def main(argv=None) -> int:
         ap.error('--update-budget requires --deep')
     if args.update_lockgraph and args.audit_only:
         ap.error('--update-lockgraph is a lint-tier operation; drop '
+                 '--audit-only')
+    if args.update_contracts and args.audit_only:
+        ap.error('--update-contracts is a lint-tier operation; drop '
                  '--audit-only')
 
     try:
@@ -116,6 +133,20 @@ def main(argv=None) -> int:
             print(f'segcheck: SEGRACE.json re-pinned '
                   f'({len(data["locks"])} locks, '
                   f'{len(data["edges"])} edges)')
+    if args.update_contracts:
+        # pure-AST, no jax: re-pin the cross-plane contract, then let
+        # the normal lint gate below verify the tree against it
+        from rtseg_tpu.analysis.contracts import update_contracts
+        try:
+            data = update_contracts(root)
+        except ValueError as e:          # incoherent: nothing written
+            print(f'segcheck: {e}', file=sys.stderr)
+            return 1
+        if not args.quiet:
+            print(f'segcheck: SEGCONTRACT.json re-pinned '
+                  f'({len(data["events"])} event types, '
+                  f'{len(data["metrics"])} metric families, '
+                  f'{len(data["headers"])} headers)')
     if not args.audit_only:
         rules = [r.strip() for r in args.rules.split(',')] \
             if args.rules else None
