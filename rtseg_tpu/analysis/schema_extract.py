@@ -46,7 +46,7 @@ from .core import SourceFile
 IMPLICIT_EVENT_KEYS = ('ts', 'host', 'event')
 
 #: registration kwargs that are metric configuration, not label names
-_NON_LABEL_KWARGS = ('help', 'bounds', 'window')
+_NON_LABEL_KWARGS = ('help', 'bounds', 'window', 'exemplars')
 
 #: label names synthesized by render_prometheus on derived series
 _SYNTHETIC_LABELS = ('le', 'quantile')
@@ -769,9 +769,10 @@ def _literal_str_seq(node: ast.AST) -> Optional[Tuple[str, ...]]:
 
 def extract_event_consumers(files: Sequence[SourceFile],
                             only: Sequence[str] = ('rtseg_tpu/obs/report.py',
-                                                   'rtseg_tpu/obs/live.py')
+                                                   'rtseg_tpu/obs/live.py',
+                                                   'rtseg_tpu/obs/trail.py')
                             ) -> List[ConsumedKey]:
-    """Typed key reads in the consumer modules (report/live)."""
+    """Typed key reads in the consumer modules (report/live/trail)."""
     ctx = _SchemaCtx(files)
     out: List[ConsumedKey] = []
     for sf in files:

@@ -74,6 +74,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple, Union
 
 from ..obs import get_sink
+from ..obs.flight import FlightRecorder
 from ..obs.metrics import Histogram, MetricsRegistry, render_prometheus
 from ..obs.tracing import new_trace_id, valid_trace_id
 from ..serve.headers import (DEADLINE_HEADER, MASK_AGE_HEADER,
@@ -222,6 +223,9 @@ class FleetRouter(ThreadingHTTPServer):
         for g, split in self.groups.items():
             self.ensure_version(g, split.stable_arm().version)
         self._mirror_slots = threading.BoundedSemaphore(_MAX_MIRRORS)
+        # segtail flight recorder: the router's ring of recent per-hop
+        # records (obs/flight.py), dumped on trigger only
+        self.flight = FlightRecorder(source='router')
         self._out_group: Dict[str, int] = {g: 0 for g in self.groups}
         self._out_replica: Dict[str, int] = {}
         # segstream: session -> replica-id affinity bindings (guarded by
@@ -293,7 +297,7 @@ class FleetRouter(ThreadingHTTPServer):
                 m = self._h_e2e.get((group, version))
                 if m is None:
                     m = self.registry.histogram(
-                        'fleet_e2e_ms',
+                        'fleet_e2e_ms', exemplars=8,
                         help='router-side end-to-end latency (ms) by '
                              'artifact version',
                         group=group, version=version)
@@ -608,6 +612,25 @@ class _RouterHandler(BaseHTTPRequestHandler):
         inbound = self.headers.get(TRACE_HEADER)
         tid = inbound if valid_trace_id(inbound) else new_trace_id()
         trace_hdr = {TRACE_HEADER: tid}
+        if path == '/debug/flight':
+            # segtail trigger, same contract as the replica endpoint
+            # (serve/server.py): dump the router's ring, return summary
+            reason = 'manual'
+            if data:
+                try:
+                    reason = str(json.loads(data.decode()).get(
+                        'reason', 'manual'))
+                except (ValueError, AttributeError):
+                    pass
+            try:
+                out = self.server.flight.dump(reason)
+            except Exception as e:   # noqa: BLE001 — surface, not hang
+                self._send_json(500,
+                                {'error': f'{type(e).__name__}: {e}'},
+                                trace_hdr)
+                return
+            self._send_json(200, out, trace_hdr)
+            return
         group = self._resolve_group(path)
         if group is None:
             self._send_json(404, {'error': f'no route {path}; groups: '
@@ -760,6 +783,7 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 fwd_headers['Content-Type'] = ctype
             url = base + '/predict' + (f'?{query}' if query else '')
             srv.note_start(rid)
+            t_f0 = time.perf_counter()
             try:
                 code, body, headers = _forward(url, data, fwd_headers,
                                                timeout_s)
@@ -790,15 +814,34 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 attempts += 1
                 note_retry()
                 continue
+            upstream_ms = (time.perf_counter() - t_f0) * 1e3
             status = {200: 'ok', 503: 'rejected', 504: 'dropped'}.get(
                 code, 'client_error' if 400 <= code < 500 else 'error')
             srv.count(group, arm.version, status)
+            served = headers.get(VERSION_HEADER, arm.version)
+            e2e_ms = (time.perf_counter() - t0) * 1e3
             if status == 'ok':
-                srv._hist(group, arm.version).observe(
-                    (time.perf_counter() - t0) * 1e3)
+                srv._hist(group, arm.version).observe(e2e_ms,
+                                                      exemplar=tid)
+            # segtail: the router's per-request evidence. The hop event
+            # is what `segscope trace` anchors the cross-plane timeline
+            # on (obs/trail.py): e2e - upstream is router-side overhead,
+            # upstream - the replica's request e2e is the network/http
+            # gap. The flight ring keeps the same record for breach-time
+            # dumps.
+            hop = {'event': 'hop', 'trace_id': tid, 'status': status,
+                   'group': group, 'version': served, 'replica': rid,
+                   'attempts': attempts + 1,
+                   'e2e_ms': round(e2e_ms, 3),
+                   'upstream_ms': round(upstream_ms, 3)}
+            srv.flight.record({'ts': time.time(),
+                               **{k: v for k, v in hop.items()
+                                  if k != 'event'}})
+            sink = get_sink()
+            if sink is not None:
+                sink.emit(hop)
             extra = {REPLICA_HEADER: rid,
-                     VERSION_HEADER: headers.get(VERSION_HEADER,
-                                                 arm.version),
+                     VERSION_HEADER: served,
                      **trace_hdr}
             for h in _PASS_HEADERS:
                 if headers.get(h):

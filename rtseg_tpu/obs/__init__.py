@@ -26,6 +26,8 @@ from .tracing import (TRACE_KEY, ensure_trace, new_trace_id,
                       valid_trace_id)
 from .profile import (CaptureBusy, DeviceProfile, SampledProfiler,
                       capture_window, parse_trace)
+from .flight import FlightRecorder, dump_all, traffic_mix
+from .trail import assemble_trace, format_timeline, load_trace
 
 __all__ = [
     'EventSink', 'emit_memory', 'get_sink', 'init_run',
@@ -37,4 +39,6 @@ __all__ = [
     'valid_trace_id',
     'CaptureBusy', 'DeviceProfile', 'SampledProfiler', 'capture_window',
     'parse_trace',
+    'FlightRecorder', 'dump_all', 'traffic_mix',
+    'assemble_trace', 'format_timeline', 'load_trace',
 ]

@@ -47,6 +47,9 @@ def parse_prometheus(text: str) -> Dict[str, List[Tuple[Dict[str, str],
         line = line.strip()
         if not line or line.startswith('#'):
             continue
+        if ' # ' in line:
+            # OpenMetrics exemplar annotation — value parses without it
+            line = line.split(' # ', 1)[0].rstrip()
         try:
             name_part, value_part = line.rsplit(' ', 1)
             value = float(value_part)
@@ -64,6 +67,50 @@ def parse_prometheus(text: str) -> Dict[str, List[Tuple[Dict[str, str],
             name = name_part
         out.setdefault(name, []).append((labels, value))
     return out
+
+
+def parse_exemplars(text: str) -> Dict[str, List[Dict[str, Any]]]:
+    """OpenMetrics exemplar annotations -> {family: [{le, trace_id,
+    value}, ...]} (slowest first). The renderer (metrics.py
+    ``render_prometheus``) attaches ``# {trace_id="..."} <value>`` to
+    ``_bucket`` lines; this is the scrape-side inverse, so a live p99
+    always links to concrete trace ids."""
+    out: Dict[str, List[Dict[str, Any]]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if line.startswith('#') or ' # ' not in line:
+            continue
+        series, annot = line.split(' # ', 1)
+        name_part = series.split(' ', 1)[0]
+        if '_bucket{' not in name_part:
+            continue
+        family = name_part.split('_bucket{', 1)[0]
+        le = None
+        for pair in name_part.split('{', 1)[1].rstrip('}').split(','):
+            if pair.startswith('le='):
+                le = pair.split('=', 1)[1].strip('"')
+        try:
+            body, val = annot.rsplit(' ', 1)
+            tid = body.split('trace_id="', 1)[1].split('"', 1)[0]
+            ex = {'le': le, 'trace_id': tid, 'value': float(val)}
+        except (IndexError, ValueError):
+            continue
+        out.setdefault(family, []).append(ex)
+    for exs in out.values():
+        exs.sort(key=lambda e: -e['value'])
+    return out
+
+
+def trigger_flight(url: str, reason: str = 'manual',
+                   timeout_s: float = 10.0) -> Dict[str, Any]:
+    """POST /debug/flight on a replica or router — the operator/CI leg
+    of the flight-recorder trigger table (obs/flight.py)."""
+    req = urllib.request.Request(
+        url.rstrip('/') + '/debug/flight',
+        data=json.dumps({'reason': reason}).encode(),
+        headers={'Content-Type': 'application/json'}, method='POST')
+    with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+        return json.loads(resp.read().decode())
 
 
 def _family_value(parsed: Dict, name: str,
@@ -112,7 +159,9 @@ class MetricsPoller:
     def poll(self) -> Dict[str, Any]:
         with urllib.request.urlopen(self.url,
                                     timeout=self.timeout_s) as resp:
-            parsed = parse_prometheus(resp.read().decode())
+            text = resp.read().decode()
+        parsed = parse_prometheus(text)
+        exemplars = parse_exemplars(text)
         now = time.monotonic()
         statuses = {labels.get('status', '?'): int(v)
                     for labels, v in parsed.get('serve_requests_total',
@@ -169,6 +218,9 @@ class MetricsPoller:
                 'occupancy': _occupancy(
                     _family_sum(parsed, 'serve_batched_requests_total'),
                     _family_sum(parsed, 'serve_padded_slots_total')),
+                'exemplars': (exemplars.get('serve_request_e2e_ms')
+                              or exemplars.get('fleet_e2e_ms')
+                              or [])[:4],
             }
         if _family_value(parsed, 'train_steps_total',
                          kind='train') is not None:
@@ -242,6 +294,9 @@ class SinkTailer:
         # segship: rollout transition tally + the latest one seen
         self._rollout_actions: Dict[str, int] = {}
         self._rollout_last: Optional[Dict[str, Any]] = None
+        # segtail: flight-recorder dumps seen so far + the latest one
+        self.flight_dumps = 0
+        self._flight_last: Optional[Dict[str, Any]] = None
 
     def _paths(self) -> List[str]:
         if self.files is not None:
@@ -325,6 +380,13 @@ class SinkTailer:
                 self._rollout_last = {
                     'action': a, 'version': e.get('version'),
                     'reason': e.get('reason')}
+            elif kind == 'flight_dump':
+                self.flight_dumps += 1
+                self._flight_last = {
+                    'reason': e.get('reason'),
+                    'source': e.get('source'),
+                    'records': e.get('records'),
+                    'path': e.get('path')}
         cutoff = now_ts - self.window_s
         self._recent = [e for e in self._recent
                         if e.get('ts', now_ts) >= cutoff]
@@ -351,6 +413,9 @@ class SinkTailer:
             'rollout': ({'actions': dict(self._rollout_actions),
                          'last': self._rollout_last}
                         if self._rollout_actions else None),
+            'flight': ({'dumps': self.flight_dumps,
+                        'last': self._flight_last}
+                       if self.flight_dumps else None),
         }
         if self._busy_frac is not None or self._peak_hbm is not None:
             frame['device'] = {
@@ -370,6 +435,14 @@ class SinkTailer:
                 'p50_ms': _pct(e2e, 0.5), 'p95_ms': _pct(e2e, 0.95),
                 'p99_ms': _pct(e2e, 0.99),
                 'queue_depth': None, 'occupancy': None,
+                # windowed slowest-first exemplars, same shape as the
+                # /metrics-poll mode gets from parse_exemplars
+                'exemplars': [
+                    {'trace_id': e.get('trace_id'),
+                     'value': round(float(e['e2e_ms']), 3), 'le': None}
+                    for e in sorted(reqs,
+                                    key=lambda e: -float(e['e2e_ms']))[:4]
+                    if e.get('trace_id')],
             }
         if any(self.frame_totals.values()) or self.session_actions \
                 or self.migrations:
@@ -426,6 +499,10 @@ def format_frame(frame: Dict[str, Any]) -> str:
         if sv.get('occupancy') is not None:
             lines.append(
                 f'  occupancy      : {100 * sv["occupancy"]:.0f}%')
+        if sv.get('exemplars'):
+            tail = ' '.join(f'{ex["trace_id"]}({ex["value"]:g}ms)'
+                            for ex in sv['exemplars'])
+            lines.append(f'  p99 exemplars  : {tail}')
     tr = frame.get('train')
     if tr:
         lines += [
@@ -464,6 +541,12 @@ def format_frame(frame: Dict[str, Any]) -> str:
         last = ro.get('last') or {}
         lines.append(f'  rollout        : {acts} — last '
                      f'{last.get("action")} {last.get("version")}')
+    fl = frame.get('flight')
+    if fl:
+        last = fl.get('last') or {}
+        lines.append(f'  flight dumps   : {fl["dumps"]} — last '
+                     f'{last.get("reason")} ({last.get("source")}, '
+                     f'{last.get("records")} records)')
     dv = frame.get('device')
     if dv:
         busy = (f'{100 * dv["busy_frac"]:.1f}%'

@@ -13,8 +13,22 @@ Hot-path contract: ``Counter.inc`` / ``Gauge.set`` / ``Histogram.observe``
 allocate nothing per call — a lock, an integer add, and (for histograms)
 a ``bisect`` into precomputed bounds plus a write into a preallocated
 ring slot. Percentiles are computed lazily at *read* time from a sliding
-window of the last ``window`` observations (ring buffer), so online
-p50/p95/p99 cost nothing until somebody scrapes.
+window of the last ``window`` observations (ring buffer): one sort of the
+window copy per snapshot, every quantile derived from that single sorted
+copy, so online p50/p95/p99 cost nothing until somebody scrapes and a
+scrape costs one sort no matter how many quantiles it reads.
+
+Exemplars (segtail): a histogram built with ``exemplars=k`` keeps a small
+reservoir of (value, trace_id, bucket) triples biased toward the top of
+the window — the k slowest observations currently in the window plus the
+most recent exemplar per bucket (stratified), so a p99 number always
+comes with concrete trace ids to chase. The reservoir only does work on
+``observe(v, exemplar=...)`` calls that actually carry an exemplar, and
+its entries expire exactly with the window (an exemplar's value is always
+inside the window's min/max). Surfaced in ``snapshot()['exemplars']``,
+``MetricsRegistry.snapshot()`` (the ``/stats`` shape) and as
+OpenMetrics-style ``# {trace_id="..."} <value>`` annotations on
+``render_prometheus`` bucket lines.
 
 Consistency contract: each metric guards its state with one lock, and
 snapshots copy under that lock — a scraper can never observe a histogram
@@ -102,6 +116,22 @@ class Gauge:
             return self._v
 
 
+def quantiles_of(sorted_vals: List[float],
+                 qs: Iterable[float] = WINDOW_QUANTILES
+                 ) -> Dict[float, Optional[float]]:
+    """Nearest-rank quantiles off one already-sorted window copy — the
+    single-sort path every scrape surface shares."""
+    out: Dict[float, Optional[float]] = {}
+    n = len(sorted_vals)
+    for q in qs:
+        if not n:
+            out[q] = None
+        else:
+            idx = min(n - 1, max(0, round(q * (n - 1))))
+            out[q] = sorted_vals[idx]
+    return out
+
+
 class Histogram:
     """Fixed-bucket histogram + ring window for online percentiles.
 
@@ -109,15 +139,22 @@ class Histogram:
     the metric lock, so ``count == sum(bucket_counts)`` holds for every
     snapshot a concurrent reader can take. The ring window (preallocated,
     no per-observation allocation) keeps the last ``window`` raw values;
-    ``quantile`` sorts a copy at read time.
+    ``snapshot`` sorts a copy once and derives every quantile from it.
+
+    With ``exemplars=k``, ``observe(v, exemplar=trace_id)`` additionally
+    maintains the segtail reservoir (module docstring): the k slowest
+    in-window observations plus the latest exemplar per bucket, each
+    stamped with its observation ordinal so expiry tracks the window
+    exactly. The reservoir costs nothing on exemplar-less observes.
     """
 
     __slots__ = ('name', 'labels', 'bounds', '_lock', '_counts', '_sum',
-                 '_count', '_ring', '_rpos', '_rfill')
+                 '_count', '_ring', '_rpos', '_rfill', '_ex_k', '_ex_top',
+                 '_ex_bucket')
 
     def __init__(self, name: str, labels: LabelKey = (),
                  bounds: Tuple[float, ...] = DEFAULT_MS_BOUNDS,
-                 window: int = 2048):
+                 window: int = 2048, exemplars: int = 0):
         self.name = name
         self.labels = labels
         self.bounds = tuple(sorted(float(b) for b in bounds))
@@ -128,8 +165,14 @@ class Histogram:
         self._ring = [0.0] * max(int(window), 1)
         self._rpos = 0
         self._rfill = 0
+        self._ex_k = max(int(exemplars), 0)
+        #: slowest-k in-window: [(value, trace_id, stamp, bucket)],
+        #: ascending by value so [0] is the cheapest to displace
+        self._ex_top: List[Tuple[float, str, int, int]] = []
+        #: stratified: bucket index -> (value, trace_id, stamp, bucket)
+        self._ex_bucket: Dict[int, Tuple[float, str, int, int]] = {}
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, exemplar: Optional[str] = None) -> None:
         v = float(v)
         # bisect_left: Prometheus `le` is an inclusive upper bound, so an
         # observation equal to a bound belongs to that bound's bucket
@@ -142,32 +185,75 @@ class Histogram:
             self._rpos = (self._rpos + 1) % len(self._ring)
             if self._rfill < len(self._ring):
                 self._rfill += 1
+            if exemplar is not None and self._ex_k:
+                self._note_exemplar(v, exemplar, i)
+
+    def _note_exemplar(self, v: float, tid: str, bucket: int) -> None:
+        # under self._lock; bounded work (the top list holds <= k entries
+        # and only re-sorts when this observation actually enters it)
+        stamp = self._count            # ordinal of THIS observation
+        self._ex_bucket[bucket] = (v, tid, stamp, bucket)
+        horizon = stamp - len(self._ring)
+        top = self._ex_top
+        if top and top[0][2] <= horizon:
+            self._ex_top = top = [e for e in top if e[2] > horizon]
+        if len(top) < self._ex_k:
+            top.append((v, tid, stamp, bucket))
+            top.sort(key=lambda e: e[0])
+        elif v >= top[0][0]:
+            top[0] = (v, tid, stamp, bucket)
+            top.sort(key=lambda e: e[0])
+
+    def _exemplars_locked(self) -> List[Dict[str, Any]]:
+        """Current reservoir, expired entries dropped: the window holds
+        ordinals (count - rfill, count], so stamp > count - rfill is
+        exactly 'still in the window' — every surviving exemplar's value
+        sits inside the window's min/max by construction."""
+        horizon = self._count - self._rfill
+        seen: Dict[int, Tuple[float, str, int, int]] = {}
+        for e in self._ex_top:
+            if e[2] > horizon:
+                seen[e[2]] = e
+        for e in self._ex_bucket.values():
+            if e[2] > horizon:
+                seen.setdefault(e[2], e)
+        out = []
+        for v, tid, _stamp, i in sorted(seen.values(),
+                                        key=lambda e: -e[0]):
+            le = '+Inf' if i >= len(self.bounds) else f'{self.bounds[i]:g}'
+            out.append({'value': round(v, 3), 'trace_id': tid, 'le': le})
+        return out
 
     def snapshot(self) -> Dict[str, Any]:
-        """Consistent copy: count always equals sum(bucket counts)."""
+        """Consistent copy: count always equals sum(bucket counts), the
+        exemplar list is taken under the same lock acquisition as the
+        window (an exemplar can never refer outside the window it ships
+        with), and ``quantiles`` derive from one sort of the copy."""
         with self._lock:
             window = (self._ring[:self._rfill]
                       if self._rfill < len(self._ring) else list(self._ring))
-            return {'bounds': self.bounds,
-                    'counts': list(self._counts),
-                    'sum': self._sum, 'count': self._count,
-                    'window': window}
+            out: Dict[str, Any] = {
+                'bounds': self.bounds, 'counts': list(self._counts),
+                'sum': self._sum, 'count': self._count, 'window': window}
+            if self._ex_k:
+                out['exemplars'] = self._exemplars_locked()
+        # the one sort per snapshot happens OUTSIDE the lock, on the copy
+        out['quantiles'] = quantiles_of(sorted(window))
+        return out
 
     def quantiles(self, qs: Iterable[float] = WINDOW_QUANTILES
                   ) -> Dict[float, Optional[float]]:
-        """Sliding-window percentiles (nearest-rank on a sorted copy)."""
+        """Sliding-window percentiles (nearest-rank, one sorted copy)."""
         with self._lock:
             vals = sorted(self._ring[:self._rfill]
                           if self._rfill < len(self._ring)
                           else self._ring)
-        out: Dict[float, Optional[float]] = {}
-        for q in qs:
-            if not vals:
-                out[q] = None
-            else:
-                idx = min(len(vals) - 1, max(0, round(q * (len(vals) - 1))))
-                out[q] = vals[idx]
-        return out
+        return quantiles_of(vals, qs)
+
+    def exemplars(self) -> List[Dict[str, Any]]:
+        """Current (value, trace_id, le) reservoir, slowest first."""
+        with self._lock:
+            return self._exemplars_locked()
 
     @property
     def count(self) -> int:
@@ -194,16 +280,19 @@ class _Null:
     def add(self, v: float) -> None:
         pass
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, exemplar: Optional[str] = None) -> None:
         pass
 
     def snapshot(self) -> Dict[str, Any]:
         return {'bounds': (), 'counts': [], 'sum': 0.0, 'count': 0,
-                'window': []}
+                'window': [], 'quantiles': {}}
 
     def quantiles(self, qs: Iterable[float] = WINDOW_QUANTILES
                   ) -> Dict[float, Optional[float]]:
         return {q: None for q in qs}
+
+    def exemplars(self) -> List[Dict[str, Any]]:
+        return []
 
 
 _NULL = _Null()
@@ -266,11 +355,13 @@ class MetricsRegistry:
 
     def histogram(self, name: str, help: str = '',
                   bounds: Tuple[float, ...] = DEFAULT_MS_BOUNDS,
-                  window: int = 2048, **labels: str) -> Histogram:
+                  window: int = 2048, exemplars: int = 0,
+                  **labels: str) -> Histogram:
         self._set_help(name, help)
         return self._get(
             'histogram', name, labels,
-            lambda n, lk: Histogram(n, lk, bounds=bounds, window=window))
+            lambda n, lk: Histogram(n, lk, bounds=bounds, window=window,
+                                    exemplars=exemplars))
 
     # ------------------------------------------------------------- scraping
     def collect(self) -> List[Any]:
@@ -287,22 +378,33 @@ class MetricsRegistry:
 
     def snapshot(self) -> Dict[str, Any]:
         """JSON-able view: counters/gauges flat, histograms with bucket
-        counts plus window quantiles (the `/stats` shape)."""
+        counts plus window quantiles (the `/stats` shape). One snapshot
+        (one sort) per histogram feeds count, every quantile and the
+        exemplar list together."""
         out: Dict[str, Any] = {}
         for m in self.collect():
             key = m.name + _label_str(m.labels)
             if isinstance(m, Histogram):
                 snap = m.snapshot()
-                qs = m.quantiles()
+                qs = snap['quantiles']
                 out[key] = {
                     'count': snap['count'],
                     'sum': round(snap['sum'], 3),
                     'p50': qs.get(0.5), 'p95': qs.get(0.95),
                     'p99': qs.get(0.99),
                 }
+                if snap.get('exemplars'):
+                    out[key]['exemplars'] = snap['exemplars']
             else:
                 out[key] = m.value
         return out
+
+
+def _exemplar_str(ex: Optional[Dict[str, Any]]) -> str:
+    """OpenMetrics exemplar suffix for one bucket sample line."""
+    if ex is None:
+        return ''
+    return f' # {{trace_id="{ex["trace_id"]}"}} {ex["value"]:g}'
 
 
 def render_prometheus(reg: MetricsRegistry) -> str:
@@ -311,7 +413,10 @@ def render_prometheus(reg: MetricsRegistry) -> str:
     Histograms render the standard cumulative ``_bucket``/``_sum``/
     ``_count`` series plus a ``<name>_window`` summary carrying the
     sliding-window p50/p95/p99, so a scraper (or ``segscope live``) gets
-    online percentiles without bucket interpolation.
+    online percentiles without bucket interpolation. A histogram with an
+    exemplar reservoir annotates its bucket lines OpenMetrics-style —
+    ``... 17 # {trace_id="deadbeef..."} 153.2`` — one exemplar per bucket
+    (``parse_prometheus`` strips them; ``parse_exemplars`` reads them).
     """
     by_family: Dict[str, List[Any]] = {}
     for m in reg.collect():
@@ -328,23 +433,28 @@ def render_prometheus(reg: MetricsRegistry) -> str:
             window_lines: List[str] = []
             for m in fam:
                 snap = m.snapshot()
+                by_le = {}
+                for ex in snap.get('exemplars', ()):
+                    by_le.setdefault(ex['le'], ex)
                 cum = 0
                 for bound, c in zip(snap['bounds'], snap['counts']):
                     cum += c
                     lk = dict(m.labels)
                     lk['le'] = f'{bound:g}'
                     lines.append(f'{name}_bucket'
-                                 f'{_label_str(_label_key(lk))} {cum}')
+                                 f'{_label_str(_label_key(lk))} {cum}'
+                                 + _exemplar_str(by_le.get(lk['le'])))
                 cum += snap['counts'][-1] if snap['counts'] else 0
                 lk = dict(m.labels)
                 lk['le'] = '+Inf'
                 lines.append(f'{name}_bucket'
-                             f'{_label_str(_label_key(lk))} {cum}')
+                             f'{_label_str(_label_key(lk))} {cum}'
+                             + _exemplar_str(by_le.get('+Inf')))
                 lines.append(f'{name}_sum{_label_str(m.labels)} '
                              f'{snap["sum"]:g}')
                 lines.append(f'{name}_count{_label_str(m.labels)} '
                              f'{snap["count"]}')
-                for q, v in m.quantiles().items():
+                for q, v in snap['quantiles'].items():
                     if v is None:
                         continue
                     lk = dict(m.labels)

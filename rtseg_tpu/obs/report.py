@@ -337,6 +337,23 @@ def summarize(events: List[dict],
             'last_reason': last.get('reason'),
         }
 
+    # segtail: flight-recorder dumps (obs/flight.py) — how many times a
+    # trigger fired, what fired it, and the captured traffic mix of the
+    # most recent dump (the replay artifact ROADMAP item 4 consumes).
+    fdumps = [e for e in events if e.get('event') == 'flight_dump']
+    flight: Optional[Dict[str, Any]] = None
+    if fdumps:
+        reasons = [e.get('reason', '?') for e in fdumps]
+        last = fdumps[-1]
+        flight = {
+            'dumps': len(fdumps),
+            'reasons': {r: reasons.count(r) for r in sorted(set(reasons))},
+            'records': sum(int(e.get('records', 0)) for e in fdumps),
+            'last_source': last.get('source'),
+            'last_path': last.get('path'),
+            'traffic_mix': last.get('traffic_mix'),
+        }
+
     spans: Dict[str, Dict[str, float]] = {}
     for e in events:
         if e.get('event') != 'span' or not mine(e):
@@ -418,6 +435,7 @@ def summarize(events: List[dict],
         'serving': serving,
         'streaming': streaming,
         'rollout': rollout,
+        'flight': flight,
         # flattened for diff_table's flat-key rows
         'serve_p99_ms': serving['e2e_p99_ms'] if serving else None,
         'serve_rps': serving['rps'] if serving else None,
@@ -535,6 +553,13 @@ def format_summary(s: Dict[str, Any], path: str = '') -> str:
             f'{ro["last_version"]}'
             + (f' ({ro["last_reason"]})' if ro.get('last_reason')
                else ''))
+    if s.get('flight'):
+        fl = s['flight']
+        reasons = ' | '.join(f'{r} x{n}'
+                             for r, n in fl['reasons'].items())
+        lines.append(
+            f'  flight dumps   : {fl["dumps"]} ({reasons}) — '
+            f'{fl["records"]} records, last from {fl["last_source"]}')
     if s.get('device'):
         dv = s['device']
         per_iter = (f' | {dv["ms_per_iter"]:.1f} device-ms/iter'

@@ -167,6 +167,14 @@ class StallWatchdog:
                             'deadline_s': round(deadline, 3),
                             'stacks': stacks, 'trace_dir': trace_dir,
                             'top_device_ops': top_ops})
+        # segtail: a stall is exactly the window the flight recorders
+        # exist for — dump every registered ring (best-effort, like the
+        # rest of _fire)
+        try:
+            from .flight import dump_all
+            dump_all('stall')
+        except Exception:   # noqa: BLE001 — never raise into the run
+            pass
         if self.logger is not None:
             self.logger.error(
                 f'segscope: no step heartbeat for {elapsed:.1f}s '

@@ -330,6 +330,15 @@ class RolloutController:
                      reason=reason, canary_ok=obs.canary_ok,
                      canary_errors=obs.canary_errors,
                      shadow_disagree=obs.shadow_disagree)
+        # segtail: a rollback is a forensic moment — capture every
+        # registered flight ring (router hops + replica requests) for
+        # the window that tripped it. Best-effort: the rollback itself
+        # must never fail on observability.
+        try:
+            from ..obs.flight import dump_all
+            dump_all('rollback')
+        except Exception:   # noqa: BLE001 — never block the rollback
+            pass
         # arm cleared first: from here every request (the sticky canary
         # hash slice included) routes to stable, so the drain below is
         # invisible to clients

@@ -38,6 +38,9 @@ from .pipeline import ServePipeline
 
 _STAGES = ('queue_ms', 'assemble_ms', 'device_ms', 'post_ms', 'decode_ms')
 
+#: how many slowest-request exemplars a bench report carries
+_SLOWEST_N = 8
+
 
 def synth_images(shapes: Sequence[Bucket], seed: int = 0,
                  per_shape: int = 2) -> List[np.ndarray]:
@@ -81,7 +84,8 @@ def _sleep_until(target: float) -> None:
 
 def _finalize(report: dict, e2e: List[float],
               stages: Dict[str, List[float]], ok: int, dropped: int,
-              rejected: int, errors: int, wall_s: float) -> dict:
+              rejected: int, errors: int, wall_s: float,
+              slowest: Optional[List[dict]] = None) -> dict:
     pct = _percentiles(e2e)
     report.update({
         'ok': ok, 'dropped': dropped, 'rejected': rejected,
@@ -93,6 +97,12 @@ def _finalize(report: dict, e2e: List[float],
         'stage_mean_ms': {k: (round(float(np.mean(v)), 3) if v else None)
                           for k, v in stages.items()},
     })
+    if slowest is not None:
+        # segtail: the N slowest ok requests, trace id + per-stage
+        # decomposition — exemplar seeds for `segscope trace <id>` and
+        # the reconciliation target for flight-recorder dumps
+        report['slowest'] = sorted(
+            slowest, key=lambda r: -(r.get('e2e_ms') or 0.0))[:_SLOWEST_N]
     return report
 
 
@@ -120,6 +130,7 @@ def bench_pipeline(pipeline: ServePipeline, images: Sequence[np.ndarray],
             futures.append(None)
     e2e: List[float] = []
     stages: Dict[str, List[float]] = {k: [] for k in _STAGES}
+    slow: List[dict] = []
     ok = dropped = errors = 0
     for fut in futures:
         if fut is None:
@@ -137,13 +148,16 @@ def bench_pipeline(pipeline: ServePipeline, images: Sequence[np.ndarray],
         for k in _STAGES:
             if k in res.timings:
                 stages[k].append(res.timings[k])
+        slow.append({'trace_id': res.meta.get(TRACE_KEY),
+                     **{k: round(float(v), 3)
+                        for k, v in res.timings.items()}})
     wall = time.perf_counter() - t0
     report = {'mode': 'in-process', 'requests': requests,
               'rps_target': rps,
               'batcher': pipeline.batcher.stats(),
               'engine': pipeline.engine.stats()}
     return _finalize(report, e2e, stages, ok, dropped, rejected, errors,
-                     wall)
+                     wall, slowest=slow)
 
 
 def bench_http(url, payloads: Sequence[bytes], requests: int,
@@ -182,6 +196,7 @@ def bench_http(url, payloads: Sequence[bytes], requests: int,
                 # the client)
                 return {'status': 'ok',
                         'e2e_ms': (time.perf_counter() - t_sched) * 1e3,
+                        'trace_id': tid,
                         'timing': timing,
                         'replica': resp.headers.get(REPLICA_HEADER),
                         'version': resp.headers.get(VERSION_HEADER),
@@ -213,6 +228,12 @@ def bench_http(url, payloads: Sequence[bytes], requests: int,
         for k in _STAGES:
             if r['status'] == 'ok' and k in r.get('timing', {}):
                 stages[k].append(r['timing'][k])
+    slow = [{'trace_id': r['trace_id'],
+             'e2e_ms': round(r['e2e_ms'], 3),
+             'replica': r.get('replica'),
+             **{k: r['timing'][k] for k in _STAGES
+                if k in r.get('timing', {})}}
+            for r in results if r['status'] == 'ok']
     counts = {s: sum(1 for r in results if r['status'] == s)
               for s in ('ok', 'dropped', 'rejected', 'error')}
     per_replica: Dict[str, int] = {}
@@ -240,7 +261,8 @@ def bench_http(url, payloads: Sequence[bytes], requests: int,
               'replica_skew': replica_skew(per_replica),
               'per_version': per_version}
     return _finalize(report, e2e, stages, counts['ok'], counts['dropped'],
-                     counts['rejected'], counts['error'], wall)
+                     counts['rejected'], counts['error'], wall,
+                     slowest=slow)
 
 
 def synth_video(bucket: Bucket, frames: int, seed: int = 0,
@@ -680,6 +702,13 @@ def format_report(report: dict) -> str:
         dist = ' | '.join(f'{v} {n} ({n / total:.2f})'
                           for v, n in sorted(pv.items()))
         lines.append(f'  per version    : {dist}')
+    slow = report.get('slowest')
+    if slow:
+        worst = ' '.join(f"{r.get('trace_id')}({r.get('e2e_ms'):.1f}ms)"
+                         for r in slow[:3] if r.get('trace_id'))
+        if worst:
+            lines.append(f'  slowest        : {worst} — '
+                         f'`segscope trace <id>` for the timeline')
     eng = report.get('engine')
     if eng:
         lines.append(

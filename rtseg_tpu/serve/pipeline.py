@@ -39,6 +39,7 @@ from typing import Any, Callable, Dict, Optional
 import numpy as np
 
 from ..obs import get_sink, span
+from ..obs.flight import FlightRecorder
 from ..obs.metrics import MetricsRegistry
 from ..obs.tracing import TRACE_KEY
 from .batcher import MicroBatcher, Request, _bucket_str
@@ -82,7 +83,7 @@ class ServePipeline:
         self._c_error = reg.counter('serve_requests_total',
                                     status='error')
         self._h_e2e = reg.histogram(
-            'serve_request_e2e_ms',
+            'serve_request_e2e_ms', exemplars=8,
             help='end-to-end request latency, ingress to response (ms)')
         self._h_stage = {
             stage: reg.histogram('serve_stage_ms', stage=stage)
@@ -90,6 +91,10 @@ class ServePipeline:
         self._g_inflight = reg.gauge(
             'serve_inflight_batches',
             help='batches dispatched to device, not yet read back')
+        # segtail flight recorder: last-N per-request records, dumped
+        # only on trigger (obs/flight.py) — nothing hits the sink per
+        # request beyond the existing event
+        self.flight = FlightRecorder(source='replica')
         self.batcher = MicroBatcher(engine.buckets, engine.batch,
                                     max_wait_ms=max_wait_ms,
                                     max_queue=max_queue,
@@ -236,11 +241,20 @@ class ServePipeline:
         if 'decode_ms' in r.meta:
             timings['decode_ms'] = r.meta['decode_ms']
         self._c_ok.inc()
-        self._h_e2e.observe(timings['e2e_ms'])
+        self._h_e2e.observe(timings['e2e_ms'],
+                            exemplar=r.meta.get(TRACE_KEY))
         for stage, h in self._h_stage.items():
             key = stage + '_ms'
             if key in timings:
                 h.observe(timings[key])
+        rec = {'ts': time.time(), 'status': 'ok',
+               'bucket': _bucket_str(r.bucket),
+               'deadline_ms': ((r.deadline - r.t_submit) * 1e3
+                               if r.deadline is not None else None),
+               **{k: round(v, 3) for k, v in timings.items()}}
+        if TRACE_KEY in r.meta:
+            rec[TRACE_KEY] = r.meta[TRACE_KEY]
+        self.flight.record(rec)
         sink = get_sink()
         if sink is not None:
             ev = {'event': 'request', 'status': 'ok',
@@ -276,13 +290,15 @@ class ServePipeline:
         """Live counters, read straight from the metrics registry — the
         same objects ``GET /metrics`` renders, so the JSON and Prometheus
         views of this pipeline cannot disagree."""
-        qs = self._h_e2e.quantiles()
+        snap = self._h_e2e.snapshot()   # one sort: quantiles + exemplars
+        qs = snap['quantiles']
         return {
             'ok': self._c_ok.value,
             'errors': self._c_error.value,
-            'request_ms': {'count': self._h_e2e.count,
+            'request_ms': {'count': snap['count'],
                            'p50': qs.get(0.5), 'p95': qs.get(0.95),
                            'p99': qs.get(0.99)},
+            'exemplars': snap.get('exemplars', []),
             'batcher': self.batcher.stats(),
             'engine': self.engine.stats(),
             'inflight': self._inflight.qsize(),

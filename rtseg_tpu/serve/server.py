@@ -35,6 +35,11 @@ API:
     serialized: one at a time process-wide, 409 while another capture
     (on-demand or a trainer's sampled window) is in flight. The capture
     is passive — requests keep flowing; it never drops or rejects.
+  * ``POST /debug/flight`` — segtail flight-recorder dump
+    (obs/flight.py): snapshot the pipeline's ring of recent per-request
+    records to the sink (one ``flight_dump`` event + a JSONL snapshot
+    file) and return the summary, records included, as JSON. The body
+    may carry ``{"reason": ...}``; also passive.
 
 Tracing: every request gets a trace id at ingress — an inbound
 ``X-Trace-Id`` header is honored (well-formed hex only) so upstream
@@ -247,6 +252,9 @@ class _Handler(BaseHTTPRequestHandler):
         if path == '/debug/profile':
             self._debug_profile(trace_hdr)
             return
+        if path == '/debug/flight':
+            self._debug_flight(data, trace_hdr)
+            return
         if path == '/drain':
             query = urllib.parse.parse_qs(
                 urllib.parse.urlsplit(self.path).query)
@@ -366,6 +374,29 @@ class _Handler(BaseHTTPRequestHandler):
         Image.fromarray(cmap[res.mask]).save(buf, format='PNG')
         self._send(200, buf.getvalue(), 'image/png',
                    {TIMING_HEADER: timing, **trace_hdr})
+
+    def _debug_flight(self, data: bytes, trace_hdr: dict) -> None:
+        """segtail flight-recorder trigger (obs/flight.py): dump the
+        pipeline's ring of recent per-request records to the sink and
+        return the dump summary — records included — as JSON. The body
+        may carry ``{"reason": ...}`` so a breach-driven trigger
+        (segscope live, segfleet's seeded-breach phase) labels the dump
+        with what fired it. Passive like /debug/profile: requests keep
+        flowing; the dump happens outside the recorder lock."""
+        reason = 'manual'
+        if data:
+            try:
+                reason = str(json.loads(data.decode()).get(
+                    'reason', 'manual'))
+            except (ValueError, AttributeError):
+                pass
+        try:
+            out = self.server.pipeline.flight.dump(reason)
+        except Exception as e:   # noqa: BLE001 — surface, don't hang
+            self._send_json(500, {'error': f'{type(e).__name__}: {e}'},
+                            trace_hdr)
+            return
+        self._send_json(200, out, trace_hdr)
 
     def _debug_profile(self, trace_hdr: dict) -> None:
         """segprof on-demand capture under live traffic (obs/profile.py

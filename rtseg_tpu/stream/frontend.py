@@ -47,7 +47,8 @@ from ..serve.engine import UnknownBucket, select_bucket
 from ..serve.headers import (DEADLINE_HEADER, MASK_AGE_HEADER,
                              MASK_DTYPE_HEADER, MASK_SHAPE_HEADER,
                              MIGRATED_HEADER, PROVENANCE_HEADER,
-                             SEQ_HEADER, SESSION_HEADER, TIMING_HEADER)
+                             SEQ_HEADER, SESSION_HEADER, TIMING_HEADER,
+                             TRACE_HEADER)
 from .protocol import (FRAME_DROPPED_LATE, FRAME_ERROR, FRAME_OK,
                        FRAME_STALE, PROV_KEYFRAME)
 from .session import (SessionClosed, SessionExists, SessionLimit,
@@ -434,9 +435,11 @@ class StreamFrontend:
         self._count(status)
         e2e = (time.perf_counter() - t0) * 1e3
         self._h_e2e.observe(e2e)
-        self._emit({'event': 'frame', 'session': sid, 'seq': seq,
-                    'status': status, 'provenance': decision.provenance,
-                    'reason': decision.reason, 'e2e_ms': round(e2e, 3)})
+        ev = {'event': 'frame', 'session': sid, 'seq': seq,
+              'status': status, 'provenance': decision.provenance,
+              'reason': decision.reason, 'e2e_ms': round(e2e, 3)}
+        ev[TRACE_KEY] = base_hdr.get(TRACE_HEADER)
+        self._emit(ev)
         handler._send_json(code, {'error': error, 'status': status},
                            base_hdr)
 
@@ -446,8 +449,10 @@ class StreamFrontend:
         counters already updated there)."""
         e2e = (time.perf_counter() - t0) * 1e3
         self._h_e2e.observe(e2e)
-        self._emit({'event': 'frame', 'session': sid, 'seq': seq,
-                    'status': status, 'e2e_ms': round(e2e, 3)})
+        ev = {'event': 'frame', 'session': sid, 'seq': seq,
+              'status': status, 'e2e_ms': round(e2e, 3)}
+        ev[TRACE_KEY] = base_hdr.get(TRACE_HEADER)
+        self._emit(ev)
         msg = ('frame arrived behind the stream cursor'
                if status == FRAME_STALE
                else 'deadline expired waiting for predecessors')
@@ -462,11 +467,13 @@ class StreamFrontend:
             c.inc()
         e2e = (time.perf_counter() - t0) * 1e3
         self._h_e2e.observe(e2e)
-        self._emit({'event': 'frame', 'session': sid, 'seq': seq,
-                    'status': FRAME_OK,
-                    'provenance': decision.provenance,
-                    'reason': decision.reason, 'mask_age': age,
-                    'e2e_ms': round(e2e, 3)})
+        ev = {'event': 'frame', 'session': sid, 'seq': seq,
+              'status': FRAME_OK,
+              'provenance': decision.provenance,
+              'reason': decision.reason, 'mask_age': age,
+              'e2e_ms': round(e2e, 3)}
+        ev[TRACE_KEY] = base_hdr.get(TRACE_HEADER)
+        self._emit(ev)
         timing = json.dumps({'e2e_ms': round(e2e, 3),
                              **{k: round(v, 3)
                                 for k, v in (timings or {}).items()}})

@@ -1,0 +1,405 @@
+"""segtail (rtseg_tpu/obs/metrics.py exemplars, flight.py, trail.py,
+live.py parse/trigger plumbing, tools/segscope.py trace): the histogram
+exemplar reservoir under an 8-thread hammer, OpenMetrics exemplar
+annotations and their parse round-trip, the flight recorder's ring /
+dump / traffic-mix artifact and its cross-cutting dump_all trigger, the
+cross-plane trace assembly golden (gap attribution sums exactly to the
+anchor e2e, explicit residue), and the `segscope trace` CLI exit codes.
+
+All CPU-fast and jax-free: pure stdlib + the obs layer."""
+
+import json
+import os
+import threading
+
+import pytest
+
+from rtseg_tpu.obs.core import EventSink
+from rtseg_tpu.obs.flight import FlightRecorder, dump_all, traffic_mix
+from rtseg_tpu.obs.live import (SinkTailer, format_frame,
+                                parse_exemplars, parse_prometheus)
+from rtseg_tpu.obs.metrics import (Histogram, MetricsRegistry,
+                                   quantiles_of, render_prometheus)
+from rtseg_tpu.obs.trail import (assemble, assemble_trace, find_sink_files,
+                                 format_timeline, load_trace)
+
+
+def _segscope():
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), 'tools'))
+    try:
+        import segscope
+    finally:
+        sys.path.pop(0)
+    return segscope
+
+
+# -------------------------------------------------------------- exemplars
+def test_exemplar_reservoir_slowest_first_with_bucket_labels():
+    h = Histogram('h', bounds=(1.0, 10.0, 100.0), window=64, exemplars=2)
+    h.observe(0.5, exemplar='aaaaaaaaaaaaaaaa')
+    h.observe(50.0, exemplar='bbbbbbbbbbbbbbbb')
+    h.observe(5.0, exemplar='cccccccccccccccc')
+    h.observe(500.0, exemplar='dddddddddddddddd')
+    ex = h.exemplars()
+    # slowest first; the top-k (k=2) keeps 500 and 50, stratification
+    # keeps the latest exemplar per bucket (0.5 -> le=1, 5 -> le=10)
+    assert [e['trace_id'] for e in ex[:2]] == ['dddddddddddddddd',
+                                              'bbbbbbbbbbbbbbbb']
+    by_tid = {e['trace_id']: e for e in ex}
+    assert by_tid['dddddddddddddddd']['le'] == '+Inf'
+    assert by_tid['bbbbbbbbbbbbbbbb']['le'] == '100'
+    assert by_tid['aaaaaaaaaaaaaaaa']['le'] == '1'
+    assert by_tid['cccccccccccccccc']['le'] == '10'
+    vals = [e['value'] for e in ex]
+    assert vals == sorted(vals, reverse=True)
+
+
+def test_exemplar_expires_with_the_window():
+    h = Histogram('h', bounds=(1.0,), window=8, exemplars=4)
+    h.observe(999.0, exemplar='ffffffffffffffff')
+    assert any(e['trace_id'] == 'ffffffffffffffff'
+               for e in h.exemplars())
+    for _ in range(8):        # roll the window right past the spike
+        h.observe(0.1)
+    assert h.exemplars() == []
+    snap = h.snapshot()
+    # the spike left the window, so quantiles no longer see it either
+    assert snap['exemplars'] == [] and max(snap['window']) == 0.1
+
+
+def test_exemplar_hammer_8_threads_window_invariant():
+    """8 writers x 2000 observes race a scraper: every exemplar a
+    snapshot ships must lie inside that same snapshot's window min/max,
+    the bucket counts always sum to the total, and the final count is
+    exact."""
+    reg = MetricsRegistry()
+    h = reg.histogram('hammer_ms', bounds=(10.0, 100.0, 1000.0),
+                      window=256, exemplars=6)
+    n_threads, n_obs = 8, 2000
+    stop = threading.Event()
+    bad = []
+
+    def writer(t):
+        for i in range(n_obs):
+            v = (t * n_obs + i) % 1999 + 0.5
+            h.observe(v, exemplar=f'{t:08x}{i:08x}')
+
+    def scraper():
+        while not stop.is_set():
+            snap = h.snapshot()
+            if sum(snap['counts']) != snap['count']:
+                bad.append(f'torn counts: {snap["counts"]} '
+                           f'!= {snap["count"]}')
+            if snap['window']:
+                lo, hi = min(snap['window']), max(snap['window'])
+                for e in snap['exemplars']:
+                    if not (lo <= e['value'] <= hi):
+                        bad.append(f'exemplar {e} outside window '
+                                   f'[{lo}, {hi}]')
+            render_prometheus(reg)          # must never crash mid-race
+
+    threads = [threading.Thread(target=writer, args=(t,))
+               for t in range(n_threads)]
+    s = threading.Thread(target=scraper)
+    s.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    s.join()
+    assert not bad, bad[:5]
+    assert h.count == n_threads * n_obs
+    final = h.snapshot()
+    assert sum(final['counts']) == n_threads * n_obs
+    lo, hi = min(final['window']), max(final['window'])
+    assert final['exemplars']
+    for e in final['exemplars']:
+        assert lo <= e['value'] <= hi
+
+
+def test_snapshot_quantiles_single_sort_consistency():
+    h = Histogram('h', bounds=(1.0,), window=128)
+    for v in (5.0, 1.0, 9.0, 3.0, 7.0):
+        h.observe(v)
+    snap = h.snapshot()
+    assert snap['quantiles'] == quantiles_of(sorted(snap['window']))
+    assert snap['quantiles'][0.5] == h.quantiles()[0.5] == 5.0
+
+
+def test_render_and_parse_exemplar_roundtrip():
+    reg = MetricsRegistry()
+    h = reg.histogram('serve_request_e2e_ms', bounds=(1.0, 100.0),
+                      exemplars=4)
+    h.observe(0.5, exemplar='00000000000000aa')
+    h.observe(42.0, exemplar='00000000000000bb')
+    h.observe(4242.0, exemplar='00000000000000cc')
+    text = render_prometheus(reg)
+    assert '# {trace_id="00000000000000cc"}' in text
+    # parse_prometheus must survive (and strip) the annotations
+    parsed = parse_prometheus(text)
+    by_le = {lab['le']: v for lab, v in
+             parsed['serve_request_e2e_ms_bucket']}
+    assert by_le == {'1': 1.0, '100': 2.0, '+Inf': 3.0}
+    ex = parse_exemplars(text)['serve_request_e2e_ms']
+    assert ex[0]['trace_id'] == '00000000000000cc'
+    assert ex[0]['value'] == pytest.approx(4242.0)
+    assert [e['value'] for e in ex] == sorted(
+        (e['value'] for e in ex), reverse=True)
+
+
+def test_registry_snapshot_carries_exemplars():
+    reg = MetricsRegistry()
+    h = reg.histogram('m_ms', exemplars=2)
+    h.observe(3.0, exemplar='00000000000000ee')
+    snap = reg.snapshot()
+    key = next(k for k in snap if k.startswith('m_ms'))
+    assert snap[key]['exemplars'][0]['trace_id'] == '00000000000000ee'
+
+
+# ---------------------------------------------------------- flight recorder
+def test_flight_ring_dump_and_traffic_mix(tmp_path):
+    sink = EventSink(os.path.join(str(tmp_path), 'events-h0.jsonl'))
+    fr = FlightRecorder(capacity=8, source='replica')
+    for i in range(12):
+        fr.record({'ts': 1000.0 + i, 'trace_id': f'{i:016x}',
+                   'status': 'ok', 'bucket': '64x64',
+                   'e2e_ms': 10.0 + i, 'deadline_ms': 100.0})
+    assert len(fr) == 8
+    snap = fr.snapshot()     # oldest first, the last 8 of 12
+    assert [r['e2e_ms'] for r in snap] == [14.0 + i for i in range(8)]
+    out = fr.dump('test', sink=sink)
+    assert out['records'] == 8 and out['source'] == 'replica'
+    assert [r['trace_id'] for r in out['dump_records']] \
+        == [f'{i:016x}' for i in range(4, 12)]
+    # the snapshot file sits next to the event log, replayable
+    assert os.path.basename(out['path']) \
+        == 'flight-replica-001-test.jsonl'
+    with open(out['path']) as f:
+        lines = [json.loads(x) for x in f]
+    assert lines == snap
+    # one flight_dump event reached the sink, traffic_mix attached
+    sink.close()
+    with open(sink.path) as f:
+        evs = [json.loads(x) for x in f if x.strip()]
+    dumps = [e for e in evs if e.get('event') == 'flight_dump']
+    assert len(dumps) == 1 and dumps[0]['reason'] == 'test'
+    mix = dumps[0]['traffic_mix']
+    assert mix['total'] == 8
+    b = mix['buckets']['64x64']
+    assert b['count'] == 8 and b['share'] == 1.0
+    assert b['e2e_p99_ms'] == 21.0 and b['deadline_p50_ms'] == 100.0
+
+
+def test_traffic_mix_multi_bucket_shares():
+    recs = ([{'ts': 100.0 + i, 'bucket': 'a', 'e2e_ms': 1.0}
+             for i in range(3)]
+            + [{'ts': 103.0, 'bucket': 'b', 'e2e_ms': 9.0,
+                'deadline_ms': 50.0}])
+    mix = traffic_mix(recs)
+    assert mix['total'] == 4 and mix['span_s'] == 3.0
+    assert mix['buckets']['a']['share'] == 0.75
+    assert mix['buckets']['a']['rps'] == 1.0
+    assert mix['buckets']['b']['deadline_p50_ms'] == 50.0
+
+
+def test_dump_all_is_best_effort_across_recorders(tmp_path):
+    sink = EventSink(os.path.join(str(tmp_path), 'events-h0.jsonl'))
+    a = FlightRecorder(capacity=4, source='router')
+    b = FlightRecorder(capacity=4, source='replica')
+    a.record({'ts': 1.0, 'trace_id': 'a' * 16, 'e2e_ms': 1.0})
+    b.record({'ts': 2.0, 'trace_id': 'b' * 16, 'e2e_ms': 2.0})
+    # a recorder whose dump explodes must not stop the others
+    class Broken(FlightRecorder):
+        def dump(self, reason, sink=None, extra=None):
+            raise RuntimeError('boom')
+    broken = Broken(capacity=2, source='replica')
+    import rtseg_tpu.obs.core as core
+    old = core.get_sink()
+    core.set_sink(sink)
+    try:
+        dumps = dump_all('stall')
+    finally:
+        core.set_sink(old)
+    del broken
+    ours = [d for d in dumps
+            if any(r.get('trace_id') in ('a' * 16, 'b' * 16)
+                   for r in d['dump_records'])]
+    assert len(ours) == 2
+    assert {d['reason'] for d in ours} == {'stall'}
+    assert {d['source'] for d in ours} == {'router', 'replica'}
+
+
+# ------------------------------------------------------------ trace assembly
+_TID = '4fe2a1b09c3d5e67'
+
+
+def _write_jsonl(path, records):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, 'w') as f:
+        for r in records:
+            f.write(json.dumps(r) + '\n')
+
+
+def _fleet_fixture(root, tid=_TID):
+    """A fleet obs root: router hop at the root, replica events in a
+    replica-r0/ subdir — timings chosen so every attribution row is a
+    distinct pinned number."""
+    _write_jsonl(os.path.join(root, 'events-router.jsonl'), [
+        {'ts': 10.0, 'event': 'run_start'},
+        {'ts': 10.5, 'event': 'hop', 'trace_id': tid, 'status': 'ok',
+         'group': 'fleet', 'version': 'v1', 'replica': 'r0',
+         'attempts': 1, 'e2e_ms': 25.0, 'upstream_ms': 23.0},
+    ])
+    _write_jsonl(os.path.join(root, 'replica-r0', 'events-r0.jsonl'), [
+        {'ts': 10.1, 'event': 'ingress', 'trace_id': tid,
+         'bucket': '64x64', 'decode_ms': 0.5},
+        {'ts': 10.2, 'event': 'batch', 'traces': [tid, 'f' * 16],
+         'size': 2, 'wait_ms': 1.5},
+        {'ts': 10.4, 'event': 'request', 'trace_id': tid,
+         'status': 'ok', 'bucket': '64x64', 'e2e_ms': 20.0,
+         'decode_ms': 0.5, 'queue_ms': 2.0, 'assemble_ms': 1.0,
+         'device_ms': 15.0, 'post_ms': 1.0},
+    ])
+
+
+def test_trace_assembly_golden_rows_sum_exactly_to_e2e(tmp_path):
+    root = str(tmp_path / 'obs')
+    _fleet_fixture(root)
+    events = load_trace([root], _TID)
+    # ts order: replica ingress/batch (via its traces list)/request,
+    # then the router's hop, written when the reply finished
+    assert [e['event'] for e in events] == ['ingress', 'batch',
+                                            'request', 'hop']
+    tl = assemble(events, _TID)
+    assert tl['anchor'] == 'router' and tl['status'] == 'ok'
+    assert tl['e2e_ms'] == 25.0
+    got = [(r['hop'], r['stage'], r['ms']) for r in tl['rows']]
+    assert got == [
+        ('router', 'router admit+route', 2.0),    # 25 - 23 upstream
+        ('router', 'network + http (gap)', 3.0),  # 23 - 20 replica e2e
+        ('replica', 'replica decode', 0.5),
+        ('replica', 'replica queue', 2.0),
+        ('replica', 'assemble', 1.0),
+        ('replica', 'device', 15.0),
+        ('replica', 'post', 1.0),
+        ('router', 'unattributed residue', 0.5),
+    ]
+    assert sum(r['ms'] for r in tl['rows']) == tl['e2e_ms']
+    assert tl['residue_ms'] == 0.5
+    assert len(tl['sources']) == 2          # router + replica sink files
+    assert tl['route'] == {'group': 'fleet', 'version': 'v1',
+                           'replica': 'r0', 'attempts': 1}
+    assert tl['bucket'] == '64x64'
+    assert tl['batch'] == {'size': 2, 'wait_ms': 1.5}
+    text = format_timeline(tl)
+    assert 'unattributed residue' in text and '25.000' in text
+    assert 'replica-r0' in text
+
+
+def test_trace_replica_anchor_without_hop(tmp_path):
+    root = str(tmp_path / 'obs')
+    _write_jsonl(os.path.join(root, 'events-0.jsonl'), [
+        {'ts': 1.0, 'event': 'request', 'trace_id': _TID,
+         'status': 'ok', 'e2e_ms': 8.0, 'queue_ms': 1.0,
+         'device_ms': 6.0},
+    ])
+    tl = assemble_trace([root], _TID)
+    assert tl['anchor'] == 'replica' and tl['e2e_ms'] == 8.0
+    assert tl['rows'][-1]['stage'] == 'unattributed residue'
+    assert sum(r['ms'] for r in tl['rows']) == 8.0
+
+
+def test_trace_flight_records_fill_in_for_lost_sinks(tmp_path):
+    """A router flight snapshot alone (event log gone) still yields a
+    router-anchored timeline; a live hop outranks its flight duplicate."""
+    root = str(tmp_path / 'obs')
+    _write_jsonl(os.path.join(root, 'flight-router-001-stall.jsonl'), [
+        {'ts': 5.0, 'trace_id': _TID, 'status': 'ok',
+         'e2e_ms': 12.0, 'upstream_ms': 10.0},
+    ])
+    events = load_trace([root], _TID)
+    assert events[0]['event'] == 'hop' and events[0]['_flight']
+    tl = assemble(events, _TID)
+    assert tl['anchor'] == 'router' and tl['e2e_ms'] == 12.0
+    # now add a live hop with a different e2e: it must win the anchor
+    _write_jsonl(os.path.join(root, 'events-r.jsonl'), [
+        {'ts': 5.0, 'event': 'hop', 'trace_id': _TID, 'status': 'ok',
+         'e2e_ms': 13.0, 'upstream_ms': 10.0},
+    ])
+    tl2 = assemble_trace([root], _TID)
+    assert tl2['e2e_ms'] == 13.0
+
+
+def test_find_sink_files_recurses_and_dedupes(tmp_path):
+    root = str(tmp_path / 'obs')
+    _fleet_fixture(root)
+    _write_jsonl(os.path.join(root, 'flight-replica-001-x.jsonl'), [])
+    files = find_sink_files([root, root])
+    assert len(files) == 3
+    assert any('replica-r0' in f for f in files)
+
+
+def test_segscope_trace_cli_exit_codes(tmp_path, capsys):
+    segscope = _segscope()
+    root = str(tmp_path / 'obs')
+    _fleet_fixture(root)
+    assert segscope.main(['trace', _TID, root]) == 0
+    out = capsys.readouterr().out
+    assert 'router admit+route' in out and 'unattributed residue' in out
+    assert segscope.main(['trace', _TID, root, '--json']) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc['e2e_ms'] == 25.0
+    # unknown id -> exit 2 with a stderr note, nothing on stdout
+    assert segscope.main(['trace', 'e' * 16, root]) == 2
+    captured = capsys.readouterr()
+    assert 'no events carry trace id' in captured.err
+
+
+# -------------------------------------------------------- live plane pieces
+def test_sink_tailer_counts_flight_dumps_and_exemplars(tmp_path):
+    import time
+    d = str(tmp_path / 'obs')
+    os.makedirs(d)
+    base = time.time()
+    with open(os.path.join(d, 'events-0.jsonl'), 'w') as f:
+        f.write(json.dumps({'ts': base - 9.0,
+                            'event': 'run_start'}) + '\n')
+        for i in range(4):
+            f.write(json.dumps(
+                {'ts': base - 8.0 + i, 'event': 'request',
+                 'status': 'ok', 'trace_id': f'{i:016x}',
+                 'e2e_ms': 10.0 * (i + 1), 'device_ms': 5.0}) + '\n')
+        f.write(json.dumps(
+            {'ts': base - 1.0, 'event': 'flight_dump',
+             'reason': 'slo_breach', 'source': 'replica',
+             'records': 4, 'path': None}) + '\n')
+    frame = SinkTailer(d, window_s=300.0).poll()
+    assert frame['flight'] == {'dumps': 1,
+                               'last': {'reason': 'slo_breach',
+                                        'source': 'replica',
+                                        'records': 4, 'path': None}}
+    ex = frame['serving']['exemplars']
+    assert ex[0]['trace_id'] == '0000000000000003'   # slowest first
+    assert ex[0]['value'] == 40.0
+    text = format_frame(frame)
+    assert 'p99 exemplars' in text and '0000000000000003' in text
+    assert 'flight dumps' in text and 'slo_breach' in text
+
+
+def test_loadgen_finalize_slowest_ranked_and_capped():
+    from rtseg_tpu.serve.loadgen import _SLOWEST_N, _finalize
+    lat = [float(i) for i in range(1, 21)]
+    slow = [{'trace_id': f'{i:016x}', 'e2e_ms': float(i)}
+            for i in range(1, 21)]
+    report = _finalize({'mode': 'http', 'requests': 20,
+                        'rps_target': 100.0}, lat, {}, 20, 0, 0, 0,
+                       1.0, slowest=slow)
+    got = report['slowest']
+    assert len(got) == _SLOWEST_N
+    assert [r['e2e_ms'] for r in got] == [float(v) for v in
+                                          range(20, 12, -1)]
+    from rtseg_tpu.serve.loadgen import format_report
+    assert got[0]['trace_id'] in format_report(report)

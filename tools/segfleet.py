@@ -19,7 +19,10 @@ Usage:
     # methodology"): 2 warm replicas behind the router; baseline one
     # replica's capacity, drive the fleet open-loop, SIGKILL a replica
     # mid-bench (retries must absorb it: 0 errors), drain one mid-burst
-    # (0 drops), reconcile router-vs-replica /metrics exactly
+    # (0 drops), reconcile router-vs-replica /metrics exactly, then
+    # (phase D, segtail) trigger flight dumps on the router + replicas,
+    # reconcile the router ring against the loadgen's slowest trace
+    # ids, and assemble one cross-plane trace timeline that sums to e2e
     python tools/segfleet.py bench --replicas 2 --buckets 64x64 \
         --batch 4 --check
 
@@ -218,8 +221,12 @@ def cmd_bench(args) -> int:
     args.models = f'fleet={args.model}:{args.replicas}'
     # min starts at 1: the first replica populates the shared segwarm
     # cache, then scale_to fans out the rest as warm starts — the
-    # spin-up numbers in the report show the cold/warm split honestly
-    group = ReplicaGroup('fleet', make_spawn_cmd(args, args.model),
+    # spin-up numbers in the report show the cold/warm split honestly.
+    # obs_root gives every replica its own sink subdir so phase D can
+    # assemble a cross-plane trace over the whole fleet obs root.
+    group = ReplicaGroup('fleet',
+                         make_spawn_cmd(args, args.model,
+                                        obs_root=obs_dir),
                          min_replicas=1,
                          max_replicas=args.replicas)
     manager = FleetManager([group], run_dir=args.run_dir,
@@ -359,6 +366,89 @@ def cmd_bench(args) -> int:
         if exit_code != 0:
             problems.append(f'drained replica exit code {exit_code} '
                             f'(want 0)')
+
+        # ---- phase D: segtail flight forensics — drive a light burst
+        # with zero client-visible errors, trigger a flight dump on the
+        # router and every live replica, reconcile the router's dumped
+        # records against the loadgen's slowest trace ids, then prove
+        # the slowest request assembles into a cross-plane timeline
+        # whose rows sum exactly to the router-recorded e2e
+        from rtseg_tpu.obs.live import trigger_flight
+        from rtseg_tpu.obs.trail import assemble, load_trace
+        d_rps = args.drain_rps or round(max(4.0, 0.4 * c1), 1)
+        phase_d = bench_http(url, payloads, args.flight_requests,
+                             d_rps, seed=args.seed + 4)
+        report['flight_bench'] = phase_d
+        if phase_d['errors'] or phase_d['ok'] != args.flight_requests:
+            problems.append(
+                f'flight phase not clean: {phase_d["ok"]}/'
+                f'{args.flight_requests} ok, '
+                f'{phase_d["errors"]} errors')
+        live = [r for r in replicas if r.state == 'ready']
+        dumps = []
+        for u in [url] + [r.url for r in live]:
+            try:
+                dumps.append(trigger_flight(u,
+                                            reason='bench_forensics'))
+            except OSError as e:
+                problems.append(f'flight trigger {u}: {e}')
+        report['flight'] = {
+            'dumps': len(dumps),
+            'records': sum(d.get('records', 0) for d in dumps),
+            'sources': sorted({str(d.get('source')) for d in dumps})}
+        print(f'  flight dumps   : {len(dumps)} '
+              f'({", ".join(report["flight"]["sources"])}) — '
+              f'{report["flight"]["records"]} records after a clean '
+              f'{phase_d["ok"]}/{args.flight_requests} burst',
+              flush=True)
+        if not dumps:
+            problems.append('no flight dump answered the trigger')
+        # every phase-D slowest trace id must be in the router's ring:
+        # the 512-slot ring holds more than every request the router
+        # has forwarded this bench (phases A-D total < 512)
+        slowest = phase_d.get('slowest') or []
+        router_dump = next((d for d in dumps
+                            if d.get('source') == 'router'), None)
+        dumped_tids = {r.get('trace_id') for r in
+                       (router_dump or {}).get('dump_records', ())}
+        missing = [s['trace_id'] for s in slowest
+                   if s.get('trace_id') not in dumped_tids]
+        if router_dump is None:
+            problems.append('router answered no flight dump')
+        elif missing:
+            problems.append(f'flight ring missing loadgen slowest '
+                            f'trace ids: {missing}')
+        else:
+            print(f'  flight recon   : all {len(slowest)} slowest '
+                  f'loadgen trace ids present in the router dump '
+                  f'({len(dumped_tids)} ring records)', flush=True)
+        if slowest:
+            tid = slowest[0]['trace_id']
+            tl = assemble(load_trace([obs_dir], tid), tid)
+            if tl is None:
+                problems.append(f'segscope trace: no timeline for '
+                                f'slowest trace id {tid}')
+            else:
+                rows_ms = sum(r['ms'] for r in tl['rows'])
+                gap = abs(rows_ms - tl['e2e_ms'])
+                report['trace'] = {
+                    'trace_id': tid, 'anchor': tl['anchor'],
+                    'e2e_ms': tl['e2e_ms'],
+                    'rows': len(tl['rows']),
+                    'residue_ms': tl['residue_ms'],
+                    'sources': tl['sources']}
+                print(f'  trace timeline : {tid} — {len(tl["rows"])} '
+                      f'rows sum {rows_ms:.3f}ms == anchor '
+                      f'{tl["anchor"]} e2e {tl["e2e_ms"]:.3f}ms '
+                      f'across {len(tl["sources"])} sinks', flush=True)
+                if gap > 0.01:
+                    problems.append(
+                        f'trace rows sum {rows_ms:.3f} != e2e '
+                        f'{tl["e2e_ms"]:.3f} for {tid}')
+                if len(tl['sources']) < 2:
+                    problems.append(
+                        f'trace {tid} did not span router + replica '
+                        f'sinks: {tl["sources"]}')
     finally:
         if router is not None:
             router.shutdown()
@@ -397,7 +487,9 @@ def cmd_bench(args) -> int:
               f'absorbed {report["kill"]["ok"]}/{args.kill_requests} | '
               f'drain clean {report["drain"]["ok"]}/'
               f'{args.drain_requests}, exit 0 | exact /metrics '
-              f'reconciliation | {report["wall_s"]}s', flush=True)
+              f'reconciliation | flight '
+              f'{report.get("flight", {}).get("dumps", 0)} dumps, '
+              f'trace rows == e2e | {report["wall_s"]}s', flush=True)
     return 0
 
 
@@ -474,6 +566,10 @@ def main(argv=None) -> int:
     bp.add_argument('--drain-requests', type=int, default=64)
     bp.add_argument('--drain-rps', type=float, default=None,
                     help='phase C rate (default: 0.4 x probed capacity)')
+    bp.add_argument('--flight-requests', type=int, default=48,
+                    help='phase D (segtail forensics) burst size; keep '
+                         'phases A-D under the 512-slot flight ring so '
+                         'the dump-vs-loadgen reconciliation is exact')
     bp.add_argument('--p95-ms', type=float, default=5000.0)
     bp.add_argument('--seed', type=int, default=0)
     bp.add_argument('--obs-dir', default=None)

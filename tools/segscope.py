@@ -43,6 +43,16 @@ Usage:
     python tools/segscope.py live http://127.0.0.1:8080 --interval 2
     python tools/segscope.py live http://host:8080 --once --check \
         --p99-ms 500                                    # CI gate
+    python tools/segscope.py live http://router:8080 --check --p99-ms 200 \
+        --flight-on-breach http://router:8080           # breach -> dump
+
+    # segtail: cross-plane forensics for ONE trace id — join the router's
+    # hop accounting, the replica's ingress/batch/request events, stream
+    # frame events and any flight-recorder snapshots across one or more
+    # sink dirs into a causally-ordered, gap-attributed timeline whose
+    # rows sum exactly to the recorded e2e (explicit residue row)
+    python tools/segscope.py trace 4fe2a1b09c3d5e67 fleet-obs/
+    python tools/segscope.py trace <id> router-obs/ replica-obs/ --json
 
 Metric definitions live in rtseg_tpu/obs/report.py and BENCHMARKS.md
 ("Goodput"). `report` summarizes the segment after the last run_start
@@ -64,10 +74,13 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from rtseg_tpu.obs.live import (MetricsPoller, SinkTailer,    # noqa: E402
-                                check_frame, format_frame)
+                                check_frame, format_frame,
+                                trigger_flight)
 from rtseg_tpu.obs.report import (diff_rows, diff_table,      # noqa: E402
                                   format_summary, load_events,
                                   load_roofline, summarize)
+from rtseg_tpu.obs.trail import (assemble, format_timeline,   # noqa: E402
+                                 load_trace)
 
 
 def _run_live(args) -> int:
@@ -75,7 +88,7 @@ def _run_live(args) -> int:
         source = MetricsPoller(args.target)
     else:
         source = SinkTailer(args.target, window_s=args.window)
-    first = True
+    breach_fired = False
     while True:
         try:
             frame = source.poll()
@@ -91,6 +104,21 @@ def _run_live(args) -> int:
         if args.check:
             problems = check_frame(frame, p99_ms=args.p99_ms,
                                    max_hbm_bytes=args.max_hbm_bytes)
+            if problems and args.flight_on_breach and not breach_fired:
+                # segtail: an SLO breach is the live poller's flight
+                # trigger — dump each target's recorder once per breach
+                # episode (re-armed when a frame comes back clean)
+                breach_fired = True
+                for u in args.flight_on_breach:
+                    try:
+                        dump = trigger_flight(u, reason='slo_breach')
+                        print(f'  FLIGHT: dumped {dump.get("records")} '
+                              f'records from {u} '
+                              f'({dump.get("source")})', flush=True)
+                    except OSError as e:
+                        print(f'  FLIGHT: {u}: {e}', file=sys.stderr)
+            elif not problems:
+                breach_fired = False
             if problems:
                 # a transient empty first frame is not a failure while
                 # following; only --once treats it as terminal
@@ -153,6 +181,22 @@ def main(argv=None) -> int:
                     help='--check peak device memory threshold (bytes, '
                          'from the device_memory_bytes gauges / memory '
                          'events)')
+    lp.add_argument('--flight-on-breach', action='append', default=None,
+                    metavar='URL',
+                    help='POST /debug/flight to this replica/router URL '
+                         'when --check detects an SLO breach (repeat for '
+                         'several targets; fires once per breach episode)')
+
+    tp = sub.add_parser('trace', help='segtail: cross-plane timeline '
+                                      'for one trace id')
+    tp.add_argument('trace_id', help='16-hex trace id (from X-Trace-Id, '
+                                     'a bench report\'s slowest list, or '
+                                     'a p99 exemplar)')
+    tp.add_argument('dirs', nargs='+',
+                    help='sink dirs to search recursively (a fleet obs '
+                         'root covers the router + replica-*/ subdirs)')
+    tp.add_argument('--json', action='store_true',
+                    help='machine-readable timeline')
     args = ap.parse_args(argv)
 
     try:
@@ -161,6 +205,19 @@ def main(argv=None) -> int:
                 return _run_live(args)
             except KeyboardInterrupt:
                 return 0
+        if args.cmd == 'trace':
+            events = load_trace(args.dirs, args.trace_id)
+            tl = assemble(events, args.trace_id) if events else None
+            if tl is None:
+                print(f'segscope trace: no events carry trace id '
+                      f'{args.trace_id} under '
+                      + ', '.join(args.dirs), file=sys.stderr)
+                return 2
+            if args.json:
+                print(json.dumps(tl, indent=2, default=str))
+            else:
+                print(format_timeline(tl))
+            return 0
         if args.cmd == 'report':
             events = load_events(args.path, last_run=not args.all_runs)
             roofline = (load_roofline(args.roofline)
