@@ -38,6 +38,7 @@ from .concurrency import (build_lockgraph, check_concurrency,
                           update_lockgraph)
 from .lockgraph import LockGraph
 from .contracts import check_contracts, update_contracts
+from .failpath import check_failpath, update_failpath
 # audit modules defer their jax imports to call time, so importing the
 # package stays jax-free
 from .recompile import (PIN_ATTRS, RecompileError, RecompileGuard,
@@ -63,6 +64,7 @@ __all__ = [
     'check_concurrency', 'build_lockgraph', 'update_lockgraph',
     'LockGraph',
     'check_contracts', 'update_contracts',
+    'check_failpath', 'update_failpath',
     'PIN_ATTRS', 'RecompileError', 'RecompileGuard', 'guard_step',
     'introspectable',
     'AuditResult', 'audit_model', 'audit_zoo', 'zoo_variants',

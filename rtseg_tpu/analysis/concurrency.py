@@ -147,6 +147,25 @@ class Access:
 
 
 @dataclass
+class CallSite:
+    """One call expression observed during the lock-set walk — the shared
+    record the failpath auditor's hot-lock pass consumes (which blocking
+    calls run while which locks are held). ``held`` is the simulated
+    lock set at the site; receiver metadata lets the consumer resolve
+    file/queue/thread attrs without re-walking."""
+    sf: SourceFile
+    line: int
+    name: str                 # dotted call target ('' if unresolvable)
+    held: FrozenSet[str]
+    ctx: str
+    recv_attr: Optional[str]  # 'x' for a self.x.<method>() receiver
+    recv_is_lock: bool        # receiver resolves to a tracked lock/cond
+    recv_is_const: bool       # receiver is a literal (', '.join(...))
+    n_args: int
+    ci: Optional['ClassInfo']
+
+
+@dataclass
 class ClassInfo:
     sf: SourceFile
     node: ast.ClassDef
@@ -154,6 +173,8 @@ class ClassInfo:
     safe_attrs: Set[str] = field(default_factory=set)
     thread_attrs: Set[str] = field(default_factory=set)
     container_attrs: Set[str] = field(default_factory=set)
+    file_attrs: Set[str] = field(default_factory=set)    # open()/os.open()
+    queue_attrs: Set[str] = field(default_factory=set)   # Queue family
     methods: Dict[str, ast.FunctionDef] = field(default_factory=dict)
     entry_methods: Set[str] = field(default_factory=set)
     handler_base: bool = False
@@ -269,6 +290,11 @@ def _extract_module(sf: SourceFile) -> ModuleInfo:
                                 ci.safe_attrs.add(attr)
                             if seg in _THREAD_FACTORIES:
                                 ci.thread_attrs.add(attr)
+                            if seg == 'open':
+                                ci.file_attrs.add(attr)
+                            if seg in ('Queue', 'LifoQueue',
+                                       'PriorityQueue', 'SimpleQueue'):
+                                ci.queue_attrs.add(attr)
                         if _is_container_value(v):
                             ci.container_attrs.add(attr)
         ci.entry_methods = {
@@ -387,6 +413,7 @@ class _Analysis:
         self.summaries = _summaries(mods)
         self._bare_cache: Dict[str, Set[str]] = {}
         self.raw_findings: List[Tuple[SourceFile, int, str]] = []
+        self.call_sites: List[CallSite] = []
         for mod in mods:
             for lock_id in mod.mod_locks.values():
                 self.graph.add_node(lock_id)
@@ -623,6 +650,18 @@ class _Analysis:
     def _scan_call(self, node: ast.Call, held: Set[str], ctx: str,
                    func_key: str, ci, mod, stack) -> None:
         f = node.func
+        # shared call-site record (failpath's hot-lock pass): the held
+        # set is captured BEFORE this call's own acquire/release effects
+        if isinstance(f, (ast.Attribute, ast.Name)):
+            recv = f.value if isinstance(f, ast.Attribute) else None
+            self.call_sites.append(CallSite(
+                sf=mod.sf, line=node.lineno,
+                name=dotted_name(f) or '', held=frozenset(held), ctx=ctx,
+                recv_attr=_self_attr(recv) if recv is not None else None,
+                recv_is_lock=(recv is not None and _resolve_lock(
+                    recv, ci, mod) is not None),
+                recv_is_const=isinstance(recv, ast.Constant),
+                n_args=len(node.args) + len(node.keywords), ci=ci))
         if isinstance(f, ast.Attribute):
             m = f.attr
             lock = _resolve_lock(f.value, ci, mod)

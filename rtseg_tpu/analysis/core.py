@@ -27,8 +27,10 @@ RULE_OBS = 'obs-purity'
 RULE_WARM = 'warm-key'
 RULE_CONCURRENCY = 'concurrency'
 RULE_CONTRACTS = 'contracts'
+RULE_FAILPATH = 'failpath'
 ALL_RULES = (RULE_IMPORTS, RULE_REGISTRY, RULE_TRACE, RULE_EVIDENCE,
-             RULE_OBS, RULE_WARM, RULE_CONCURRENCY, RULE_CONTRACTS)
+             RULE_OBS, RULE_WARM, RULE_CONCURRENCY, RULE_CONTRACTS,
+             RULE_FAILPATH)
 
 #: deep (jaxpr/HLO-level) rule identifiers — the segaudit family. These
 #: trace and compile the real step artifacts instead of walking source
@@ -157,6 +159,7 @@ def run_lints(root: Optional[str] = None,
     from .lint_warm import check_warm_key_coverage
     from .concurrency import check_concurrency
     from .contracts import check_contracts
+    from .failpath import check_failpath
     table: Dict[str, Callable[..., List[Finding]]] = {
         RULE_IMPORTS: check_import_hygiene,
         RULE_REGISTRY: check_registry_consistency,
@@ -166,6 +169,7 @@ def run_lints(root: Optional[str] = None,
         RULE_WARM: check_warm_key_coverage,
         RULE_CONCURRENCY: check_concurrency,
         RULE_CONTRACTS: check_contracts,
+        RULE_FAILPATH: check_failpath,
     }
     root = root or repo_root()
     selected = list(rules) if rules is not None else list(ALL_RULES)

@@ -1,0 +1,955 @@
+"""Rule `failpath`: static failure-path, resource-lifecycle and
+hot-lock audit of the threaded runtime planes — segfail.
+
+segtail (PR 18) tells you which request hit p99 after the fact; this
+rule proves at lint time that no code path can eat the error or block
+the hot path that gets it there. Three passes, all pure stdlib ``ast``
+over the same TARGET_PREFIXES as segrace (concurrency.py), whose
+extraction (thread-entry discovery, lock-set-on-path walk) is reused
+rather than re-implemented:
+
+1. **exception-flow** — a thread run-loop or callback that dies
+   silently is the worst failure mode a concurrent plane has: the
+   default ``threading`` behavior prints to stderr nobody tails and the
+   plane just stops. Every concurrent entry point (``Thread``/``Timer``
+   targets and ``add_done_callback`` callbacks — ``executor.submit``
+   functions are excluded because their exception lands in the Future a
+   joiner observes) must route risky calls through ``try`` protection,
+   and every *broad* ``except`` (bare / ``Exception`` /
+   ``BaseException``) in a runtime plane must do something with what it
+   caught: assign a fallback, count it, log it, emit it, re-raise,
+   return, or break. A handler whose body is only ``pass``/``continue``
+   swallows the failure with no side channel and is a finding.
+
+2. **resource-lifecycle** — acquired resources must reach release on
+   all paths: a local ``open()``/``Popen``/``socket``/
+   ``TemporaryDirectory`` must be released in a ``finally`` (or used as
+   a ``with`` item, or ownership handed off); a field-held resource
+   needs an owner release method that references it; every attr-stored
+   thread needs a reachable stop-family method that joins or cancels
+   it; a spawned thread whose target loops ``while True`` with no
+   ``break``/``return`` can never be stopped; and every
+   ``Queue``/``deque`` in a runtime plane must be explicitly bounded
+   (``maxsize``/``maxlen``) — unbounded buffering is how overload
+   becomes latency collapse.
+
+3. **hot-lock** — reusing segrace's simulated held-lock sets (the
+   shared :class:`~rtseg_tpu.analysis.concurrency.CallSite` records),
+   any blocking call — file/socket I/O, subprocess, sleep, thread
+   join, ``jax.device_get``/``block_until_ready``, json/pickle
+   dump/load, sink emit — executed while holding a lock that lives in
+   the serve/obs/stream/fleet hot planes is a finding. The flight
+   recorder's snapshot-under-the-lock-write-outside shape (PR 18) is
+   the sanctioned alternative and the fix the message prescribes.
+
+The observed census (audited entry points, bounded-buffer sites, hot
+locks, per-pass suppression counts) is pinned in the committed
+**SEGFAIL.json** sidecar, house style SEGRACE/SEGCONTRACT: any drift in
+either direction is a finding until reviewed and re-pinned with
+``tools/segcheck.py --update-failpath``, re-pinning refuses while the
+tree still has unsuppressed findings (the sidecar pins a *coherent*
+failure-path discipline, it never grandfathers a live hazard), and the
+suppression budget only goes down.
+
+Known conservatisms, by design: multiprocessing ``Process`` targets are
+not exception-flow entries (a dead child has an exitcode the parent can
+check); ``join`` on anything but a tracked thread attr is not
+classified blocking (``', '.join`` and ``os.path.join`` share the
+name); logging calls under a lock are not flagged (rare, and the
+logging module buffers); protection is any enclosing ``try`` with a
+handler — matching handler *types* to raised types statically is not
+attempted, the swallow pass owns handler quality instead.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .concurrency import (ClassInfo, ModuleInfo, _call_last_seg,
+                          _self_attr, analyze)
+from .core import Finding, RULE_FAILPATH, SourceFile
+from .walker import dotted_name, index_functions
+
+#: the committed sidecar, repo-root relative
+SEGFAIL_FILE = 'SEGFAIL.json'
+
+#: pass names — sidecar suppression-budget keys and finding taxonomy
+P_EXC = 'exception-flow'
+P_RES = 'resource-lifecycle'
+P_LOCK = 'hot-lock'
+PASSES = (P_EXC, P_RES, P_LOCK)
+
+#: spawn wrappers whose passed callables die silently on an unhandled
+#: exception (submit is excluded: the Future captures and a joiner sees)
+_THREAD_SPAWNERS = frozenset({'Thread', 'Timer', 'add_done_callback'})
+
+#: lock-id prefixes of the latency-critical planes for pass 3
+_HOT_PREFIXES = ('rtseg_tpu/serve/', 'rtseg_tpu/obs/',
+                 'rtseg_tpu/stream/', 'rtseg_tpu/fleet/')
+
+#: call last-segments that cannot raise in practice inside a run loop —
+#: pure builtins, sync primitives, container/str ops, time sources, and
+#: the sanctioned record-keeping side channels themselves
+_SAFE_LAST_SEGS = frozenset({
+    # pure builtins / converters
+    'len', 'range', 'sorted', 'reversed', 'min', 'max', 'sum', 'abs',
+    'round', 'int', 'float', 'str', 'bool', 'bytes', 'list', 'dict',
+    'set', 'tuple', 'frozenset', 'repr', 'format', 'id', 'hash',
+    'print', 'isinstance', 'issubclass', 'enumerate', 'zip', 'map',
+    'filter', 'getattr', 'hasattr', 'setattr', 'vars', 'type', 'super',
+    # time sources
+    'monotonic', 'time', 'perf_counter', 'perf_counter_ns',
+    'monotonic_ns', 'sleep',
+    # sync primitives / thread introspection
+    'wait', 'wait_for', 'notify', 'notify_all', 'acquire', 'release',
+    'locked', 'is_set', 'clear', 'is_alive', 'current_thread', 'join',
+    # container ops (Queue.get/put block but do not raise)
+    'append', 'appendleft', 'pop', 'popleft', 'extend', 'remove',
+    'discard', 'insert', 'add', 'update', 'setdefault', 'get', 'put',
+    'keys', 'values', 'items', 'copy', 'count', 'index', 'qsize',
+    'task_done',
+    # str ops
+    'startswith', 'endswith', 'strip', 'lstrip', 'rstrip', 'split',
+    'rsplit', 'splitlines', 'lower', 'upper', 'encode', 'decode',
+    'replace', 'partition', 'ljust', 'rjust', 'zfill',
+    # sanctioned side channels: recording a failure must never itself
+    # count as a new failure path
+    'debug', 'info', 'warning', 'error', 'exception', 'log', 'emit',
+    'inc', 'dec', 'observe', 'record', 'set_exception', 'set_result',
+})
+
+#: constructors that acquire a releasable resource (pass 2a/2b); the
+#: value names the expected release family in messages
+_ACQUIRE_FACTORIES = {
+    'open': 'close', 'Popen': 'terminate/kill/wait',
+    'socket': 'close', 'create_connection': 'close',
+    'socketpair': 'close', 'TemporaryDirectory': 'cleanup',
+}
+
+#: method names that release a resource when called on it
+_RELEASE_METHODS = frozenset({'close', 'cleanup', 'terminate', 'kill',
+                              'wait', 'communicate', 'stop', 'shutdown',
+                              'unlink', '__exit__'})
+
+#: owner methods expected to release field-held resources / threads
+_OWNER_RELEASE = frozenset({'close', 'stop', 'shutdown', 'cleanup',
+                            'terminate', 'join', 'cancel', '__exit__',
+                            '__del__'})
+
+#: bounded-buffer constructors (pass 2e) — SimpleQueue has no maxsize
+_BUFFER_CTORS = frozenset({'Queue', 'LifoQueue', 'PriorityQueue',
+                           'SimpleQueue', 'deque'})
+
+#: call last-segments that always block (pass 3), with the reason
+_ALWAYS_BLOCKING = {
+    'sleep': 'sleeps', 'urlopen': 'network I/O',
+    'Popen': 'process spawn', 'check_call': 'subprocess',
+    'check_output': 'subprocess', 'communicate': 'subprocess I/O',
+    'device_get': 'device sync', 'block_until_ready': 'device sync',
+    'getresponse': 'network I/O', 'recv': 'socket I/O',
+    'sendall': 'socket I/O', 'accept': 'socket accept',
+    'connect': 'socket connect', 'result': 'future wait',
+    'emit': 'sink write',
+}
+
+#: dotted call names that always block (file/OS I/O)
+_DOTTED_BLOCKING = frozenset({
+    'json.dump', 'json.load', 'pickle.dump', 'pickle.load',
+    'os.replace', 'os.rename', 'os.makedirs', 'os.fsync', 'os.write',
+    'os.read', 'np.save', 'np.load', 'numpy.save', 'numpy.load',
+    'subprocess.run', 'shutil.rmtree', 'shutil.copytree', 'time.sleep',
+})
+
+#: file-handle methods that block when the receiver is a held file attr
+_FILE_BLOCKING = frozenset({'write', 'flush', 'read', 'readline',
+                            'readlines', 'seek', 'fsync'})
+
+#: (SourceFile|None, path, line, pass, message)
+_RawFinding = Tuple[Optional[SourceFile], str, int, str, str]
+
+
+# ------------------------------------------------------ pass 1a: entries
+def _discover_entries(mods: List[ModuleInfo]
+                      ) -> Dict[str, Tuple[SourceFile, ast.AST]]:
+    """Concurrent entry points whose exceptions vanish by default:
+    Thread/Timer targets and done-callbacks, resolved to their defs
+    (class methods, module functions, nested closures) by bare name."""
+    entries: Dict[str, Tuple[SourceFile, ast.AST]] = {}
+    for mod in mods:
+        fns, spawned = index_functions(mod.sf, _THREAD_SPAWNERS)
+        for bare in sorted(spawned):
+            placed = False
+            for ci in mod.classes:
+                if bare in ci.methods:
+                    key = f'{mod.sf.relpath}:{ci.name}.{bare}'
+                    entries[key] = (mod.sf, ci.methods[bare])
+                    placed = True
+            if placed:
+                continue
+            if bare in mod.functions:
+                entries[f'{mod.sf.relpath}:{bare}'] = (
+                    mod.sf, mod.functions[bare])
+            elif bare in fns:
+                entries[f'{mod.sf.relpath}:{fns[bare].qualname}'] = (
+                    mod.sf, fns[bare].node)
+    return entries
+
+
+def _risky_calls(fn: ast.AST) -> List[Tuple[int, str]]:
+    """(line, name) of calls in `fn` that can raise and are not inside
+    any ``try`` with a handler. Nested defs are their own entries (or
+    closures that run elsewhere) and are skipped; a bare ``raise``
+    outside protection is itself risky (deliberate silent death)."""
+    risky: List[Tuple[int, str]] = []
+
+    def scan_expr(e) -> None:
+        if e is None or isinstance(e, ast.Lambda):
+            return
+        if isinstance(e, ast.Call):
+            name = dotted_name(e.func)
+            seg = name.split('.')[-1] if name else None
+            if seg is not None and seg not in _SAFE_LAST_SEGS:
+                risky.append((e.lineno, name))
+            if isinstance(e.func, ast.Attribute):
+                scan_expr(e.func.value)
+            elif not isinstance(e.func, ast.Name):
+                scan_expr(e.func)
+            for a in e.args:
+                scan_expr(a)
+            for kw in e.keywords:
+                scan_expr(kw.value)
+            return
+        for child in ast.iter_child_nodes(e):
+            if isinstance(child, ast.expr):
+                scan_expr(child)
+            elif isinstance(child, ast.comprehension):
+                scan_expr(child.iter)
+                for cond in child.ifs:
+                    scan_expr(cond)
+
+    def walk_stmt(s, protected: bool) -> None:
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return
+        if isinstance(s, ast.Raise):
+            if not protected:
+                risky.append((s.lineno, 'raise'))
+            return
+        if isinstance(s, ast.Try):
+            shield = protected or bool(s.handlers)
+            for b in s.body:
+                walk_stmt(b, shield)
+            # orelse/finalbody/handler bodies are NOT covered by this
+            # try's handlers — exceptions there propagate
+            for blk in (s.orelse, s.finalbody):
+                for b in blk:
+                    walk_stmt(b, protected)
+            for h in s.handlers:
+                for b in h.body:
+                    walk_stmt(b, protected)
+            return
+        for child in ast.iter_child_nodes(s):
+            if isinstance(child, ast.stmt):
+                walk_stmt(child, protected)
+            elif isinstance(child, ast.expr) and not protected:
+                scan_expr(child)
+            elif isinstance(child, ast.withitem) and not protected:
+                scan_expr(child.context_expr)
+
+    for s in fn.body:
+        walk_stmt(s, False)
+    return risky
+
+
+def _exception_flow(entries: Dict[str, Tuple[SourceFile, ast.AST]]
+                    ) -> List[_RawFinding]:
+    out: List[_RawFinding] = []
+    for key in sorted(entries):
+        sf, fn = entries[key]
+        risky = _risky_calls(fn)
+        if not risky:
+            continue
+        line = min(ln for ln, _ in risky)
+        names = []
+        for _, name in sorted(risky):
+            short = name or '<dynamic>'
+            if short not in names:
+                names.append(short)
+        shown = ', '.join(f'{n}()' if n != 'raise' else n
+                          for n in names[:3])
+        more = f' (+{len(names) - 3} more)' if len(names) > 3 else ''
+        out.append((sf, sf.relpath, line, P_EXC,
+                    f"concurrent entry point '{key}' can die silently: "
+                    f'unprotected {shown}{more} — an exception raised '
+                    f'on this thread vanishes and the plane just stops; '
+                    f'wrap the risky region in a broad try whose '
+                    f'handler records the failure (sink event, metric, '
+                    f'error field) or re-raises into a joiner'))
+    return out
+
+
+# ----------------------------------------------------- pass 1b: swallows
+def _broad_handler(h: ast.ExceptHandler) -> bool:
+    t = h.type
+    if t is None:
+        return True
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    for e in elts:
+        if (_call_last_seg(e) or '') in ('Exception', 'BaseException'):
+            return True
+    return False
+
+
+def _handler_swallows(h: ast.ExceptHandler) -> bool:
+    """True when the handler body has no side channel at all — only
+    ``pass``/``continue``/bare constants. Any assign, call, raise,
+    return or break is a deliberate response to the failure."""
+    for s in h.body:
+        if isinstance(s, (ast.Pass, ast.Continue)):
+            continue
+        if isinstance(s, ast.Expr) and isinstance(s.value, ast.Constant):
+            continue
+        return False
+    return True
+
+
+def _swallow_pass(sfs: Sequence[SourceFile]) -> List[_RawFinding]:
+    out: List[_RawFinding] = []
+    for sf in sfs:
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Try):
+                continue
+            for h in node.handlers:
+                if _broad_handler(h) and _handler_swallows(h):
+                    out.append((sf, sf.relpath, h.lineno, P_EXC,
+                                'broad `except` swallows the exception '
+                                'with no side channel (body is pass/'
+                                'continue only) — record it (assign a '
+                                'fallback, count it, log it, emit it) '
+                                'or narrow the exception type'))
+    return out
+
+
+# -------------------------------------------------- pass 2: lifecycle
+def _own_stmts(body) :
+    """Statements of a function body, recursively, nested defs skipped
+    (they run in their own lifetime)."""
+    for s in body:
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield s
+        for blk in ('body', 'orelse', 'finalbody'):
+            sub = getattr(s, blk, None)
+            if sub:
+                yield from _own_stmts(sub)
+        for h in getattr(s, 'handlers', ()):
+            yield from _own_stmts(h.body)
+
+
+def _local_leaks(sf: SourceFile) -> List[_RawFinding]:
+    """Pass 2a: a local name bound to an acquiring constructor must be
+    released in a ``finally`` or escape (returned/yielded/stored/passed
+    — ownership transfer); straight-line ``f.close()`` leaks on the
+    exception path between acquire and close."""
+    out: List[_RawFinding] = []
+    for fn in ast.walk(sf.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        acquired: Dict[str, Tuple[int, str]] = {}
+        for s in _own_stmts(fn.body):
+            if (isinstance(s, ast.Assign) and len(s.targets) == 1
+                    and isinstance(s.targets[0], ast.Name)
+                    and isinstance(s.value, ast.Call)):
+                seg = _call_last_seg(s.value.func)
+                if seg in _ACQUIRE_FACTORIES:
+                    acquired[s.targets[0].id] = (s.lineno, seg)
+        if not acquired:
+            continue
+        sanctioned: Set[str] = set()
+
+        def note_escape(e) -> None:
+            if isinstance(e, ast.Name) and e.id in acquired:
+                sanctioned.add(e.id)
+            elif isinstance(e, (ast.Tuple, ast.List)):
+                for el in e.elts:
+                    note_escape(el)
+
+        def scan(stmts, in_finally: bool) -> None:
+            for s in stmts:
+                if isinstance(s, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef)):
+                    continue
+                if isinstance(s, ast.Try):
+                    scan(s.body, in_finally)
+                    scan(s.orelse, in_finally)
+                    scan(s.finalbody, True)
+                    for h in s.handlers:
+                        scan(h.body, in_finally)
+                    continue
+                if isinstance(s, (ast.Return, ast.Expr)) \
+                        and isinstance(getattr(s, 'value', None),
+                                       (ast.Yield, ast.YieldFrom)):
+                    note_escape(s.value.value)
+                elif isinstance(s, ast.Return):
+                    note_escape(s.value)
+                if isinstance(s, ast.Assign):
+                    for t in s.targets:
+                        if not isinstance(t, ast.Name):
+                            note_escape(s.value)
+                if isinstance(s, ast.With):
+                    for item in s.items:
+                        note_escape(item.context_expr)
+                for sub in ast.walk(s):
+                    if isinstance(sub, ast.Call):
+                        for a in (list(sub.args)
+                                  + [kw.value for kw in sub.keywords]):
+                            note_escape(a)
+                        f = sub.func
+                        if (isinstance(f, ast.Attribute)
+                                and isinstance(f.value, ast.Name)
+                                and f.value.id in acquired
+                                and f.attr in _RELEASE_METHODS
+                                and in_finally):
+                            sanctioned.add(f.value.id)
+                # nested compound statements: recurse for finally flags
+                for blk in ('body', 'orelse'):
+                    sub = getattr(s, blk, None)
+                    if sub and not isinstance(s, ast.Try):
+                        scan(sub, in_finally)
+
+        scan(fn.body, False)
+        for name in sorted(acquired):
+            if name in sanctioned:
+                continue
+            line, seg = acquired[name]
+            out.append((sf, sf.relpath, line, P_RES,
+                        f"local '{name}' acquires a {seg}() resource "
+                        f'that is not released on all paths — use '
+                        f'`with`, release it in a `finally` '
+                        f'({_ACQUIRE_FACTORIES[seg]}), or hand '
+                        f'ownership off explicitly'))
+    return out
+
+
+def _attr_line(ci: ClassInfo, attr: str) -> int:
+    for m in ci.methods.values():
+        for sub in ast.walk(m):
+            if isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                targets = (sub.targets if isinstance(sub, ast.Assign)
+                           else [sub.target])
+                for t in targets:
+                    if _self_attr(t) == attr:
+                        return sub.lineno
+    return ci.node.lineno
+
+
+def _owner_releases(ci: ClassInfo, attr: str) -> bool:
+    for mname in sorted(_OWNER_RELEASE & set(ci.methods)):
+        for sub in ast.walk(ci.methods[mname]):
+            if isinstance(sub, ast.Attribute) \
+                    and _self_attr(sub) == attr:
+                return True
+    return False
+
+
+def _field_lifecycle(mods: List[ModuleInfo]) -> List[_RawFinding]:
+    """Pass 2b/2c: field-held resources and attr-stored threads need an
+    owner release/stop method that references them."""
+    out: List[_RawFinding] = []
+    for mod in mods:
+        for ci in mod.classes:
+            heavy: Dict[str, str] = {}
+            for a in sorted(ci.file_attrs):
+                heavy[a] = 'open'
+            for m in ci.methods.values():
+                for sub in ast.walk(m):
+                    if not isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                        continue
+                    targets = (sub.targets
+                               if isinstance(sub, ast.Assign)
+                               else [sub.target])
+                    v = sub.value
+                    if not isinstance(v, ast.Call):
+                        continue
+                    seg = _call_last_seg(v.func)
+                    if seg in ('Popen', 'TemporaryDirectory', 'socket',
+                               'create_connection'):
+                        for t in targets:
+                            attr = _self_attr(t)
+                            if attr is not None:
+                                heavy.setdefault(attr, seg)
+            for attr in sorted(heavy):
+                if _owner_releases(ci, attr):
+                    continue
+                out.append((mod.sf, mod.sf.relpath,
+                            _attr_line(ci, attr), P_RES,
+                            f"field 'self.{attr}' of {ci.name} holds a "
+                            f'{heavy[attr]}() resource but no owner '
+                            f'release method (close/stop/shutdown/'
+                            f'cleanup/...) references it — add an '
+                            f'idempotent release that reaches it'))
+            for attr in sorted(ci.thread_attrs):
+                if _owner_releases(ci, attr):
+                    continue
+                out.append((mod.sf, mod.sf.relpath,
+                            _attr_line(ci, attr), P_RES,
+                            f"thread field 'self.{attr}' of {ci.name} "
+                            f'is started but no stop-family method '
+                            f'(stop/close/shutdown/join/cancel) '
+                            f'references it — every started thread '
+                            f'needs a reachable, idempotent stop'))
+    return out
+
+
+def _unstoppable(fn: ast.AST) -> bool:
+    """A ``while True`` loop with no break/return (nested defs skipped)
+    can never be asked to exit."""
+    for s in _own_stmts(fn.body):
+        if isinstance(s, ast.While) \
+                and isinstance(s.test, ast.Constant) \
+                and s.test.value is True:
+            exits = any(isinstance(sub, (ast.Break, ast.Return))
+                        for sub in _own_stmts(s.body))
+            if not exits:
+                return True
+    return False
+
+
+def _spawn_targets(mods: List[ModuleInfo]) -> List[_RawFinding]:
+    """Pass 2d: spawned thread targets that loop forever with no exit
+    path — unstoppable by construction, whatever the owner does."""
+    out: List[_RawFinding] = []
+    for mod in mods:
+        fns, _ = index_functions(mod.sf, _THREAD_SPAWNERS)
+        for node in ast.walk(mod.sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _call_last_seg(node.func) not in ('Thread', 'Timer'):
+                continue
+            target = None
+            for kw in node.keywords:
+                if kw.arg in ('target', 'function'):
+                    target = kw.value
+            if target is None and node.args:
+                target = node.args[0]
+            d = dotted_name(target) if target is not None else None
+            if not d:
+                continue
+            bare = d.split('.')[-1]
+            fn = None
+            for ci in mod.classes:
+                if bare in ci.methods:
+                    fn = ci.methods[bare]
+            if fn is None:
+                fn = (mod.functions.get(bare)
+                      or (fns[bare].node if bare in fns else None))
+            if fn is None or not _unstoppable(fn):
+                continue
+            out.append((mod.sf, mod.sf.relpath, node.lineno, P_RES,
+                        f"thread target '{bare}' loops `while True` "
+                        f'with no break/return — this thread can never '
+                        f'be stopped; poll a stop Event (or break on a '
+                        f'sentinel) so shutdown can reach it'))
+    return out
+
+
+def _bound_spelling(call: ast.Call) -> Optional[str]:
+    """The explicit bound of a buffer constructor call, or None when it
+    is unbounded. Any non-zero expression counts as a bound."""
+    seg = _call_last_seg(call.func)
+    if seg == 'SimpleQueue':
+        return None
+    kw_name = 'maxlen' if seg == 'deque' else 'maxsize'
+    bound = None
+    for kw in call.keywords:
+        if kw.arg == kw_name:
+            bound = kw.value
+    if bound is None:
+        pos = 1 if seg == 'deque' else 0
+        if len(call.args) > pos:
+            bound = call.args[pos]
+    if bound is None:
+        return None
+    if isinstance(bound, ast.Constant) and not bound.value:
+        return None                      # maxsize=0 means unbounded
+    return f'{kw_name}={ast.unparse(bound)}'
+
+
+def _buffer_pass(mods: List[ModuleInfo]
+                 ) -> Tuple[List[_RawFinding],
+                            Dict[str, List[Tuple[int, Optional[str],
+                                                 str]]]]:
+    """Pass 2e: every Queue/deque in a runtime plane carries an explicit
+    bound. Returns raw findings for unbounded sites plus the census of
+    every buffer site keyed `relpath:Qual` (attr for self-assigned,
+    enclosing scope otherwise) -> [(line, spelling|None, ctor)]."""
+    out: List[_RawFinding] = []
+    census: Dict[str, List[Tuple[int, Optional[str], str]]] = {}
+
+    def record(mod, qual, call):
+        seg = _call_last_seg(call.func)
+        spelling = _bound_spelling(call)
+        key = f'{mod.sf.relpath}:{qual}'
+        census.setdefault(key, []).append((call.lineno, spelling, seg))
+        if spelling is None:
+            out.append((mod.sf, mod.sf.relpath, call.lineno, P_RES,
+                        f'unbounded {seg}() in a runtime plane '
+                        f'({key}) — overload turns into latency '
+                        f'collapse; give it an explicit '
+                        f'maxsize/maxlen, or suppress with a one-line '
+                        f'justification if admission is bounded '
+                        f'elsewhere'))
+
+    def buffer_calls(e):
+        for sub in ast.walk(e):
+            if isinstance(sub, ast.Call) \
+                    and _call_last_seg(sub.func) in _BUFFER_CTORS:
+                yield sub
+
+    def visit(mod, node, scope):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                visit(mod, child, child.name)
+            elif isinstance(child, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                qual = (f'{scope}.{child.name}'
+                        if scope != '<module>' else child.name)
+                visit(mod, child, qual)
+            elif isinstance(child, (ast.Assign, ast.AnnAssign)):
+                targets = (child.targets
+                           if isinstance(child, ast.Assign)
+                           else [child.target])
+                qual = scope
+                for t in targets:
+                    attr = _self_attr(t)
+                    if attr is not None:
+                        cls = scope.split('.', 1)[0]
+                        qual = f'{cls}.{attr}'
+                    elif isinstance(t, ast.Name) and scope == '<module>':
+                        qual = t.id
+                if child.value is not None:
+                    for call in buffer_calls(child.value):
+                        record(mod, qual, call)
+            else:
+                if isinstance(child, (ast.expr, ast.stmt)):
+                    claimed = set()
+                    for sub in ast.walk(child):
+                        if isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                            visit(mod, _Wrap([sub]), scope)
+                            for c2 in ast.walk(sub):
+                                claimed.add(id(c2))
+                    for call in buffer_calls(child):
+                        if id(call) not in claimed:
+                            record(mod, scope, call)
+                else:
+                    visit(mod, child, scope)
+
+    for mod in mods:
+        visit(mod, mod.sf.tree, '<module>')
+    return out, census
+
+
+class _Wrap:
+    """Minimal node wrapper so ``visit`` can re-dispatch a nested
+    Assign through its own branch via iter_child_nodes."""
+
+    def __init__(self, body):
+        self.body = body
+        self._fields = ('body',)
+
+
+# --------------------------------------------------- pass 3: hot locks
+def _blocking_reason(cs) -> Optional[str]:
+    seg = cs.name.split('.')[-1] if cs.name else ''
+    if not seg:
+        return None
+    if cs.name in _DOTTED_BLOCKING:
+        return 'file/OS I/O'
+    if seg in _ALWAYS_BLOCKING:
+        return _ALWAYS_BLOCKING[seg]
+    if seg == 'open':
+        return 'file open'
+    ci = cs.ci
+    if ci is not None and cs.recv_attr is not None:
+        if seg in _FILE_BLOCKING and cs.recv_attr in ci.file_attrs:
+            return 'file I/O on a held handle'
+        if seg in ('get', 'put') and cs.recv_attr in ci.queue_attrs:
+            return 'queue get/put blocks on empty/full'
+        if seg == 'join' and cs.recv_attr in ci.thread_attrs:
+            return 'thread join'
+    if seg in ('wait', 'wait_for') and not cs.recv_is_lock:
+        # Condition.wait releases the lock while waiting (recv IS the
+        # lock); Event/Future wait keeps everything held
+        return 'event/future wait'
+    return None
+
+
+def _hot_lock_pass(ana) -> List[_RawFinding]:
+    out: List[_RawFinding] = []
+    for cs in ana.call_sites:
+        hot = sorted(h for h in cs.held if h.startswith(_HOT_PREFIXES))
+        if not hot:
+            continue
+        why = _blocking_reason(cs)
+        if why is None:
+            continue
+        out.append((cs.sf, cs.sf.relpath, cs.line, P_LOCK,
+                    f'blocking call {cs.name}() ({why}) while holding '
+                    f"hot-path lock(s) {', '.join(hot)} — every "
+                    f'waiter on that lock inherits this latency; '
+                    f'snapshot under the lock and do the blocking work '
+                    f'outside it (the flight-recorder shape)'))
+    return out
+
+
+# ----------------------------------------------------------- the census
+@dataclass
+class FailObserved:
+    """One tree's failure-path audit: raw findings + the pinnable
+    census."""
+    root: str
+    files: List[SourceFile]
+    by_path: Dict[str, SourceFile]
+    entries: Dict[str, Tuple[SourceFile, int]]
+    buffers: Dict[str, List[Tuple[int, Optional[str], str]]]
+    hot_locks: List[str]
+    raw: List[_RawFinding] = field(default_factory=list)
+
+    def suppression_census(self) -> Dict[str, int]:
+        counts = {p: 0 for p in PASSES}
+        for sf, _path, line, pname, _msg in self.raw:
+            if sf is not None and sf.is_suppressed(RULE_FAILPATH, line):
+                counts[pname] += 1
+        return counts
+
+    def unresolved(self) -> List[_RawFinding]:
+        return [rf for rf in self.raw
+                if rf[0] is None
+                or not rf[0].is_suppressed(RULE_FAILPATH, rf[2])]
+
+    def bounded_census(self) -> Dict[str, List[str]]:
+        """Buffer key -> sorted bound spellings; an unbounded site only
+        enters the census once suppressed (a live finding never pins)."""
+        out: Dict[str, List[str]] = {}
+        for key, sites in self.buffers.items():
+            sf = self.by_path.get(key.split(':', 1)[0])
+            spellings = []
+            for line, spelling, _seg in sites:
+                if spelling is None:
+                    if sf is not None \
+                            and sf.is_suppressed(RULE_FAILPATH, line):
+                        spellings.append('suppressed')
+                else:
+                    spellings.append(spelling)
+            if spellings:
+                out[key] = sorted(spellings)
+        return out
+
+    def to_sidecar(self) -> Dict:
+        """The pinnable census. Raises ValueError while the tree still
+        has unsuppressed findings — nothing is written."""
+        problems = [f'{path}:{line}: [{pname}] {msg}'
+                    for _sf, path, line, pname, msg in self.unresolved()]
+        if problems:
+            raise ValueError(
+                'refusing to pin SEGFAIL.json while the tree has live '
+                'failure-path findings; fix these first:\n  '
+                + '\n  '.join(problems))
+        return {
+            '_comment': (
+                'segfail sidecar: the committed failure-path census — '
+                'audited concurrent entry points, bounded-buffer '
+                'sites, hot-plane locks, and the per-pass suppression '
+                'budget (which only goes down). Any drift fails '
+                '`segcheck --rules failpath`; review and re-pin with '
+                '`tools/segcheck.py --update-failpath` (refuses while '
+                'live findings exist).'),
+            'entry_points': sorted(self.entries),
+            'bounded': {k: self.bounded_census()[k]
+                        for k in sorted(self.bounded_census())},
+            'hot_locks': list(self.hot_locks),
+            'suppressions': self.suppression_census(),
+        }
+
+
+def observe(root: str, files: Optional[Sequence[SourceFile]] = None
+            ) -> FailObserved:
+    """Run all three passes over the tree (one shared segrace analysis
+    walk); findings are deduplicated by site."""
+    ana, sfs = analyze(root, files)
+    mods = ana.mods
+    entry_nodes = _discover_entries(mods)
+    raw: List[_RawFinding] = []
+    raw += _exception_flow(entry_nodes)
+    raw += _swallow_pass(sfs)
+    raw += _local_leaks_all(sfs)
+    raw += _field_lifecycle(mods)
+    raw += _spawn_targets(mods)
+    buf_raw, buffers = _buffer_pass(mods)
+    raw += buf_raw
+    raw += _hot_lock_pass(ana)
+    seen: Set[Tuple[str, int, str]] = set()
+    deduped: List[_RawFinding] = []
+    for rf in sorted(raw, key=lambda r: (r[1], r[2], r[4])):
+        key = (rf[1], rf[2], rf[4])
+        if key not in seen:
+            seen.add(key)
+            deduped.append(rf)
+    return FailObserved(
+        root=root, files=list(sfs),
+        by_path={sf.relpath: sf for sf in sfs},
+        entries={k: (sf, fn.lineno)
+                 for k, (sf, fn) in entry_nodes.items()},
+        buffers=buffers,
+        hot_locks=sorted(n for n in ana.graph.nodes
+                         if n.startswith(_HOT_PREFIXES)),
+        raw=deduped)
+
+
+def _local_leaks_all(sfs: Sequence[SourceFile]) -> List[_RawFinding]:
+    out: List[_RawFinding] = []
+    for sf in sfs:
+        out.extend(_local_leaks(sf))
+    return out
+
+
+# ------------------------------------------------------------ sidecar IO
+def sidecar_path(root: str) -> str:
+    return os.path.join(root, SEGFAIL_FILE)
+
+
+def load_sidecar(root: str) -> Optional[Dict]:
+    path = sidecar_path(root)
+    if not os.path.exists(path):
+        return None
+    with open(path) as f:
+        return json.load(f)
+
+
+def save_sidecar(root: str, obs: FailObserved) -> Dict:
+    data = obs.to_sidecar()     # raises on live findings, nothing written
+    with open(sidecar_path(root), 'w') as f:
+        json.dump(data, f, indent=1, sort_keys=False)
+        f.write('\n')
+    return data
+
+
+def update_failpath(root: str,
+                    files: Optional[Sequence[SourceFile]] = None) -> Dict:
+    """Re-pin SEGFAIL.json from the current tree (the --update-failpath
+    entry point). Refuses while live findings exist: see
+    FailObserved.to_sidecar."""
+    return save_sidecar(root, observe(root, files))
+
+
+# ------------------------------------------------------ pass 4: the gate
+def compare(obs: FailObserved, sidecar: Optional[Dict]
+            ) -> List[_RawFinding]:
+    """Gate the observed census against the committed sidecar, both
+    directions, suppression budget monotone-decreasing."""
+    repin = ('review the change and re-pin with `tools/segcheck.py '
+             '--update-failpath`')
+    out: List[_RawFinding] = []
+    observed_entries = sorted(obs.entries)
+    bounded = obs.bounded_census()
+    if sidecar is None:
+        if observed_entries or bounded or obs.hot_locks:
+            out.append((None, SEGFAIL_FILE, 1, P_EXC,
+                        f'{SEGFAIL_FILE} is missing but the tree has '
+                        f'{len(observed_entries)} concurrent entry '
+                        f'point(s), {len(bounded)} bounded buffer '
+                        f'site(s) and {len(obs.hot_locks)} hot-plane '
+                        f'lock(s); pin the failure-path census with '
+                        f'`tools/segcheck.py --update-failpath` and '
+                        f'commit it'))
+        return out
+
+    pinned_entries = set(sidecar.get('entry_points', ()))
+    for key in sorted(set(observed_entries) - pinned_entries):
+        sf, line = obs.entries[key]
+        out.append((sf, sf.relpath, line, P_EXC,
+                    f"new concurrent entry point '{key}' is not in the "
+                    f'committed {SEGFAIL_FILE}; audit its failure path '
+                    f'and {repin}'))
+    for key in sorted(pinned_entries - set(observed_entries)):
+        out.append((None, SEGFAIL_FILE, 1, P_EXC,
+                    f"entry point '{key}' is pinned in {SEGFAIL_FILE} "
+                    f'but gone from the tree; {repin}'))
+
+    pinned_bounded = sidecar.get('bounded', {})
+    for key in sorted(set(bounded) - set(pinned_bounded)):
+        path = key.split(':', 1)[0]
+        sf = obs.by_path.get(path)
+        line = obs.buffers.get(key, [(1, None, '')])[0][0]
+        out.append((sf, path, line, P_RES,
+                    f"new bounded-buffer site '{key}' "
+                    f'({", ".join(bounded[key])}) is not in the '
+                    f'committed {SEGFAIL_FILE}; {repin}'))
+    for key in sorted(set(pinned_bounded) - set(bounded)):
+        out.append((None, SEGFAIL_FILE, 1, P_RES,
+                    f"bounded-buffer site '{key}' is pinned in "
+                    f'{SEGFAIL_FILE} but gone from the tree; {repin}'))
+    for key in sorted(set(bounded) & set(pinned_bounded)):
+        if bounded[key] != pinned_bounded[key]:
+            path = key.split(':', 1)[0]
+            sf = obs.by_path.get(path)
+            line = obs.buffers.get(key, [(1, None, '')])[0][0]
+            out.append((sf, path, line, P_RES,
+                        f"buffer bound at '{key}' drifted from the "
+                        f'committed {SEGFAIL_FILE} (pinned '
+                        f'{pinned_bounded[key]} vs observed '
+                        f'{bounded[key]}); {repin}'))
+
+    pinned_locks = set(sidecar.get('hot_locks', ()))
+    for lock in sorted(set(obs.hot_locks) - pinned_locks):
+        path = lock.split(':', 1)[0]
+        out.append((obs.by_path.get(path), path, 1, P_LOCK,
+                    f"new hot-plane lock '{lock}' is not in the "
+                    f'committed {SEGFAIL_FILE}; {repin}'))
+    for lock in sorted(pinned_locks - set(obs.hot_locks)):
+        out.append((None, SEGFAIL_FILE, 1, P_LOCK,
+                    f"hot-plane lock '{lock}' is pinned in "
+                    f'{SEGFAIL_FILE} but gone from the tree; {repin}'))
+
+    pinned_sup = sidecar.get('suppressions', {})
+    for pname, n_obs in obs.suppression_census().items():
+        n_pin = int(pinned_sup.get(pname, 0))
+        if n_obs > n_pin:
+            out.append((None, SEGFAIL_FILE, 1, pname,
+                        f"failpath suppression budget for pass "
+                        f"'{pname}' increased (pinned {n_pin}, observed "
+                        f'{n_obs}) — the budget only goes down; remove '
+                        f'the new suppression (fix the finding) or '
+                        f'consciously re-pin with --update-failpath'))
+        elif n_obs < n_pin:
+            out.append((None, SEGFAIL_FILE, 1, pname,
+                        f"failpath suppression budget for pass "
+                        f"'{pname}' is stale (pinned {n_pin}, observed "
+                        f'{n_obs}) — a suppression was removed; lock '
+                        f'in the lower budget with --update-failpath'))
+    return out
+
+
+# ----------------------------------------------------------------- rule
+def check_failpath(root: str,
+                   files: Optional[Sequence[SourceFile]] = None
+                   ) -> List[Finding]:
+    """All three passes + the SEGFAIL.json gate; suppression via
+    ``# segcheck: disable=failpath`` like every other rule."""
+    obs = observe(root, files)
+    raw = list(obs.raw) + compare(obs, load_sidecar(root))
+    findings: List[Finding] = []
+    seen: Set[Tuple[str, int, str]] = set()
+    for sf, path, line, _pname, msg in raw:
+        if sf is None:
+            f: Optional[Finding] = Finding(rule=RULE_FAILPATH, path=path,
+                                           line=line, message=msg)
+        else:
+            f = sf.finding(RULE_FAILPATH, line, msg)
+        if f is not None and (f.path, f.line, f.message) not in seen:
+            seen.add((f.path, f.line, f.message))
+            findings.append(f)
+    return sorted(findings, key=lambda f: (f.path, f.line, f.message))

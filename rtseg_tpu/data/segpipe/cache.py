@@ -151,28 +151,33 @@ def build_cache(dataset, path: str) -> str:
     tmp = tempfile.mkdtemp(prefix='.segpack-build-', dir=parent)
     try:
         shards, f, written = [], None, 0
-        for i in range(n):
-            img, mask = (img0, mask0) if i == 0 else dataset.prepare(i)
-            img, mask = np.asarray(img), np.asarray(mask)
-            if (img.shape != img0.shape or img.dtype != img0.dtype
-                    or mask.shape != mask0.shape
-                    or mask.dtype != mask0.dtype):
-                raise CacheUnsupported(
-                    f'sample {i} prepare() shape/dtype '
-                    f'{img.shape}/{img.dtype} differs from sample 0 '
-                    f'{img0.shape}/{img0.dtype}: packed shards need '
-                    f'fixed-shape samples')
-            if written % sps == 0:
-                if f is not None:
-                    f.close()
-                name = f'data-{len(shards):05d}.bin'
-                shards.append(name)
-                f = open(os.path.join(tmp, name), 'wb')
-            f.write(np.ascontiguousarray(img).tobytes())
-            f.write(np.ascontiguousarray(mask).tobytes())
-            written += 1
-        if f is not None:
-            f.close()
+        try:
+            for i in range(n):
+                img, mask = (img0, mask0) if i == 0 else dataset.prepare(i)
+                img, mask = np.asarray(img), np.asarray(mask)
+                if (img.shape != img0.shape or img.dtype != img0.dtype
+                        or mask.shape != mask0.shape
+                        or mask.dtype != mask0.dtype):
+                    raise CacheUnsupported(
+                        f'sample {i} prepare() shape/dtype '
+                        f'{img.shape}/{img.dtype} differs from sample 0 '
+                        f'{img0.shape}/{img0.dtype}: packed shards need '
+                        f'fixed-shape samples')
+                if written % sps == 0:
+                    if f is not None:
+                        f.close()
+                    name = f'data-{len(shards):05d}.bin'
+                    shards.append(name)
+                    f = open(os.path.join(tmp, name), 'wb')
+                f.write(np.ascontiguousarray(img).tobytes())
+                f.write(np.ascontiguousarray(mask).tobytes())
+                written += 1
+        finally:
+            # the open shard must close on the exception path too
+            # (segfail resource-lifecycle): a CacheUnsupported mid-build
+            # otherwise leaks the fd past the rmtree below
+            if f is not None:
+                f.close()
         index = {'format_version': FORMAT_VERSION, 'n': n,
                  'samples_per_shard': sps, 'shards': shards,
                  'record_bytes': rec_bytes, **layout}

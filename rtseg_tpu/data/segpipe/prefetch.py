@@ -34,6 +34,10 @@ class DevicePrefetcher:
         self._src = it
         self._put_fn = put_fn
         self._q: queue.Queue = queue.Queue(maxsize=depth)
+        #: segfail side channel: producer-side best-effort steps that
+        #: raised (source close() in teardown, error hand-off to the
+        #: consumer). Single-writer: the producer thread.
+        self.producer_failures = 0
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name='segpipe-h2d')
@@ -50,8 +54,12 @@ class DevicePrefetcher:
         return False
 
     def _run(self) -> None:
-        it = iter(self._src)
+        it = None
         try:
+            # inside the shield: a source whose __iter__ raises must
+            # reach the consumer as that exception, not as a silently
+            # empty epoch (segfail exception-flow)
+            it = iter(self._src)
             while not self._stop.is_set():
                 try:
                     batch = next(it)
@@ -62,7 +70,12 @@ class DevicePrefetcher:
                 if not self._offer(dev):
                     return              # consumer went away
         except BaseException as e:      # loader/transfer errors -> consumer
-            self._offer(e)
+            try:
+                self._offer(e)
+            except Exception:   # noqa: BLE001 — even the hand-off died;
+                # the consumer will see the dead thread, the counter
+                # says why the exception itself never arrived
+                self.producer_failures += 1
         finally:
             # the generator is owned by THIS thread: closing it here runs
             # the loader's finally (producer-thread/pool teardown)
@@ -70,8 +83,10 @@ class DevicePrefetcher:
             if close is not None:
                 try:
                     close()
-                except Exception:   # noqa: BLE001 — teardown best-effort
-                    pass
+                except Exception:   # noqa: BLE001 — teardown is best-
+                    # effort but not silent: a leaked pool is debuggable
+                    # only if something says the close failed
+                    self.producer_failures += 1
 
     # --------------------------------------------------------- consumer side
     def __iter__(self) -> Iterator:

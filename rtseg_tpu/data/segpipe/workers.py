@@ -101,7 +101,9 @@ def _worker_main(shm, layout, source: SampleSource, seed: int,
     try:
         import cv2
         cv2.setNumThreads(0)        # no per-worker thread fan-out on top
-    except Exception:   # noqa: BLE001 — cv2-free sources still work
+    except Exception:   # segcheck: disable=failpath — noqa: BLE001; a
+        # cv2-free source is a supported configuration, not a failure:
+        # there is nothing worth recording from a child process
         pass
     try:
         while True:
@@ -156,8 +158,15 @@ class AugmentPool:
             ctx = mp.get_context('spawn')
         self._shm = shared_memory.SharedMemory(
             create=True, size=max(1, self.slots * self.layout['slot_b']))
-        self._task_q = ctx.Queue()
-        self._result_q = ctx.Queue()
+        # both queues are slot-bounded by construction — run() submits a
+        # task only while a free slot exists, and every result occupies
+        # a slot — plus one close() sentinel per worker; the explicit
+        # maxsize turns that invariant into backpressure instead of
+        # trusting it (segfail resource-lifecycle)
+        self._task_q = ctx.Queue(maxsize=self.slots + workers)
+        self._result_q = ctx.Queue(maxsize=self.slots + workers)
+        #: segfail side channel: best-effort teardown steps that raised
+        self.teardown_failures = 0
         self._procs = [
             ctx.Process(target=_worker_main,
                         args=(self._shm, self.layout, source, seed,
@@ -237,8 +246,9 @@ class AugmentPool:
         for _ in self._procs:
             try:
                 self._task_q.put_nowait(None)
-            except Exception:   # noqa: BLE001 — full queue on teardown
-                pass
+            except Exception:   # noqa: BLE001 — full queue on teardown:
+                # the worker is terminate()d below instead; count it
+                self.teardown_failures += 1
         for p in self._procs:
             p.join(timeout=2.0)
         for p in self._procs:
@@ -255,13 +265,16 @@ class AugmentPool:
             try:
                 q.close()
                 q.join_thread()
-            except Exception:   # noqa: BLE001 — already-closed queue
-                pass
+            except Exception:   # noqa: BLE001 — already-closed queue;
+                # still counted: a wedged feeder thread would otherwise
+                # block interpreter exit with no evidence why
+                self.teardown_failures += 1
         try:
             self._shm.close()
             self._shm.unlink()
-        except Exception:   # noqa: BLE001 — double unlink on races
-            pass
+        except Exception:   # noqa: BLE001 — double unlink on races;
+            # counted: a leaked /dev/shm segment outlives the process
+            self.teardown_failures += 1
 
     def __enter__(self) -> 'AugmentPool':
         return self
@@ -272,5 +285,8 @@ class AugmentPool:
     def __del__(self):
         try:
             self.close()
-        except Exception:   # noqa: BLE001 — interpreter teardown
+        except Exception:   # segcheck: disable=failpath — noqa: BLE001;
+            # gc-at-interpreter-teardown: modules and even instance
+            # attributes may already be torn down, so there is no side
+            # channel left that is itself safe to touch here
             pass

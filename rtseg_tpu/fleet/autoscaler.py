@@ -110,6 +110,12 @@ class Autoscaler:
         self.group_name = group_name
         self.policy = policy if policy is not None else AutoscalePolicy()
         self.poll_s = poll_s
+        # failure-path side channels (segfail): an autoscaler that dies
+        # or skips scrapes silently leaves the group frozen at its last
+        # size with no evidence why. Single-writer (the loop thread);
+        # readers only ever see a slightly stale count.
+        self.scrape_failures = 0
+        self.loop_failures = 0
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name=f'segfleet-autoscale-'
@@ -132,31 +138,41 @@ class Autoscaler:
         streak = (0, 0)
         cooldown_until = 0.0
         while not self._stop.wait(self.poll_s):
-            group = self.manager.groups[self.group_name]
-            ready = group.ready()
-            frames = []
-            for r in ready:
-                url = r.url
-                if url is None:
+            try:
+                group = self.manager.groups[self.group_name]
+                ready = group.ready()
+                frames = []
+                for r in ready:
+                    url = r.url
+                    if url is None:
+                        continue
+                    poller = pollers.get(r.replica_id)
+                    if poller is None:
+                        poller = MetricsPoller(url)
+                        pollers[r.replica_id] = poller
+                    try:
+                        frames.append(poller.poll())
+                    except Exception:   # noqa: BLE001 — a scrape may
+                        # race a replica death; skip this frame but keep
+                        # the count visible (segfail exception-flow)
+                        self.scrape_failures += 1
+                        continue
+                # drop pollers of replicas that left the ready set so a
+                # restarted replica gets a fresh delta baseline
+                gone = set(pollers) - {r.replica_id for r in ready}
+                for rid in gone:
+                    del pollers[rid]
+                delta, reason, streak = decide(frames, len(ready),
+                                               self.policy, streak)
+                if delta == 0 or time.monotonic() < cooldown_until:
                     continue
-                poller = pollers.get(r.replica_id)
-                if poller is None:
-                    poller = MetricsPoller(url)
-                    pollers[r.replica_id] = poller
-                try:
-                    frames.append(poller.poll())
-                except Exception:   # noqa: BLE001 — a scrape may race a
-                    continue        # replica death; skip this frame
-            # drop pollers of replicas that left the ready set so a
-            # restarted replica gets a fresh delta baseline
-            gone = set(pollers) - {r.replica_id for r in ready}
-            for rid in gone:
-                del pollers[rid]
-            delta, reason, streak = decide(frames, len(ready),
-                                           self.policy, streak)
-            if delta == 0 or time.monotonic() < cooldown_until:
-                continue
-            self.manager.scale_to(self.group_name, len(ready) + delta,
-                                  reason=f'autoscale: {reason}')
-            cooldown_until = time.monotonic() + self.policy.cooldown_s
-            streak = (0, 0)
+                self.manager.scale_to(self.group_name,
+                                      len(ready) + delta,
+                                      reason=f'autoscale: {reason}')
+                cooldown_until = (time.monotonic()
+                                  + self.policy.cooldown_s)
+                streak = (0, 0)
+            except Exception:   # noqa: BLE001 — one bad poll (scale_to
+                # racing teardown, a group vanishing) must not kill the
+                # autoscaler for the rest of the process's life
+                self.loop_failures += 1

@@ -128,6 +128,10 @@ class FleetManager:
         # serializes scale decisions (autoscaler thread vs. CLI thread);
         # never held across event emission or replica HTTP requests
         self._scale_lock = threading.Lock()
+        # segfail exception-flow side channel: per-replica ticks that
+        # raised (replica HTTP races, spawn failures); bumped under
+        # _scale_lock so concurrent readers see an exact count
+        self.monitor_failures = 0
         self._stop = threading.Event()
         self._monitor = threading.Thread(target=self._monitor_loop,
                                          daemon=True,
@@ -318,13 +322,23 @@ class FleetManager:
     # ------------------------------------------------------------- monitor
     def _monitor_loop(self) -> None:
         while not self._stop.is_set():
-            # snapshot: add_group/remove_group mutate the dict mid-run
-            for g in list(self.groups.values()):
-                for r in g.replicas():
-                    try:
-                        self._tick_replica(g, r)
-                    except Exception:   # noqa: BLE001 — monitor survives
-                        pass
+            try:
+                # snapshot: add_group/remove_group mutate the dict
+                # mid-run
+                for g in list(self.groups.values()):
+                    for r in g.replicas():
+                        try:
+                            self._tick_replica(g, r)
+                        except Exception:   # noqa: BLE001 — the monitor
+                            # survives any one replica's tick, but a
+                            # swallowed tick is still a reaped-late
+                            # replica: count it (segfail exception-flow)
+                            with self._scale_lock:
+                                self.monitor_failures += 1
+            except Exception:   # noqa: BLE001 — never let the fleet's
+                # only lifecycle driver die silently
+                with self._scale_lock:
+                    self.monitor_failures += 1
             self._stop.wait(self.poll_s)
 
     def _tick_replica(self, g: ReplicaGroup, r: ReplicaProcess) -> None:

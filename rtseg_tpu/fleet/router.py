@@ -223,6 +223,10 @@ class FleetRouter(ThreadingHTTPServer):
         for g, split in self.groups.items():
             self.ensure_version(g, split.stable_arm().version)
         self._mirror_slots = threading.BoundedSemaphore(_MAX_MIRRORS)
+        # segfail exception-flow: mirror threads whose failure couldn't
+        # even reach the shadow error counter (registry itself raising).
+        # Last-ditch side channel so a dying mirror is never silent.
+        self.mirror_errors = 0
         # segtail flight recorder: the router's ring of recent per-hop
         # records (obs/flight.py), dumped on trigger only
         self.flight = FlightRecorder(source='router')
@@ -533,6 +537,14 @@ class FleetRouter(ThreadingHTTPServer):
             agree = body == stable_body
             self._shadow_counter(
                 group, 'agree' if agree else 'disagree').inc()
+        except Exception:   # noqa: BLE001 — a mirror thread must not
+            # die silently (segfail exception-flow): anything the body
+            # didn't classify itself lands in the shadow error counter
+            try:
+                self._shadow_counter(group, 'error').inc()
+            except Exception:   # noqa: BLE001 — counter plane down too
+                with self._lock:
+                    self.mirror_errors += 1
         finally:
             self._mirror_slots.release()
 

@@ -34,40 +34,61 @@ from typing import Any, Dict, Iterator, Optional
 class EventSink:
     """Append-only JSONL event writer, one file per host.
 
-    Thread-safe: the trainer loop, the loader's producer thread and the
-    stall watchdog all emit into the same sink. Each event line gets a
-    wall-clock ``ts`` and the sink's static fields (``host``) stamped in
-    unless the caller already set them. Emitting into a closed sink is a
-    silent no-op so late telemetry (a watchdog poll racing shutdown) can
-    never crash a run.
+    Thread-safe *without a lock on the write path*: the file is opened
+    ``O_APPEND`` and each event goes down as a single ``os.write`` —
+    POSIX makes each such append atomic, so the trainer loop, the
+    loader's producer thread and the stall watchdog can emit
+    concurrently with no interleaved lines and, crucially, with no
+    disk-latency inheritance between them (the segfail hot-lock pass
+    statically forbids the old write-under-lock shape; see
+    SEGFAIL.json). One unbuffered write per line also keeps the old
+    flush-per-line crash guarantee: a stall/crash must not eat the
+    events that explain it.
+
+    Each event line gets a wall-clock ``ts`` and the sink's static
+    fields (``host``) stamped in unless the caller already set them.
+    Emitting into a closed sink is a silent no-op (counted in
+    ``dropped``) so late telemetry — a watchdog poll racing shutdown —
+    can never crash a run.
     """
 
     def __init__(self, path: str, static: Optional[Dict[str, Any]] = None):
         self.path = path
         self.static = dict(static or {})
-        self._lock = threading.Lock()
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-        self._f = open(path, 'a')
+        self._fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND,
+                           0o644)
         self._closed = False
+        #: emits lost to the close race — telemetry about the telemetry;
+        #: best-effort (racing updates may undercount, by design)
+        self.dropped = 0
 
     def emit(self, event: Dict[str, Any]) -> None:
+        if self._closed:
+            return
         rec = dict(self.static)
         rec.update(event)
         rec.setdefault('ts', time.time())
-        line = json.dumps(rec, default=str)
-        with self._lock:
-            if self._closed:
-                return
-            self._f.write(line + '\n')
-            # flush per line: a stall/crash must not eat the events that
-            # explain it (the whole point of the stall watchdog)
-            self._f.flush()
+        data = (json.dumps(rec, default=str) + '\n').encode()
+        try:
+            os.write(self._fd, data)
+        except OSError:
+            # lost the race with close(): the fd was swapped to -1 (or
+            # freed) between the _closed check and the write — drop the
+            # line, count it, never raise into the emitter
+            self.dropped += 1
 
     def close(self) -> None:
-        with self._lock:
-            if not self._closed:
-                self._closed = True
-                self._f.close()
+        """Idempotent. The fd is swapped out *before* it is released so
+        a concurrent emit observes -1 (EBADF, counted as dropped) rather
+        than writing into a recycled descriptor."""
+        self._closed = True
+        fd, self._fd = self._fd, -1
+        if fd >= 0:
+            try:
+                os.close(fd)
+            except OSError:
+                self.dropped += 1       # double-release race: already shut
 
 
 # process-global sink: the trainer owns the lifecycle (init_run/set_sink);

@@ -65,8 +65,14 @@ def dump_all(reason: str) -> List[Dict[str, Any]]:
     for r in recs:
         try:
             out.append(r.dump(reason))
-        except Exception:   # noqa: BLE001 — never raise into the trigger
-            pass
+        except Exception as e:   # noqa: BLE001 — never raise into the
+            # trigger; the failed dump still leaves a record saying WHICH
+            # plane's forensics are missing and why (segfail
+            # exception-flow: best-effort must not mean silent)
+            out.append({'event': 'flight_dump', 'reason': reason,
+                        'source': getattr(r, 'source', '?'),
+                        'error': f'{type(e).__name__}: {e}',
+                        'records': 0, 'dump_records': []})
     return out
 
 

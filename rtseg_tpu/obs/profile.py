@@ -299,12 +299,16 @@ def capture_window(duration_s: float, depth: int = 2,
         raise CaptureBusy('a profiler capture is already in progress')
     tmp = trace_dir is None
     target = trace_dir or tempfile.mkdtemp(prefix='segprof_')
+    # segfail hot-lock suppressions below: _CAPTURE_LOCK intentionally
+    # serializes whole capture windows (sleep included) — every acquire
+    # in this module is non-blocking (CaptureBusy / skip), so no hot
+    # path can ever wait out these latencies behind the lock
     try:
         try:
-            os.makedirs(target, exist_ok=True)
+            os.makedirs(target, exist_ok=True)  # segcheck: disable=failpath
             jax.profiler.start_trace(target)
             try:
-                time.sleep(max(0.0, float(duration_s)))
+                time.sleep(max(0.0, float(duration_s)))  # segcheck: disable=failpath
             finally:
                 jax.profiler.stop_trace()
         finally:
@@ -315,8 +319,10 @@ def capture_window(duration_s: float, depth: int = 2,
             _CAPTURE_LOCK.release()
         return parse_trace(target, depth=depth)
     finally:
+        # lock-set inference can't see the early release above; the
+        # cleanup actually runs lock-free
         if tmp:
-            shutil.rmtree(target, ignore_errors=True)
+            shutil.rmtree(target, ignore_errors=True)  # segcheck: disable=failpath
 
 
 class SampledProfiler:
@@ -354,6 +360,9 @@ class SampledProfiler:
         self.depth = depth
         self.logger = logger
         self.captures = 0
+        #: segfail side channel: half-open-window teardowns that raised
+        #: (abort() is best-effort but must not be silent)
+        self.abort_errors = 0
         self._seq = 0                      # completed steps seen
         self._active: Optional[dict] = None
         self._disabled = False
@@ -376,8 +385,10 @@ class SampledProfiler:
         try:
             import jax
             jax.profiler.stop_trace()
-        except Exception:   # noqa: BLE001 — best-effort teardown
-            pass
+        except Exception:   # noqa: BLE001 — best-effort teardown, but a
+            # trace the profiler refused to stop will fail every later
+            # capture: keep that visible
+            self.abort_errors += 1
         _CAPTURE_LOCK.release()
         shutil.rmtree(a['dir'], ignore_errors=True)
 
@@ -393,7 +404,9 @@ class SampledProfiler:
         trace_dir = None
         try:
             import jax
-            jax.block_until_ready(state)   # fence: window opens idle
+            # fence: window opens idle. Held-lock sleep is the point —
+            # every _CAPTURE_LOCK acquire is non-blocking, nobody waits
+            jax.block_until_ready(state)  # segcheck: disable=failpath
             trace_dir = tempfile.mkdtemp(prefix='segprof_train_')
             jax.profiler.start_trace(trace_dir)
         except Exception:   # noqa: BLE001 — another trace active / no jax

@@ -65,6 +65,12 @@ class StallWatchdog:
         self.trace_len_s = trace_len_s
         self.logger = logger
         self.stall_count = 0
+        # failure-path side channels (segfail exception-flow pass): a
+        # watchdog that dies or misfires silently is the exact failure
+        # mode it exists to report, so both are counted where tests and
+        # operators can see them
+        self.poll_failures = 0      # poll iterations that raised
+        self.fire_errors = 0        # best-effort _fire sub-steps that raised
         self._durs: collections.deque = collections.deque(maxlen=128)
         self._lock = threading.Lock()
         self._last: Optional[tuple] = None     # (monotonic, step id)
@@ -116,20 +122,24 @@ class StallWatchdog:
     # ----------------------------------------------------------------- loop
     def _loop(self) -> None:
         while not self._stop.wait(self.poll_s):
-            with self._lock:
-                last, fired = self._last, self._fired
-            if last is None or fired:
-                continue
-            elapsed = time.monotonic() - last[0]
-            deadline = self.deadline_s()
-            if elapsed <= deadline:
-                continue
-            with self._lock:
-                self._fired = True              # once per missed beat
             try:
-                self._fire(elapsed, deadline, last[1])
+                self._poll_once()
             except Exception:   # noqa: BLE001 — never raise into the run
-                pass
+                with self._lock:
+                    self.poll_failures += 1
+
+    def _poll_once(self) -> None:
+        with self._lock:
+            last, fired = self._last, self._fired
+        if last is None or fired:
+            return
+        elapsed = time.monotonic() - last[0]
+        deadline = self.deadline_s()
+        if elapsed <= deadline:
+            return
+        with self._lock:
+            self._fired = True              # once per missed beat
+        self._fire(elapsed, deadline, last[1])
 
     def _fire(self, elapsed: float, deadline: float,
               step: Optional[int]) -> None:
@@ -160,7 +170,8 @@ class StallWatchdog:
                 top_ops = [{'name': n, 'ms': round(us / 1e3, 3)}
                            for n, us in prof.top_ops[:5]]
             except Exception:   # noqa: BLE001 — best-effort enrichment
-                pass
+                with self._lock:
+                    self.fire_errors += 1
         if self.sink is not None:
             self.sink.emit({'event': 'stall', 'step': step,
                             'elapsed_s': round(elapsed, 3),
@@ -174,7 +185,8 @@ class StallWatchdog:
             from .flight import dump_all
             dump_all('stall')
         except Exception:   # noqa: BLE001 — never raise into the run
-            pass
+            with self._lock:
+                self.fire_errors += 1
         if self.logger is not None:
             self.logger.error(
                 f'segscope: no step heartbeat for {elapsed:.1f}s '

@@ -206,6 +206,8 @@ class RolloutController:
         self._base: Dict[str, Dict[str, Any]] = {}
         self._primed = False
         self._outcome: Optional[Tuple[str, str]] = None
+        #: rollback flight dumps that raised (segfail side channel)
+        self.dump_failures = 0
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name=f'segship-rollout-{group}')
 
@@ -269,26 +271,36 @@ class RolloutController:
 
     def _loop(self) -> None:
         streak = (0, 0)
-        while not self._stop.wait(self.poll_s):
-            obs = self.observe()
-            action, reason, streak = decide(obs, self.policy, streak)
-            if action == 'hold':
-                continue
-            if action == 'promote':
-                golden = self._golden_gate()
-                if golden is not None and not golden.get('bit_identical'):
-                    # the live canary does not reproduce its own bake —
-                    # that is corruption/drift, not a promotable version
-                    action, reason = 'rollback', (
-                        f'golden replay mismatch: '
-                        f'{golden.get("agree")}/{golden.get("pairs")} '
-                        f'pairs bit-identical')
-                else:
-                    self._promote(reason, golden)
+        try:
+            while not self._stop.wait(self.poll_s):
+                obs = self.observe()
+                action, reason, streak = decide(obs, self.policy, streak)
+                if action == 'hold':
+                    continue
+                if action == 'promote':
+                    golden = self._golden_gate()
+                    if golden is not None and \
+                            not golden.get('bit_identical'):
+                        # the live canary does not reproduce its own
+                        # bake — corruption/drift, not promotable
+                        action, reason = 'rollback', (
+                            f'golden replay mismatch: '
+                            f'{golden.get("agree")}/{golden.get("pairs")} '
+                            f'pairs bit-identical')
+                    else:
+                        self._promote(reason, golden)
+                        return
+                if action == 'rollback':
+                    self._rollback(reason, obs)
                     return
-            if action == 'rollback':
-                self._rollback(reason, obs)
-                return
+        except Exception as e:   # noqa: BLE001 — a controller that died
+            # silently would leave wait() blocking until its timeout and
+            # the canary serving forever with nobody watching it; a
+            # crash is a terminal outcome like promote/rollback (segfail
+            # exception-flow)
+            with self._lock:
+                if self._outcome is None:
+                    self._outcome = ('error', f'{type(e).__name__}: {e}')
 
     # ------------------------------------------------------------- actions
     def _golden_gate(self) -> Optional[Dict[str, Any]]:
@@ -337,8 +349,10 @@ class RolloutController:
         try:
             from ..obs.flight import dump_all
             dump_all('rollback')
-        except Exception:   # noqa: BLE001 — never block the rollback
-            pass
+        except Exception:   # noqa: BLE001 — never block the rollback,
+            # but a lost forensic dump must stay visible (segfail)
+            with self._lock:
+                self.dump_failures += 1
         # arm cleared first: from here every request (the sticky canary
         # hash slice included) routes to stable, so the drain below is
         # invisible to clients

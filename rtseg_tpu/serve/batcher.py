@@ -89,7 +89,11 @@ class MicroBatcher:
         self.max_queue = int(max_queue)
         self.deadline_ms = deadline_ms
         self.trace = trace
-        self._queues: Dict[Bucket, deque] = {b: deque()
+        # bounded by admission, not by the deque: submit() rejects once
+        # the TOTAL queued count across buckets hits max_queue (under
+        # _cond), so no per-bucket maxlen exists that wouldn't silently
+        # drop admitted requests — justified segfail suppression
+        self._queues: Dict[Bucket, deque] = {b: deque()  # segcheck: disable=failpath
                                              for b in self.buckets}
         self._cond = threading.Condition()
         self._closed = False

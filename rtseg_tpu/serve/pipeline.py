@@ -163,58 +163,79 @@ class ServePipeline:
 
     # -------------------------------------------------------------- stages
     def _dispatch_loop(self) -> None:
-        while True:
-            got = self.batcher.get_batch(timeout=0.05)
-            if got is None:
-                if self._closing:
+        # the whole loop runs under one broad shield (segfail
+        # exception-flow): a dispatcher that dies silently — get_batch
+        # raising, not just the engine — wedges every client forever,
+        # so any escape poisons the pipeline and fails pending work
+        try:
+            while True:
+                got = self.batcher.get_batch(timeout=0.05)
+                if got is None:
+                    if self._closing:
+                        break
+                    continue
+                bucket, reqs = got
+                try:
+                    with span('serve/assemble', record=False):
+                        arr = assemble_batch([r.image for r in reqs],
+                                             bucket, self.engine.batch)
+                    t_d0 = time.perf_counter()
+                    with span('serve/dispatch', record=False):
+                        dev = self.engine.dispatch(bucket, arr)
+                    t_d1 = time.perf_counter()
+                except BaseException as e:  # noqa: BLE001 — engine dead
+                    self.error = e
+                    # every admitted request must reach a terminal
+                    # serve_requests_total status — this batch errors
+                    # here, the still-queued ones inside fail_all
+                    self._c_error.inc(len(reqs))
+                    for r in reqs:
+                        r.future.set_exception(e)
+                    self.batcher.close()
+                    self.batcher.fail_all(e)
                     break
-                continue
-            bucket, reqs = got
+                self._inflight.put((bucket, reqs, t_d0, t_d1, dev))
+                self._g_inflight.set(self._inflight.qsize())
+        except BaseException as e:   # noqa: BLE001 — loop itself died
+            self.error = e
+            self._c_error.inc()
             try:
-                with span('serve/assemble', record=False):
-                    arr = assemble_batch([r.image for r in reqs], bucket,
-                                         self.engine.batch)
-                t_d0 = time.perf_counter()
-                with span('serve/dispatch', record=False):
-                    dev = self.engine.dispatch(bucket, arr)
-                t_d1 = time.perf_counter()
-            except BaseException as e:   # noqa: BLE001 — engine is dead
-                self.error = e
-                # every admitted request must reach a terminal
-                # serve_requests_total status — this batch errors here,
-                # the still-queued ones inside fail_all
-                self._c_error.inc(len(reqs))
-                for r in reqs:
-                    r.future.set_exception(e)
                 self.batcher.close()
                 self.batcher.fail_all(e)
-                break
-            self._inflight.put((bucket, reqs, t_d0, t_d1, dev))
-            self._g_inflight.set(self._inflight.qsize())
+            except Exception:   # noqa: BLE001 — cleanup is best-effort
+                self._c_error.inc()
         self._inflight.put(_DONE)
 
     def _readback_loop(self) -> None:
-        while True:
-            item = self._inflight.get()
-            if item is _DONE:
-                break
-            self._g_inflight.set(self._inflight.qsize())
-            bucket, reqs, t_d0, t_d1, dev = item
-            try:
-                with span('serve/readback', record=False):
-                    host = np.asarray(dev)
-            except BaseException as e:   # noqa: BLE001 — async dispatch
-                # XLA runtime errors (device OOM, bad buffer) surface at
-                # the first block on the result, i.e. HERE, not at the
-                # dispatch call — resolve this batch's futures instead of
-                # letting the thread die and wedge the whole pipeline
-                self._c_error.inc(len(reqs))
-                for r in reqs:
-                    r.future.set_exception(e)
-                continue
-            t_done = time.perf_counter()
-            for i, r in enumerate(reqs):
-                self._post.submit(self._finish, r, host[i], t_d1, t_done)
+        try:
+            while True:
+                item = self._inflight.get()
+                if item is _DONE:
+                    break
+                self._g_inflight.set(self._inflight.qsize())
+                bucket, reqs, t_d0, t_d1, dev = item
+                try:
+                    with span('serve/readback', record=False):
+                        host = np.asarray(dev)
+                except BaseException as e:  # noqa: BLE001 — async
+                    # dispatch: XLA runtime errors (device OOM, bad
+                    # buffer) surface at the first block on the result,
+                    # i.e. HERE, not at the dispatch call — resolve this
+                    # batch's futures instead of letting the thread die
+                    # and wedge the whole pipeline
+                    self._c_error.inc(len(reqs))
+                    for r in reqs:
+                        r.future.set_exception(e)
+                    continue
+                t_done = time.perf_counter()
+                for i, r in enumerate(reqs):
+                    self._post.submit(self._finish, r, host[i], t_d1,
+                                      t_done)
+        except BaseException as e:   # noqa: BLE001 — reader died (e.g.
+            # post-pool submit after shutdown): poison the pipeline so
+            # submit() raises instead of hanging clients silently
+            self.error = e
+            self._c_error.inc()
 
     def _finish(self, r: Request, row: np.ndarray, t_disp: float,
                 t_done: float) -> None:

@@ -116,6 +116,10 @@ class ServeHTTPServer(ThreadingHTTPServer):
         self._draining = False
         self._exit_waiter = False
         self._inflight = 0
+        #: drain-waiter failures (segfail exception-flow side channel):
+        #: a drain that dies silently leaves the process serving 503s
+        #: forever, so the health endpoint must be able to say why
+        self.drain_errors = 0
         super().__init__(addr, _Handler)
 
     # ------------------------------------------------------------ lifecycle
@@ -157,7 +161,13 @@ class ServeHTTPServer(ThreadingHTTPServer):
                 if self._inflight == 0:
                     break
             time.sleep(0.02)
-        self.shutdown()
+        try:
+            self.shutdown()
+        except Exception:   # noqa: BLE001 — accept loop already torn
+            # down (e.g. server_close raced us): record, don't die
+            # silently in a daemon thread (segfail exception-flow)
+            with self._state_lock:
+                self.drain_errors += 1
 
     def health(self) -> dict:
         with self._state_lock:

@@ -194,6 +194,7 @@ class ExeCache:
         self.misses = 0
         self.fallbacks = 0            # artifact present but unloadable
         self.store_failures = 0
+        self.stats_failures = 0       # hit-count sidecar RMWs that raised
         self.bytes_read = 0
         self.bytes_written = 0
         self.hit_s = 0.0              # deserialize time
@@ -336,8 +337,11 @@ class ExeCache:
             meta['hits'] = int(meta.get('hits', 0)) + 1
             meta['last_used'] = time.time()
             _atomic_write(meta_path, json.dumps(meta, indent=1).encode())
-        except Exception:   # noqa: BLE001 — stats bookkeeping only
-            pass
+        except Exception:   # noqa: BLE001 — stats bookkeeping only,
+            # but a sidecar that never updates reads as a cold entry to
+            # the eviction policy: keep the failure countable (segfail)
+            with self._lock:
+                self.stats_failures += 1
         finally:
             lock_f.close()    # releases the flock
 

@@ -20,6 +20,7 @@ Run: python tests/_fleet_stub.py --port-file P --replica-id ID
 import argparse
 import json
 import os
+import signal
 import sys
 import threading
 import time
@@ -142,7 +143,11 @@ def main() -> int:
         os.replace(tmp, args.port_file)
     print(f'fleet-stub {args.replica_id} on {args.host}:{port}',
           flush=True)
-    server.serve_forever()     # returns after /drain?exit=1 completes
+    # same SIGTERM==drain contract as tools/segserve.py serve: stop
+    # admitting, answer in-flight work, stop the accept loop, exit 0
+    signal.signal(signal.SIGTERM,
+                  lambda *_: server.begin_drain(exit_after=True))
+    server.serve_forever()     # returns after drain (POST or SIGTERM)
     return 0
 
 

@@ -1,0 +1,742 @@
+"""segfail (analysis/failpath.py): the static failure-path auditor must
+be green on the real tree, the committed SEGFAIL.json must reconcile
+exactly with the observed census in both directions, every pass must
+catch its seeded violation next to a clean twin (a lint that cannot fail
+its negative test is decoration, not enforcement), --update-failpath
+must refuse to pin an incoherent tree, and the suppression budget may
+only go down.
+
+Also here: the regression tests for the real findings this rule turned
+up (EventSink close race, watchdog poll shield, flight-dump error
+records, prefetcher error hand-off, rollout crash outcome) and the
+SIGTERM==drain contract e2e (ROADMAP item 5 down-payment) — one process,
+one in-flight request, zero client-visible errors, exit 0.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+import types
+import urllib.request
+
+import pytest
+
+from rtseg_tpu.analysis import check_failpath, update_failpath
+from rtseg_tpu.analysis.failpath import (SEGFAIL_FILE, P_EXC, P_LOCK,
+                                         P_RES, load_sidecar, observe,
+                                         sidecar_path)
+from rtseg_tpu.analysis.core import ALL_RULES, RULE_FAILPATH, repo_root
+
+REPO = repo_root()
+STUB = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                    '_fleet_stub.py')
+SEGCHECK = os.path.join(REPO, 'tools', 'segcheck.py')
+SEED = 'rtseg_tpu/serve/seed.py'
+
+
+def _write(root, relpath, text):
+    path = os.path.join(str(root), relpath)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, 'w') as f:
+        f.write(textwrap.dedent(text))
+
+
+def _msgs(findings):
+    return '\n'.join(str(f) for f in findings)
+
+
+def _with(findings, fragment):
+    return [f for f in findings if fragment in f.message]
+
+
+@pytest.fixture(scope='module')
+def real_obs():
+    return observe(REPO)
+
+
+# ---------------------------------------------------------- positive gates
+def test_real_tree_failpath_clean():
+    """The committed tree passes the failpath rule — the CI gate. Every
+    true finding was fixed in this PR or carries a justified, counted
+    suppression."""
+    fs = check_failpath(REPO)
+    assert fs == [], _msgs(fs)
+
+
+def test_rule_registered():
+    assert RULE_FAILPATH in ALL_RULES
+
+
+def test_real_tree_matches_sidecar_exactly(real_obs):
+    """The committed SEGFAIL.json is exactly the observed census, both
+    directions on all four surfaces: every concurrent entry point /
+    bounded-buffer site / hot-plane lock the tree has is pinned AND
+    nothing pinned has quietly left the tree."""
+    sidecar = load_sidecar(REPO)
+    assert sidecar is not None, f'{SEGFAIL_FILE} must be committed'
+    observed = real_obs.to_sidecar()     # raises if incoherent
+    for surface in ('entry_points', 'bounded', 'hot_locks',
+                    'suppressions'):
+        assert observed[surface] == sidecar[surface], surface
+
+
+def test_sidecar_pins_core_census():
+    """Spot-checks grounding the census in known runtime shapes: the
+    serve pipeline's two loops are audited entries, its inflight queue
+    is pinned with its exact bound spelling, the batcher's deque rides
+    on a counted suppression, and the hot-plane lock list includes the
+    batcher condition and the profiler capture lock."""
+    sidecar = load_sidecar(REPO)
+    entries = set(sidecar['entry_points'])
+    assert 'rtseg_tpu/serve/pipeline.py:ServePipeline._dispatch_loop' \
+        in entries
+    assert 'rtseg_tpu/serve/pipeline.py:ServePipeline._readback_loop' \
+        in entries
+    assert 'rtseg_tpu/obs/watchdog.py:StallWatchdog._loop' in entries
+    bounded = sidecar['bounded']
+    assert bounded['rtseg_tpu/serve/pipeline.py:ServePipeline._inflight'] \
+        == ['maxsize=max(1, inflight)']
+    assert bounded['rtseg_tpu/serve/batcher.py:MicroBatcher._queues'] \
+        == ['suppressed']
+    locks = set(sidecar['hot_locks'])
+    assert 'rtseg_tpu/serve/batcher.py:MicroBatcher._cond' in locks
+    assert 'rtseg_tpu/obs/profile.py:_CAPTURE_LOCK' in locks
+
+
+def test_suppression_budget_only_goes_down(real_obs):
+    """The full justified-suppression budget of the tree, by pass:
+    2 exception-flow (workers.py cv2 decode swallow + __del__ teardown),
+    1 resource-lifecycle (batcher deque, admission bounded under _cond),
+    4 hot-lock (profile.py — every _CAPTURE_LOCK acquire is
+    non-blocking, so no hot waiter exists). Fixing a site lowers a
+    number; never raise one without a justification comment on the
+    line AND a conscious re-pin."""
+    assert real_obs.suppression_census() == {
+        P_EXC: 2, P_RES: 1, P_LOCK: 4}
+
+
+# ----------------------------------- pass 1a: silent-death thread entries
+_ENTRY_BAD = '''
+    import threading
+
+    class Poller:
+        def __init__(self):
+            self.errors = 0
+            self._stop = threading.Event()
+            self._t = threading.Thread(target=self._loop, daemon=True)
+
+        def stop(self):
+            self._stop.set()
+            self._t.join()
+
+        def _loop(self):
+            while not self._stop.is_set():
+                self.fetch_once()
+
+        def fetch_once(self):
+            return None
+    '''
+
+_ENTRY_OK = '''
+    import threading
+
+    class Poller:
+        def __init__(self):
+            self.errors = 0
+            self._stop = threading.Event()
+            self._t = threading.Thread(target=self._loop, daemon=True)
+
+        def stop(self):
+            self._stop.set()
+            self._t.join()
+
+        def _loop(self):
+            try:
+                while not self._stop.is_set():
+                    self.fetch_once()
+            except Exception:
+                self.errors += 1
+
+        def fetch_once(self):
+            return None
+    '''
+
+
+def test_silent_death_entry_detected(tmp_path):
+    _write(tmp_path, SEED, _ENTRY_BAD)
+    hits = _with(check_failpath(str(tmp_path)), 'can die silently')
+    assert hits, 'unprotected thread entry must be a finding'
+    assert f'{SEED}:Poller._loop' in hits[0].message
+    assert 'fetch_once()' in hits[0].message
+
+
+def test_protected_entry_clean(tmp_path):
+    _write(tmp_path, SEED, _ENTRY_OK)
+    update_failpath(str(tmp_path))
+    fs = check_failpath(str(tmp_path))
+    assert fs == [], _msgs(fs)
+
+
+# --------------------------------------- pass 1b: broad swallowing except
+_SWALLOW_BAD = '''
+    def probe(sock):
+        try:
+            sock.send(b'x')
+        except Exception:
+            pass
+    '''
+
+_SWALLOW_OK = '''
+    def probe(sock, stats):
+        try:
+            sock.send(b'x')
+        except Exception:
+            stats['probe_errors'] = stats.get('probe_errors', 0) + 1
+    '''
+
+
+def test_swallowing_except_detected(tmp_path):
+    _write(tmp_path, SEED, _SWALLOW_BAD)
+    hits = _with(check_failpath(str(tmp_path)),
+                 'swallows the exception with no side channel')
+    assert len(hits) == 1, _msgs(hits)
+
+
+def test_recording_except_clean(tmp_path):
+    _write(tmp_path, SEED, _SWALLOW_OK)
+    fs = check_failpath(str(tmp_path))
+    assert fs == [], _msgs(fs)
+
+
+# ------------------------------------------ pass 2a: local resource leaks
+def test_straight_line_close_leaks(tmp_path):
+    """f.close() not in a finally leaks on the exception path between
+    acquire and close — the with/finally shapes next door are clean."""
+    _write(tmp_path, SEED, '''
+        def read_manifest(path):
+            f = open(path)
+            data = f.read()
+            f.close()
+            return data
+        ''')
+    hits = _with(check_failpath(str(tmp_path)),
+                 'acquires a open() resource that is not released')
+    assert len(hits) == 1, _msgs(hits)
+
+
+def test_with_and_finally_release_clean(tmp_path):
+    _write(tmp_path, SEED, '''
+        def read_manifest(path):
+            with open(path) as f:
+                return f.read()
+
+        def read_tail(path):
+            f = open(path)
+            try:
+                return f.read()
+            finally:
+                f.close()
+        ''')
+    fs = check_failpath(str(tmp_path))
+    assert fs == [], _msgs(fs)
+
+
+# --------------------------------------- pass 2b/2c: field-held lifecycle
+def test_field_resource_without_release_detected(tmp_path):
+    _write(tmp_path, SEED, '''
+        class Writer:
+            def __init__(self, path):
+                self._f = open(path, 'a')
+        ''')
+    hits = _with(check_failpath(str(tmp_path)),
+                 'holds a open() resource but no owner release method')
+    assert len(hits) == 1, _msgs(hits)
+    assert "'self._f' of Writer" in hits[0].message
+
+
+def test_field_resource_with_release_clean(tmp_path):
+    _write(tmp_path, SEED, '''
+        class Writer:
+            def __init__(self, path):
+                self._f = open(path, 'a')
+
+            def close(self):
+                self._f.close()
+        ''')
+    fs = check_failpath(str(tmp_path))
+    assert fs == [], _msgs(fs)
+
+
+def test_thread_field_without_stop_detected(tmp_path):
+    _write(tmp_path, SEED, '''
+        import threading
+
+        class Beater:
+            def start(self):
+                self._t = threading.Thread(target=self._tick,
+                                           daemon=True)
+                self._t.start()
+
+            def _tick(self):
+                return None
+        ''')
+    hits = _with(check_failpath(str(tmp_path)),
+                 'is started but no stop-family method')
+    assert len(hits) == 1, _msgs(hits)
+
+
+def test_thread_field_with_join_clean(tmp_path):
+    _write(tmp_path, SEED, '''
+        import threading
+
+        class Beater:
+            def start(self):
+                self._t = threading.Thread(target=self._tick,
+                                           daemon=True)
+                self._t.start()
+
+            def stop(self):
+                self._t.join()
+
+            def _tick(self):
+                return None
+        ''')
+    update_failpath(str(tmp_path))
+    fs = check_failpath(str(tmp_path))
+    assert fs == [], _msgs(fs)
+
+
+# -------------------------------------- pass 2d: unstoppable loop targets
+def test_unstoppable_while_true_detected(tmp_path):
+    _write(tmp_path, SEED, '''
+        import threading
+        import time
+
+        class Spin:
+            def start(self):
+                self._t = threading.Thread(target=self._loop,
+                                           daemon=True)
+                self._t.start()
+
+            def stop(self):
+                self._t.join()
+
+            def _loop(self):
+                while True:
+                    time.sleep(0.1)
+        ''')
+    hits = _with(check_failpath(str(tmp_path)),
+                 'loops `while True` with no break/return')
+    assert len(hits) == 1, _msgs(hits)
+
+
+def test_stop_event_loop_clean(tmp_path):
+    _write(tmp_path, SEED, '''
+        import threading
+        import time
+
+        class Spin:
+            def __init__(self):
+                self._stop = threading.Event()
+
+            def start(self):
+                self._t = threading.Thread(target=self._loop,
+                                           daemon=True)
+                self._t.start()
+
+            def stop(self):
+                self._stop.set()
+                self._t.join()
+
+            def _loop(self):
+                while not self._stop.is_set():
+                    time.sleep(0.1)
+        ''')
+    update_failpath(str(tmp_path))
+    fs = check_failpath(str(tmp_path))
+    assert fs == [], _msgs(fs)
+
+
+# ------------------------------------------- pass 2e: unbounded buffering
+def test_unbounded_queue_detected(tmp_path):
+    _write(tmp_path, SEED, '''
+        import queue
+
+        class Mailbox:
+            def __init__(self):
+                self._q = queue.Queue()
+        ''')
+    hits = _with(check_failpath(str(tmp_path)),
+                 'unbounded Queue() in a runtime plane')
+    assert len(hits) == 1, _msgs(hits)
+    assert f'{SEED}:Mailbox._q' in hits[0].message
+
+
+def test_bounded_queue_clean_and_pinned(tmp_path):
+    _write(tmp_path, SEED, '''
+        import queue
+
+        class Mailbox:
+            def __init__(self):
+                self._q = queue.Queue(maxsize=8)
+        ''')
+    data = update_failpath(str(tmp_path))
+    assert data['bounded'][f'{SEED}:Mailbox._q'] == ['maxsize=8']
+    fs = check_failpath(str(tmp_path))
+    assert fs == [], _msgs(fs)
+
+
+# --------------------------------------- pass 3: blocking under hot locks
+_HOT_BAD = '''
+    import json
+    import threading
+
+    class Ledger:
+        def __init__(self, path):
+            self.path = path
+            self._lock = threading.Lock()
+            self._rows = []
+
+        def add(self, row):
+            with self._lock:
+                self._rows.append(row)
+                with open(self.path, 'a') as f:
+                    json.dump(row, f)
+    '''
+
+_HOT_OK = '''
+    import json
+    import threading
+
+    class Ledger:
+        def __init__(self, path):
+            self.path = path
+            self._lock = threading.Lock()
+            self._rows = []
+
+        def add(self, row):
+            with self._lock:
+                self._rows.append(row)
+                rows = list(self._rows)
+            with open(self.path, 'a') as f:
+                json.dump(rows, f)
+    '''
+
+
+def test_blocking_under_hot_lock_detected(tmp_path):
+    _write(tmp_path, SEED, _HOT_BAD)
+    hits = _with(check_failpath(str(tmp_path)),
+                 'while holding hot-path lock(s)')
+    assert hits, 'file I/O under a serve-plane lock must be a finding'
+    assert any(f'{SEED}:Ledger._lock' in f.message for f in hits)
+
+
+def test_snapshot_then_write_outside_clean(tmp_path):
+    """The flight-recorder shape the finding message prescribes:
+    snapshot under the lock, do the blocking write outside it."""
+    _write(tmp_path, SEED, _HOT_OK)
+    data = update_failpath(str(tmp_path))
+    assert f'{SEED}:Ledger._lock' in data['hot_locks']
+    fs = check_failpath(str(tmp_path))
+    assert fs == [], _msgs(fs)
+
+
+# --------------------------------------------- the SEGFAIL.json lifecycle
+def test_sidecar_lifecycle_missing_pin_drift_repin(tmp_path):
+    _write(tmp_path, SEED, _ENTRY_OK)
+    # 1. coherent tree, no sidecar: the gate demands a pin
+    hits = _with(check_failpath(str(tmp_path)),
+                 f'{SEGFAIL_FILE} is missing but the tree has')
+    assert len(hits) == 1, _msgs(hits)
+    # 2. pin it: gate goes green
+    data = update_failpath(str(tmp_path))
+    assert data['entry_points'] == [f'{SEED}:Poller._loop']
+    assert check_failpath(str(tmp_path)) == []
+    # 3. a new entry point drifts from the pin
+    _write(tmp_path, 'rtseg_tpu/fleet/seed2.py', _ENTRY_OK)
+    hits = _with(check_failpath(str(tmp_path)),
+                 'new concurrent entry point')
+    assert len(hits) == 1, _msgs(hits)
+    assert 'rtseg_tpu/fleet/seed2.py:Poller._loop' in hits[0].message
+    # 4. ...and a removed one is flagged from the other direction
+    _write(tmp_path, SEED, 'def nothing():\n    return None\n')
+    hits = _with(check_failpath(str(tmp_path)), 'gone from the tree')
+    assert any(f"'{SEED}:Poller._loop'" in f.message for f in hits)
+    # 5. re-pin: green again
+    update_failpath(str(tmp_path))
+    fs = check_failpath(str(tmp_path))
+    assert fs == [], _msgs(fs)
+
+
+def test_buffer_bound_drift_detected(tmp_path):
+    _write(tmp_path, SEED, '''
+        import queue
+
+        class Mailbox:
+            def __init__(self):
+                self._q = queue.Queue(maxsize=8)
+        ''')
+    update_failpath(str(tmp_path))
+    _write(tmp_path, SEED, '''
+        import queue
+
+        class Mailbox:
+            def __init__(self):
+                self._q = queue.Queue(maxsize=64)
+        ''')
+    hits = _with(check_failpath(str(tmp_path)), 'drifted')
+    assert len(hits) == 1, _msgs(hits)
+    assert 'maxsize=8' in hits[0].message
+    assert 'maxsize=64' in hits[0].message
+
+
+def test_update_refuses_incoherent_tree(tmp_path):
+    """--update-failpath never grandfathers a live hazard: it raises and
+    writes nothing while the tree has unsuppressed findings."""
+    _write(tmp_path, SEED, _SWALLOW_BAD)
+    with pytest.raises(ValueError, match='refusing to pin'):
+        update_failpath(str(tmp_path))
+    assert not os.path.exists(sidecar_path(str(tmp_path)))
+
+
+def test_suppression_budget_monotone(tmp_path):
+    _write(tmp_path, SEED, '''
+        def probe(sock):
+            try:
+                sock.send(b'x')
+            except Exception:   # segcheck: disable=failpath — demo
+                pass
+        ''')
+    data = update_failpath(str(tmp_path))
+    assert data['suppressions'][P_EXC] == 1
+    assert check_failpath(str(tmp_path)) == []
+    # pin lowered under the observed count: "budget only goes down"
+    data['suppressions'][P_EXC] = 0
+    with open(sidecar_path(str(tmp_path)), 'w') as f:
+        json.dump(data, f)
+    hits = _with(check_failpath(str(tmp_path)), 'only goes down')
+    assert len(hits) == 1, _msgs(hits)
+    # pin above the observed count: a suppression was removed, lock the
+    # lower budget in
+    data['suppressions'][P_EXC] = 2
+    with open(sidecar_path(str(tmp_path)), 'w') as f:
+        json.dump(data, f)
+    hits = _with(check_failpath(str(tmp_path)), 'is stale')
+    assert len(hits) == 1, _msgs(hits)
+
+
+# ----------------------------------------------------------------- CLI e2e
+def test_cli_failpath_rule_green():
+    r = subprocess.run(
+        [sys.executable, SEGCHECK, '--lint-only', '--rules', 'failpath'],
+        capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert '0 finding(s)' in r.stdout
+
+
+def test_cli_drift_drill_and_update_refusal(tmp_path):
+    """The CI drift drill: a seeded `except: pass` in serve/ turns the
+    failpath gate red, and --update-failpath refuses to launder it."""
+    _write(tmp_path, 'rtseg_tpu/serve/bad.py', _SWALLOW_BAD)
+    args = [sys.executable, SEGCHECK, '--root', str(tmp_path),
+            '--lint-only', '--rules', 'failpath']
+    r = subprocess.run(args, capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert 'swallows the exception' in r.stdout
+    r = subprocess.run(args + ['--update-failpath'],
+                       capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 1
+    assert 'refusing to pin' in r.stderr
+    assert not os.path.exists(os.path.join(str(tmp_path), SEGFAIL_FILE))
+
+
+def test_cli_update_failpath_pins_scratch_tree(tmp_path):
+    _write(tmp_path, SEED, _ENTRY_OK)
+    args = [sys.executable, SEGCHECK, '--root', str(tmp_path),
+            '--lint-only', '--rules', 'failpath']
+    r = subprocess.run(args + ['--update-failpath'],
+                       capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert 're-pinned' in r.stdout
+    with open(os.path.join(str(tmp_path), SEGFAIL_FILE)) as f:
+        data = json.load(f)
+    assert data['entry_points'] == [f'{SEED}:Poller._loop']
+    r = subprocess.run(args, capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+# ------------------------------- regressions for the findings this PR fixed
+def test_event_sink_close_race_counts_drops(tmp_path):
+    """The lock-free sink redesign: close() swaps the fd out before
+    releasing it, so an emit that won the _closed check but lost the fd
+    race is counted in `dropped`, never raised and never written into a
+    recycled descriptor."""
+    from rtseg_tpu.obs.core import EventSink
+    path = str(tmp_path / 'events.jsonl')
+    sink = EventSink(path)
+    sink.emit({'event': 'a'})
+    sink.close()
+    sink.close()                         # idempotent
+    sink.emit({'event': 'b'})            # after close: silent no-op
+    # reopen exactly the race window close() defends: emit already past
+    # the _closed check when the fd went to -1
+    sink._closed = False
+    sink.emit({'event': 'c'})
+    assert sink.dropped == 1
+    with open(path) as f:
+        recs = [json.loads(line) for line in f]
+    assert [r['event'] for r in recs] == ['a']
+
+
+def test_event_sink_concurrent_emit_atomic_lines(tmp_path):
+    """O_APPEND + one os.write per event: concurrent emitters never
+    produce a torn or interleaved line."""
+    from rtseg_tpu.obs.core import EventSink
+    path = str(tmp_path / 'events.jsonl')
+    sink = EventSink(path)
+    n_threads, n_each = 4, 50
+
+    def pump(tid):
+        for i in range(n_each):
+            sink.emit({'event': 'x', 'tid': tid, 'i': i,
+                       'pad': 'y' * 256})
+
+    threads = [threading.Thread(target=pump, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    sink.close()
+    with open(path) as f:
+        recs = [json.loads(line) for line in f]   # raises on a torn line
+    assert len(recs) == n_threads * n_each
+    assert {(r['tid'], r['i']) for r in recs} \
+        == {(t, i) for t in range(n_threads) for i in range(n_each)}
+
+
+def test_watchdog_survives_poll_crash():
+    """A poll iteration that raises must not kill the watchdog thread —
+    it is counted in poll_failures and the loop keeps running."""
+    from rtseg_tpu.obs.watchdog import StallWatchdog
+    wd = StallWatchdog(None, poll_s=0.01)
+
+    def boom():
+        raise RuntimeError('poll boom')
+
+    wd._poll_once = boom
+    wd.start()
+    try:
+        deadline = time.monotonic() + 5.0
+        while wd.poll_failures < 3 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert wd.poll_failures >= 3
+        assert wd._thread is not None and wd._thread.is_alive()
+    finally:
+        wd.stop()
+
+
+def test_flight_dump_all_failed_dump_leaves_record():
+    """A recorder whose dump raises must not take down the trigger, and
+    the failure is a record saying WHICH plane's forensics are missing —
+    not a silent omission."""
+    from rtseg_tpu.obs.flight import FlightRecorder, dump_all, register
+
+    class Broken(FlightRecorder):
+        def dump(self, reason, sink=None, emit=True):
+            raise RuntimeError('ring poisoned')
+
+    rec = Broken(capacity=4, source='segfail-unit')
+    register(rec)
+    out = dump_all('unit-test')
+    mine = [r for r in out if r.get('source') == 'segfail-unit']
+    assert len(mine) == 1
+    assert mine[0]['error'] == 'RuntimeError: ring poisoned'
+    assert mine[0]['records'] == 0
+    assert mine[0]['dump_records'] == []
+
+
+def test_prefetch_source_iter_error_reaches_consumer():
+    """A source whose __iter__ raises must surface that exception in the
+    consumer, not present as a silently empty epoch (the iter() call now
+    sits inside the producer's exception shield)."""
+    from rtseg_tpu.data.segpipe.prefetch import DevicePrefetcher
+
+    class BadSource:
+        def __iter__(self):
+            raise RuntimeError('bad-source')
+
+    pf = DevicePrefetcher(BadSource(), put_fn=lambda x: x)
+    try:
+        with pytest.raises(RuntimeError, match='bad-source'):
+            next(iter(pf))
+    finally:
+        pf.close()
+
+
+def test_rollout_loop_crash_is_terminal_error_outcome():
+    """A controller whose polling loop raises records ('error', ...) as
+    a terminal outcome — wait() unblocks and nobody is left watching a
+    canary that nobody is actually judging."""
+    from rtseg_tpu.registry.rollout import RolloutController
+    ctl = RolloutController(router=types.SimpleNamespace(), manager=None,
+                            registry=None, group='g', canary_version='v2',
+                            canary_group_name='g-canary', poll_s=0.01)
+    ctl._loop()          # observe() hits the attribute-less fake router
+    out = ctl.outcome
+    assert out is not None and out[0] == 'error'
+    assert 'AttributeError' in out[1]
+
+
+# --------------------------------------- ROADMAP item 5: SIGTERM == drain
+def test_sigterm_drains_in_flight_and_exits_zero(tmp_path):
+    """kill -TERM on a serving process is a graceful drain: the
+    in-flight request completes with 200, nothing is dropped on the
+    floor, and the process exits 0 — the contract fleet schedulers and
+    `segserve.py serve` under systemd/k8s rely on."""
+    port_file = str(tmp_path / 'port')
+    proc = subprocess.Popen(
+        [sys.executable, STUB, '--port-file', port_file,
+         '--replica-id', 'r-term', '--delay-ms', '400'],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    try:
+        deadline = time.monotonic() + 15.0
+        while not os.path.exists(port_file):
+            assert time.monotonic() < deadline, 'stub never bound'
+            assert proc.poll() is None, proc.communicate()[1]
+            time.sleep(0.02)
+        with open(port_file) as f:
+            port = int(f.read().strip())
+        url = f'http://127.0.0.1:{port}/predict?raw=1'
+        result = {}
+
+        def request():
+            req = urllib.request.Request(url, data=b'x')
+            try:
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    result['status'] = resp.status
+                    result['body'] = resp.read()
+            except Exception as e:       # noqa: BLE001 — assert below
+                result['error'] = e
+
+        t = threading.Thread(target=request)
+        t.start()
+        time.sleep(0.15)                 # let the request get admitted
+        proc.send_signal(signal.SIGTERM)
+        t.join(timeout=30)
+        assert not t.is_alive()
+        assert 'error' not in result, result.get('error')
+        assert result['status'] == 200
+        assert len(result['body']) == 16     # the full 4x4 int8 mask
+        assert proc.wait(timeout=15) == 0
+        _, err = proc.communicate()
+        assert 'Traceback' not in err, err
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)

@@ -10,6 +10,7 @@ Usage:
     python tools/segcheck.py --deep --update-budget   # re-pin SEGAUDIT.json
     python tools/segcheck.py --update-lockgraph       # re-pin SEGRACE.json
     python tools/segcheck.py --update-contracts       # re-pin SEGCONTRACT.json
+    python tools/segcheck.py --update-failpath        # re-pin SEGFAIL.json
 
 Rules (suppress one finding with `# segcheck: disable=<rule>` on its line):
     import-hygiene        torch/torchvision never import at module scope
@@ -31,6 +32,13 @@ Rules (suppress one finding with `# segcheck: disable=<rule>` on its line):
                           serve/headers.py constants; raw X-* literals
                           elsewhere are findings), all pinned in
                           SEGCONTRACT.json
+    failpath              segfail: failure-path auditor — silent-death
+                          thread entries and swallowing broad excepts
+                          (exception-flow), resource release / thread
+                          stop / bounded-buffer discipline
+                          (resource-lifecycle), and blocking calls
+                          under serve/obs hot-plane locks (hot-lock),
+                          census pinned in SEGFAIL.json
 
 Audit: jax.eval_shape sweep of every registry model (aux/detail variants
 included) asserting the [B, H, W, num_class] eval contract — no weights
@@ -97,6 +105,12 @@ def main(argv=None) -> int:
                          'gate runs; refuses while the contract itself '
                          'is incoherent (orphan consumers, unregistered '
                          'metric references, raw X-* literals)')
+    ap.add_argument('--update-failpath', action='store_true',
+                    help='rewrite SEGFAIL.json with the observed '
+                         'failure-path census (entry points, bounded '
+                         'buffers, hot locks, suppression budget) '
+                         'before the lint gate runs; refuses while the '
+                         'tree still has live failure-path findings')
     ap.add_argument('-q', '--quiet', action='store_true',
                     help='print findings only, no summary')
     args = ap.parse_args(argv)
@@ -111,6 +125,9 @@ def main(argv=None) -> int:
                  '--audit-only')
     if args.update_contracts and args.audit_only:
         ap.error('--update-contracts is a lint-tier operation; drop '
+                 '--audit-only')
+    if args.update_failpath and args.audit_only:
+        ap.error('--update-failpath is a lint-tier operation; drop '
                  '--audit-only')
 
     try:
@@ -147,6 +164,22 @@ def main(argv=None) -> int:
                   f'({len(data["events"])} event types, '
                   f'{len(data["metrics"])} metric families, '
                   f'{len(data["headers"])} headers)')
+    if args.update_failpath:
+        # pure-AST, no jax: re-pin the failure-path census, then let
+        # the normal lint gate below verify the tree against it
+        from rtseg_tpu.analysis.failpath import update_failpath
+        try:
+            data = update_failpath(root)
+        except ValueError as e:          # live findings: nothing written
+            print(f'segcheck: {e}', file=sys.stderr)
+            return 1
+        if not args.quiet:
+            print(f'segcheck: SEGFAIL.json re-pinned '
+                  f'({len(data["entry_points"])} entry points, '
+                  f'{len(data["bounded"])} bounded buffer sites, '
+                  f'{len(data["hot_locks"])} hot locks, '
+                  f'{sum(data["suppressions"].values())} '
+                  f'suppressions)')
     if not args.audit_only:
         rules = [r.strip() for r in args.rules.split(',')] \
             if args.rules else None

@@ -34,6 +34,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import signal
 import sys
 import threading
 
@@ -206,6 +207,13 @@ def cmd_serve(args) -> int:
           f'{args.buckets} x batch {engine.batch} | POST /predict{extra} '
           f'/drain /debug/profile?ms=, GET /healthz /stats /metrics',
           flush=True)
+    # SIGTERM == graceful drain (ROADMAP item 5): a fleet manager's (or
+    # kubelet's) TERM stops admission (/predict answers 503), in-flight
+    # requests run to completion, then the drain waiter stops the accept
+    # loop — serve_forever returns, the finally flushes run_end into the
+    # sink, and the process exits 0 with zero dropped work
+    signal.signal(signal.SIGTERM,
+                  lambda *_: server.begin_drain(exit_after=True))
     try:
         server.serve_forever()
     except KeyboardInterrupt:
