@@ -53,6 +53,7 @@ from .audit_precision import (audit_train_precision, find_silent_upcasts,
 from .audit_collectives import (audit_collective_budget, compare_counts,
                                 count_collectives)
 from .audit_params import audit_dead_params, dead_param_paths
+from .audit_quant import audit_quant_boundaries, find_unsanctioned_dequants
 
 __all__ = [
     'ALL_RULES', 'DEEP_RULES',
@@ -73,4 +74,5 @@ __all__ = [
     'audit_train_precision', 'find_silent_upcasts', 'trace_for_precision',
     'audit_collective_budget', 'compare_counts', 'count_collectives',
     'audit_dead_params', 'dead_param_paths',
+    'audit_quant_boundaries', 'find_unsanctioned_dequants',
 ]

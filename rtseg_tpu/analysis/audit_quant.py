@@ -1,0 +1,193 @@
+"""Quant-boundary auditor — int8 stays int8 until the sanctioned dequant.
+
+The quantized inference program (rtseg_tpu/quant/ptq.py) is built so that
+every int8 -> float convert happens at exactly two kinds of sites: the
+per-leaf weight dequant in ``dequantize_params`` and the activation QDQ
+in ``fake_quant`` — both in ``rtseg_tpu/quant/``. A convert anywhere else
+(above all a model file casting a quantized tensor on its own) means the
+quantization boundary leaked: the artifact still computes the right
+answer, but the int8 representation dies early and the size/bandwidth win
+silently shrinks. That is the same failure shape as audit_precision's
+silent bf16->f32 upcasts, so this pass reuses its attribution machinery
+over the *quantized forward's* jaxpr instead of the train step's.
+
+Two gates, mirroring the collective-budget discipline:
+
+  * location — every int8 -> float ``convert_element_type`` with a user
+    frame must attribute into ``rtseg_tpu/quant/`` (findings otherwise,
+    suppressible with ``# segcheck: disable=quant-boundary``);
+  * count — the total number of dequant converts is pinned per
+    model/shape in SEGAUDIT.json (``quant_dequant``). More converts than
+    pinned = a boundary leak or duplicated dequants; fewer = the pin is
+    stale (a layer was dropped); both fail until re-pinned with
+    ``tools/segcheck.py --deep --update-budget``.
+
+The trace is backend-independent (``jax.make_jaxpr``, no compile), so
+the pin carries no platform key — unlike collective counts, dequant
+sites are a property of the traced program alone.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional, Tuple
+
+from .audit_collectives import BUDGET_FILE, load_budget
+from .audit_precision import _attribute, _is_library
+from .core import Finding, RULE_QUANT, repo_root, suppressed_at
+from .step_harness import iter_eqns
+
+#: repo locations sanctioned to convert int8 back to float — the
+#: quantization package itself, nothing else
+ALLOWED_DEQUANT_PREFIXES: Tuple[str, ...] = ('rtseg_tpu/quant/',)
+
+#: the shape/model the pinned audit traces (small on purpose — the
+#: dequant-site count is shape-independent, the trace is not free)
+AUDIT_HW = (64, 64)
+AUDIT_NUM_CLASS = 19
+
+
+def _dequants(eqn) -> bool:
+    """True when this leaf equation converts int8 input to float
+    output. Only ``convert_element_type`` counts: arithmetic ops never
+    take int8 operands in the quantized program (the convert always
+    comes first), so any other int8-consuming float-producing op would
+    itself be a convert in disguise and XLA does not emit those from
+    this trace."""
+    if eqn.primitive.name != 'convert_element_type':
+        return False
+    has_int8 = any(str(getattr(getattr(v, 'aval', None), 'dtype', ''))
+                   == 'int8' for v in eqn.invars)
+    if not has_int8:
+        return False
+    return any(str(getattr(getattr(v, 'aval', None), 'dtype', '')
+                   ).startswith(('float', 'bfloat'))
+               for v in eqn.outvars)
+
+
+def find_unsanctioned_dequants(closed_jaxpr, label: str,
+                               root: Optional[str] = None,
+                               allowed=ALLOWED_DEQUANT_PREFIXES
+                               ) -> Tuple[List[Finding], int]:
+    """(findings, total dequant-convert count) over ``closed_jaxpr`` and
+    its sub-jaxprs. Findings are dequants attributed outside the
+    sanctioned prefixes; the count covers every dequant (sanctioned
+    included) — it feeds the SEGAUDIT.json pin."""
+    from .step_harness import subjaxprs, user_frames
+    root = root or repo_root()
+    findings: List[Finding] = []
+    seen = set()
+    total = 0
+    for eqn in iter_eqns(closed_jaxpr.jaxpr):
+        if subjaxprs(eqn):
+            continue
+        if not _dequants(eqn):
+            continue
+        total += 1
+        path, line, func = _attribute(user_frames(eqn))
+        if path is None or _is_library(path):
+            continue
+        if any(path.startswith(p) for p in allowed):
+            continue
+        key = (path, line)
+        if key in seen:          # one finding per source line, not per op
+            continue
+        seen.add(key)
+        if path.startswith('rtseg_tpu/') and \
+                suppressed_at(root, path, line, RULE_QUANT):
+            continue
+        findings.append(Finding(
+            rule=RULE_QUANT, path=path, line=line,
+            message=(f'{label}: int8 -> float convert outside the '
+                     f'sanctioned dequant sites in {func}() — the '
+                     f'quantized forward must dequantize only in '
+                     f'rtseg_tpu/quant/ (dequantize_params/fake_quant); '
+                     f'move the convert or suppress with segcheck: '
+                     f'disable={RULE_QUANT}')))
+    return findings, total
+
+
+def _quant_key(model_name: str, hw) -> str:
+    return f'{model_name}@{hw[0]}x{hw[1]}'
+
+
+def audit_quant_boundaries(root: Optional[str] = None,
+                           update: bool = False,
+                           model_name: str = 'fastscnn',
+                           num_class: int = AUDIT_NUM_CLASS,
+                           hw=AUDIT_HW) -> List[Finding]:
+    """Trace the quantized inference forward of ``model_name`` (real
+    init, quantized weights, QDQ input boundary — the exact program a
+    ``bake --quant int8`` exports) and gate its dequant sites: location
+    against ALLOWED_DEQUANT_PREFIXES, count against the SEGAUDIT.json
+    ``quant_dequant`` pin. With ``update``, re-pin instead of failing."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from ..config import SegConfig
+    from ..models import get_model
+    from ..quant import QMAX, build_quantized_inference_fn, \
+        quantize_variables
+    from ..quant.ptq import is_qleaf
+
+    root = root or repo_root()
+    cfg = SegConfig(dataset='synthetic', model=model_name,
+                    num_class=num_class, compute_dtype='float32',
+                    save_dir='/tmp/segquant_audit', use_tb=False)
+    cfg.resolve(num_devices=1)
+    net = get_model(cfg)
+    variables = net.init(jax.random.PRNGKey(0),
+                         jnp.zeros((1, 64, 64, 3), jnp.float32), False)
+    qvariables = quantize_variables(variables)
+    n_leaves = sum(1 for leaf in jax.tree_util.tree_flatten(
+        qvariables['params'], is_leaf=is_qleaf)[0] if is_qleaf(leaf))
+    # a fixed input scale stands in for a calibrated one — the audit is
+    # structural (where converts sit), not numerical
+    fn = build_quantized_inference_fn(net, qvariables, 'float32',
+                                      argmax=True, input_scale=1.0 / QMAX)
+    closed = jax.make_jaxpr(fn)(
+        np.zeros((1, hw[0], hw[1], 3), np.float32))
+    label = f'quant[{model_name}]@{hw[0]}x{hw[1]}'
+    findings, total = find_unsanctioned_dequants(closed, label, root=root)
+
+    key = _quant_key(model_name, hw)
+    data = load_budget(root)
+    table = data.setdefault('quant_dequant', {})
+    if update:
+        table[key] = {
+            'model': model_name,
+            'image_hw': [int(hw[0]), int(hw[1])],
+            'num_class': int(num_class),
+            'quantized_leaves': int(n_leaves),
+            'int8_to_float_converts': int(total),
+        }
+        with open(os.path.join(root, BUDGET_FILE), 'w') as f:
+            json.dump(data, f, indent=2, sort_keys=True)
+            f.write('\n')
+        return findings
+    entry = table.get(key)
+    if entry is None:
+        findings.append(Finding(
+            rule=RULE_QUANT, path=BUDGET_FILE, line=1,
+            message=(f'no quant_dequant pin for {key} (this trace '
+                     f'counted {total} dequant converts over {n_leaves} '
+                     f'quantized leaves); pin it with tools/segcheck.py '
+                     f'--deep --update-budget')))
+        return findings
+    want = int(entry.get('int8_to_float_converts', -1))
+    if total > want:
+        findings.append(Finding(
+            rule=RULE_QUANT, path=BUDGET_FILE, line=1,
+            message=(f'{label}: {total} int8->float converts exceed the '
+                     f'pinned {want} — a quantization-boundary leak or a '
+                     f'duplicated dequant; inspect the jaxpr before '
+                     f're-pinning')))
+    elif total < want:
+        findings.append(Finding(
+            rule=RULE_QUANT, path=BUDGET_FILE, line=1,
+            message=(f'{label}: {total} int8->float converts under the '
+                     f'pinned {want} — the pin is stale; re-run '
+                     f'tools/segcheck.py --deep --update-budget and '
+                     f'commit the SEGAUDIT.json diff')))
+    return findings

@@ -39,8 +39,9 @@ RULE_DONATION = 'donation'
 RULE_PRECISION = 'precision-flow'
 RULE_COLLECTIVES = 'collective-budget'
 RULE_DEAD_PARAM = 'dead-param'
+RULE_QUANT = 'quant-boundary'
 DEEP_RULES = (RULE_DONATION, RULE_PRECISION, RULE_COLLECTIVES,
-              RULE_DEAD_PARAM)
+              RULE_DEAD_PARAM, RULE_QUANT)
 
 _SUPPRESS_RE = re.compile(r'#\s*segcheck:\s*disable=([\w,\- ]+)')
 

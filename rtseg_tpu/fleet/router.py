@@ -62,6 +62,7 @@ per-replica lifecycle snapshots.
 
 from __future__ import annotations
 
+import collections
 import http.client
 import json
 import math
@@ -109,6 +110,35 @@ _SHADOW_RESULTS = ('agree', 'disagree', 'error', 'skipped')
 #: concurrent in-flight shadow mirrors per router — a slow/hung shadow
 #: arm must back up into skipped samples, not into unbounded threads
 _MAX_MIRRORS = 8
+
+#: mirrored compares whose per-pixel agreement fractions feed the
+#: fleet_shadow_agree_frac window — enough samples that one outlier
+#: frame can't swing the rollout gate, small enough to track a live
+#: quality regression within one canary observation window
+_AGREE_WINDOW = 256
+
+
+def classify_compare(body: bytes, stable_body: bytes, raw: bool,
+                     tol: float = 1.0) -> Tuple[str, float]:
+    """Pure shadow-compare verdict: ``('agree'|'disagree', frac)``.
+
+    Raw equal-length masks are int8 argmax per pixel, so byte-agreement
+    IS argmax-agreement: ``frac`` is the per-pixel agreement fraction
+    and the verdict is ``frac >= tol``. The default ``tol=1.0`` keeps
+    the original byte-for-byte contract (an f32-vs-f32 shadow must be
+    bit-identical); a quantized shadow arm (segquant) relaxes it to an
+    explicit argmax-agreement-rate gate — int8 rounding legitimately
+    flips a sliver of boundary pixels, and the tolerance states exactly
+    how large a sliver is acceptable. Non-raw (or length-mismatched)
+    bodies fall back to exact equality with frac 1.0/0.0 — JSON answers
+    have no per-pixel structure to be tolerant over."""
+    if raw and len(body) == len(stable_body) and len(body) > 0:
+        import numpy as np
+        frac = float((np.frombuffer(body, np.uint8)
+                      == np.frombuffer(stable_body, np.uint8)).mean())
+        return ('agree' if frac >= tol else 'disagree'), frac
+    agree = body == stable_body
+    return ('agree' if agree else 'disagree'), (1.0 if agree else 0.0)
 
 #: response headers copied verbatim from the replica to the client
 _PASS_HEADERS = (TIMING_HEADER, MASK_SHAPE_HEADER, MASK_DTYPE_HEADER)
@@ -217,9 +247,15 @@ class FleetRouter(ThreadingHTTPServer):
         self._g_shadow_agree = {
             g: self.registry.gauge(
                 'fleet_shadow_agree_frac',
-                help='byte-agreement fraction of the last mirrored '
-                     'raw compare (1.0 = bit-identical masks)', group=g)
+                help='mean per-pixel agreement fraction over the recent '
+                     'mirrored compares (1.0 = bit-identical masks; the '
+                     'rollout min_agree_frac gate reads this)', group=g)
             for g in self.groups}
+        # per-group recent compare fractions (deque under _lock) backing
+        # the agree_frac gauge, and the agree/disagree verdict tolerance
+        # (1.0 = byte-exact; a quantized shadow arm relaxes it)
+        self._shadow_fracs: Dict[str, object] = {}
+        self._shadow_tol: Dict[str, float] = {}
         for g, split in self.groups.items():
             self.ensure_version(g, split.stable_arm().version)
         self._mirror_slots = threading.BoundedSemaphore(_MAX_MIRRORS)
@@ -331,10 +367,35 @@ class FleetRouter(ThreadingHTTPServer):
         self.ensure_version(group, version)
 
     def configure_shadow(self, group: str, shadow: ReplicaGroup,
-                         version: str, sample: float) -> None:
+                         version: str, sample: float,
+                         agree_tol: float = 1.0) -> None:
+        """Attach a shadow arm. ``agree_tol`` is the per-compare
+        agreement fraction below which a mirrored raw mask counts as
+        ``disagree`` (1.0 = byte-exact, the f32 default; an int8 shadow
+        arm states its argmax-agreement tolerance explicitly)."""
+        if not 0.0 < agree_tol <= 1.0:
+            raise ValueError(f'agree_tol must be in (0, 1], '
+                             f'got {agree_tol}')
         self.groups[group].set_shadow(shadow, version, sample)
+        with self._lock:
+            self._shadow_tol[group] = float(agree_tol)
+            # fresh window per arm: the agree_frac gauge scores the
+            # CURRENT candidate, not a mean polluted by the last one
+            self._shadow_fracs[group] = \
+                collections.deque(maxlen=_AGREE_WINDOW)
         for res in _SHADOW_RESULTS:
             self._shadow_counter(group, res)
+
+    def _note_agree_frac(self, group: str, frac: float) -> None:
+        """Fold one compare's agreement fraction into the group window
+        and publish the window mean as the gauge (mirror threads race
+        here; the deque+mean under _lock keeps the gauge coherent)."""
+        with self._lock:
+            win = self._shadow_fracs.setdefault(
+                group, collections.deque(maxlen=_AGREE_WINDOW))
+            win.append(float(frac))
+            mean = sum(win) / len(win)
+        self._g_shadow_agree[group].set(mean)
 
     # -------------------------------------------------- outstanding ledger
     def try_admit(self, group: str) -> bool:
@@ -524,19 +585,12 @@ class FleetRouter(ThreadingHTTPServer):
             if code != 200 or stable_code != 200:
                 self._shadow_counter(group, 'error').inc()
                 return
-            if raw and len(body) == len(stable_body) and len(body) > 0:
-                # raw masks are int8 argmax per pixel: byte-agreement IS
-                # argmax-agreement. Record the fraction (vectorized — a
-                # 512x1024 mask is half a megabyte, a Python byte loop
-                # here would stall the serving handlers), gate on
-                # equality.
-                import numpy as np
-                same = (np.frombuffer(body, np.uint8)
-                        == np.frombuffer(stable_body, np.uint8)).mean()
-                self._g_shadow_agree[group].set(float(same))
-            agree = body == stable_body
-            self._shadow_counter(
-                group, 'agree' if agree else 'disagree').inc()
+            with self._lock:
+                tol = self._shadow_tol.get(group, 1.0)
+            result, frac = classify_compare(body, stable_body, raw,
+                                            tol=tol)
+            self._note_agree_frac(group, frac)
+            self._shadow_counter(group, result).inc()
         except Exception:   # noqa: BLE001 — a mirror thread must not
             # die silently (segfail exception-flow): anything the body
             # didn't classify itself lands in the shadow error counter

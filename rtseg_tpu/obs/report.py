@@ -170,6 +170,16 @@ def _summarize_device(profs: List[dict], memory: Optional[dict],
         if ceiling is not None:
             device['ceiling_mfu'] = float(ceiling)
             device['measured_mfu'] = round(busy_frac * float(ceiling), 4)
+        # segquant: the same busy fraction against the int8 roofline row
+        # (MFU of the int8 peak — what an int8 bundle of this model
+        # could reach; roofline.py documents the conservative byte
+        # counts behind it)
+        int8_ceiling = row.get('lane_adj_int8_ceiling_mfu',
+                               row.get('int8_ceiling_mfu'))
+        if int8_ceiling is not None:
+            device['int8_ceiling_mfu'] = float(int8_ceiling)
+            device['measured_mfu_int8'] = round(
+                busy_frac * float(int8_ceiling), 4)
     return device
 
 
@@ -582,6 +592,12 @@ def format_summary(s: Dict[str, Any], path: str = '') -> str:
                 f'  measured MFU   : {100 * dv["measured_mfu"]:.1f}% '
                 f'(busy {100 * dv["busy_frac"]:.1f}% x roofline ceiling '
                 f'{100 * dv["ceiling_mfu"]:.1f}%)')
+        if dv.get('measured_mfu_int8') is not None:
+            lines.append(
+                f'  int8 MFU       : '
+                f'{100 * dv["measured_mfu_int8"]:.1f}% of int8 peak '
+                f'(ceiling {100 * dv["int8_ceiling_mfu"]:.1f}%, '
+                f'segquant)')
         if dv.get('peak_hbm_bytes') is not None:
             lines.append(f'  peak HBM       : '
                          f'{dv["peak_hbm_bytes"] / 2**20:.0f} MiB')

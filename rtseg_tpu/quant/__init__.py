@@ -1,0 +1,38 @@
+"""segquant — post-training int8 quantization of zoo-model forwards.
+
+Two halves, mirroring export.py's split between graph building and
+artifact plumbing:
+
+  * :mod:`.ptq` — the pure quantization math: per-channel symmetric int8
+    weights (scale = maxabs/127 over the output-channel axis), the
+    dequantize-in-graph inference closure whose ``jax.export`` artifact
+    bakes int8 constants + small f32 scale vectors (the artifact-size
+    lever), and the seeded scale-corruption knob the rollout drill uses;
+  * :mod:`.calibrate` — deterministic calibration: seeded sample
+    selection over a segpipe PackedCache (or the seeded synthetic source
+    at bake time), optional per-tensor activation scales from the real
+    eval forward, and the QuantRecord — scales hash, calibration hash,
+    argmax agreement + mIoU delta vs the f32 reference on the same
+    slice, gated by a configurable max-drop threshold.
+
+Every int8 -> float convert a quantized forward performs must live in
+this package: segaudit's quant-boundary pass (analysis/audit_quant.py)
+walks the quantized jaxpr and pins the sanctioned dequant-site count in
+SEGAUDIT.json.
+"""
+
+from .ptq import (QKIND, QMAX, build_quantized_inference_fn,
+                  corrupt_scales, dequantize_params, fake_quant, is_qleaf,
+                  quantize_params, quantize_variables, quantized_nbytes,
+                  scale_fingerprint)
+from .calibrate import (QuantRecord, calibrate, record_to_json,
+                        select_calibration_indices)
+
+__all__ = [
+    'QKIND', 'QMAX',
+    'build_quantized_inference_fn', 'corrupt_scales', 'dequantize_params',
+    'fake_quant', 'is_qleaf', 'quantize_params', 'quantize_variables',
+    'quantized_nbytes', 'scale_fingerprint',
+    'QuantRecord', 'calibrate', 'record_to_json',
+    'select_calibration_indices',
+]

@@ -1,0 +1,183 @@
+"""Deterministic calibration + the QuantRecord quality gate.
+
+Calibration runs the *real eval forward* (the same program the bundle
+ships — export head, int8 argmax) twice over one deterministic sample
+slice: once with the f32 weights, once with the quantized tree. What
+comes out is evidence, not vibes:
+
+  * ``agreement_frac`` — fraction of pixels whose argmax matches between
+    the two forwards (the same statistic the fleet's shadow compare
+    measures live, so the bake-time number and the rollout gate speak
+    one language);
+  * mIoU delta — against ground-truth masks when the slice comes from a
+    segpipe PackedCache (the real eval metric), or against the f32
+    forward's own masks for the synthetic bake-time source (recorded as
+    ``reference: f32_forward`` so nobody mistakes it for held-out mIoU);
+  * the calibration hash — sha256 over the exact sample bytes + seed +
+    indices, so two bakes claiming the same calibration can be checked.
+
+Sample selection is seeded (:func:`select_calibration_indices`): same
+cache + same seed ⇒ the same indices, the same images, byte-identical
+scales and QuantRecord (pinned by tests/test_segquant.py).
+
+The record is a plain JSON-able dict; :func:`record_to_json` is the ONE
+serializer (sorted keys, fixed indent) so the bundle member and the
+determinism test agree on bytes.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .ptq import (QMAX, build_quantized_inference_fn, quantized_nbytes,
+                  scale_fingerprint)
+
+#: a QuantRecord is a plain dict (see :func:`calibrate` for the schema);
+#: the alias exists for signatures and docs
+QuantRecord = Dict[str, Any]
+
+
+def select_calibration_indices(n_total: int, n_samples: int,
+                               seed: int = 0) -> List[int]:
+    """Seeded sample-without-replacement over ``range(n_total)``, sorted
+    ascending (shard-sequential reads on a PackedCache). Deterministic:
+    numpy's Generator stream is stable across runs for a fixed seed."""
+    n = min(int(n_samples), int(n_total))
+    rng = np.random.default_rng(seed)
+    return sorted(int(i) for i in
+                  rng.choice(int(n_total), size=n, replace=False))
+
+
+def calibration_hash(images: np.ndarray, masks: Optional[np.ndarray],
+                     seed: int, indices: Optional[Sequence[int]]) -> str:
+    """sha256 over the exact calibration inputs — what 'calibrated on
+    the same slice' means, checkably."""
+    h = hashlib.sha256()
+    h.update(np.ascontiguousarray(images).tobytes())
+    if masks is not None:
+        h.update(np.ascontiguousarray(masks).tobytes())
+    h.update(json.dumps({'seed': int(seed),
+                         'indices': [int(i) for i in indices or []]},
+                        sort_keys=True).encode())
+    return h.hexdigest()
+
+
+def _np_miou(pred: np.ndarray, ref: np.ndarray, num_class: int) -> float:
+    """Host-side mIoU (JaccardIndex semantics, classes absent from both
+    excluded) — the comparison runs on two already-materialized int8
+    mask arrays, no device work needed."""
+    pred = pred.reshape(-1).astype(np.int64)
+    ref = ref.reshape(-1).astype(np.int64)
+    valid = (ref >= 0) & (ref < num_class)
+    cm = np.bincount(ref[valid] * num_class + pred[valid],
+                     minlength=num_class * num_class
+                     ).reshape(num_class, num_class)
+    inter = np.diag(cm)
+    union = cm.sum(0) + cm.sum(1) - inter
+    present = union > 0
+    if not present.any():
+        return 1.0
+    return float(np.mean(inter[present] / union[present]))
+
+
+def activation_scales(model, variables, images, compute_dtype
+                      ) -> Dict[str, float]:
+    """Per-tensor symmetric scales (maxabs/127) for every intermediate
+    the eval forward produces, captured with flax's
+    ``capture_intermediates`` over the calibration slice. Keys are the
+    '/'-joined module paths; values are python floats so the record
+    stays JSON-able."""
+    import jax.numpy as jnp
+    dtype = jnp.dtype(compute_dtype)
+    _, state = model.apply(variables, jnp.asarray(images, jnp.float32)
+                           .astype(dtype), False,
+                           capture_intermediates=True,
+                           mutable=['intermediates'])
+    out: Dict[str, float] = {}
+
+    def walk(node, path):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(v, path + (k,))
+        elif isinstance(node, (tuple, list)):
+            for i, v in enumerate(node):
+                walk(v, path + (str(i),) if len(node) > 1 else path)
+        else:
+            maxabs = float(jnp.max(jnp.abs(node)))
+            out['/'.join(path)] = (maxabs / QMAX) if maxabs > 0 else 1.0
+    walk(state['intermediates'], ())
+    return out
+
+
+def calibrate(model, variables, qvariables, images: np.ndarray,
+              masks: Optional[np.ndarray] = None, *,
+              compute_dtype='float32', num_class: int = 19,
+              max_drop: float = 0.05, activations: bool = False,
+              source: str = 'synthetic', seed: int = 0,
+              indices: Optional[Sequence[int]] = None) -> QuantRecord:
+    """Run the f32 and int8 eval forwards over one calibration slice and
+    emit the QuantRecord. ``images`` is the preprocessed (N, H, W, 3)
+    f32 batch (the serving-path normalization already applied);
+    ``masks`` (N, H, W) int ground truth when the slice comes from a
+    real cache. The record carries the gate verdict; enforcing it (the
+    bake refuses, the CLI exits 1) is the caller's job."""
+    import jax
+    from ..export import build_inference_fn
+
+    images = np.ascontiguousarray(np.asarray(images, np.float32))
+    f32_fn = jax.jit(build_inference_fn(model, variables, compute_dtype,
+                                        argmax=True))
+    input_scale = None
+    act: Optional[Dict[str, Any]] = None
+    if activations:
+        scales = activation_scales(model, variables, images,
+                                   compute_dtype)
+        maxabs = float(np.max(np.abs(images)))
+        input_scale = (maxabs / QMAX) if maxabs > 0 else 1.0
+        act = {'input_scale': input_scale,
+               'tensors': len(scales), 'scales': scales}
+    int8_fn = jax.jit(build_quantized_inference_fn(
+        model, qvariables, compute_dtype, argmax=True,
+        input_scale=input_scale))
+    pred_f32 = np.asarray(f32_fn(images), np.int8)
+    pred_int8 = np.asarray(int8_fn(images), np.int8)
+    agreement = float((pred_f32 == pred_int8).mean())
+    if masks is not None:
+        miou_f32 = _np_miou(pred_f32, np.asarray(masks), num_class)
+        miou_int8 = _np_miou(pred_int8, np.asarray(masks), num_class)
+        miou = {'reference': 'ground_truth', 'f32': miou_f32,
+                'int8': miou_int8, 'drop': miou_f32 - miou_int8}
+    else:
+        # no ground truth on this slice: the f32 forward IS the
+        # reference, and the 'drop' is 1 - mIoU(int8, f32) — labeled so
+        # it can never pass for held-out mIoU
+        vs = _np_miou(pred_int8, pred_f32, num_class)
+        miou = {'reference': 'f32_forward', 'f32': 1.0, 'int8': vs,
+                'drop': 1.0 - vs}
+    sizes = quantized_nbytes(qvariables['params'])
+    record: QuantRecord = {
+        'precision': 'int8',
+        'weights': {**sizes,
+                    'scale_sha256': scale_fingerprint(
+                        qvariables['params'])},
+        'calib': {'source': source, 'samples': int(images.shape[0]),
+                  'seed': int(seed),
+                  'indices': [int(i) for i in indices or []],
+                  'hash': calibration_hash(images, masks, seed, indices)},
+        'activations': act,
+        'agreement_frac': agreement,
+        'miou': miou,
+        'gate': {'max_drop': float(max_drop),
+                 'passed': bool(miou['drop'] <= max_drop)},
+    }
+    return record
+
+
+def record_to_json(record: QuantRecord) -> str:
+    """The one canonical serialization (bundle member, determinism
+    test): sorted keys, indent 1, trailing newline."""
+    return json.dumps(record, sort_keys=True, indent=1) + '\n'

@@ -1,0 +1,181 @@
+"""Per-channel symmetric int8 post-training quantization.
+
+The quantized representation keeps the params *tree structure* and swaps
+each quantizable leaf (float arrays with >= 2 dims — conv HWIO kernels
+and dense (in, out) matrices; the zoo keeps channels on the last axis
+throughout) for a small dict ``{'kind': QKIND, 'q': int8, 'scale': f32}``
+with one scale per output channel. 1-D leaves (biases, BN
+scale/bias/mean/var) stay f32: they are a rounding error of the byte
+budget and their dynamic range is not weight-like.
+
+The inference closure (:func:`build_quantized_inference_fn`) dequantizes
+*inside the traced function*, so ``jax.export`` serializes the int8
+tensors and the per-channel scale vectors as constants and the StableHLO
+artifact shrinks ~4x against the f32 bake (the convert+multiply runs on
+device at dispatch time). Every int8 -> float convert therefore
+originates in this file — the property segaudit's quant-boundary pass
+(analysis/audit_quant.py) pins.
+
+:func:`corrupt_scales` is the rollout-drill knob (the ``--perturb``
+analogue for quantized bakes): seeded multiplicative noise on the scale
+vectors *after* calibration, i.e. a quality regression the bake-time
+mIoU gate never saw — exactly what the shadow agreement gate must catch.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: marker for a quantized leaf dict inside a params tree
+QKIND = 'segquant.int8'
+#: symmetric int8 range; -128 is never produced (symmetric grid)
+QMAX = 127.0
+
+
+def is_qleaf(x: Any) -> bool:
+    return isinstance(x, dict) and x.get('kind') == QKIND
+
+
+def _quantizable(arr) -> bool:
+    return arr.ndim >= 2 and jnp.issubdtype(arr.dtype, jnp.floating)
+
+
+def quantize_params(params) -> Any:
+    """Params tree -> quantized tree (same treedef; quantizable leaves
+    become qleaf dicts, everything else passes through as f32).
+
+    Per-channel symmetric: scale[c] = maxabs over all other axes / 127,
+    taken on the *last* axis (HWIO conv kernels and (in, out) dense —
+    the output channel everywhere in the zoo). An all-zero channel gets
+    scale 1.0 so the dequant never divides by (or multiplies with) 0.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    out = []
+    for leaf in leaves:
+        arr = jnp.asarray(leaf)
+        if not _quantizable(arr):
+            out.append(arr)
+            continue
+        flat = arr.reshape(-1, arr.shape[-1]).astype(jnp.float32)
+        maxabs = jnp.max(jnp.abs(flat), axis=0)
+        scale = jnp.where(maxabs > 0.0, maxabs / QMAX,
+                          jnp.ones_like(maxabs))
+        q = jnp.clip(jnp.round(arr.astype(jnp.float32) / scale),
+                     -QMAX, QMAX).astype(jnp.int8)
+        out.append({'kind': QKIND, 'q': q,
+                    'scale': scale.astype(jnp.float32)})
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def quantize_variables(variables) -> Dict[str, Any]:
+    """Quantize ``variables['params']``; batch_stats (and any other
+    collection) pass through untouched — BN folding is a later lever,
+    the running stats are consumed in f32 either way."""
+    return dict(variables, params=quantize_params(variables['params']))
+
+
+def dequantize_params(qparams) -> Any:
+    """Quantized tree -> f32 tree. Traced: inside a jitted/exported
+    function this is where the int8 constants convert back — the ONE
+    sanctioned dequant site (plus :func:`fake_quant`) the quant-boundary
+    audit allows."""
+    def deq(x):
+        if is_qleaf(x):
+            return x['q'].astype(jnp.float32) * x['scale']
+        return x
+    return jax.tree_util.tree_map(deq, qparams, is_leaf=is_qleaf)
+
+
+def fake_quant(x, scale):
+    """Quantize-dequantize (QDQ) one activation tensor with a per-tensor
+    scale: the activation-quantization boundary. Round-trips through a
+    real int8 tensor so the traced program carries the exact serving
+    quantization error, not a float simulation of it."""
+    q = jnp.clip(jnp.round(x / scale), -QMAX, QMAX).astype(jnp.int8)
+    return q.astype(jnp.float32) * scale
+
+
+def build_quantized_inference_fn(model, qvariables, compute_dtype,
+                                 argmax: bool = True,
+                                 input_scale=None):
+    """The quantized counterpart of export.build_inference_fn: identical
+    head (channel argmax -> int8), weights dequantized in-graph from the
+    qleaf tree so export bakes int8 constants. ``input_scale`` (from
+    calibration, ``--activations``) adds a QDQ on the input boundary —
+    the per-tensor activation grid the calibrated scales describe."""
+    dtype = jnp.dtype(compute_dtype)
+
+    def fn(images):
+        if input_scale is not None:
+            images = fake_quant(images, input_scale)
+        variables = dict(qvariables,
+                         params=dequantize_params(qvariables['params']))
+        logits = model.apply(variables, images.astype(dtype), False)
+        logits = logits.astype(jnp.float32)
+        if argmax:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int8)
+        return logits
+
+    return fn
+
+
+def corrupt_scales(qvariables, amount: float, seed: int = 0
+                   ) -> Dict[str, Any]:
+    """Seeded multiplicative noise on every scale vector: scale *=
+    (1 + amount * N(0, 1)). Applied AFTER calibration on purpose — the
+    bake-time quality gate has already passed, so the regression is only
+    visible to the live planes (shadow agreement, rollout decide()).
+    Deterministic per (amount, seed); leaf order is the tree-flatten
+    order, which is itself deterministic."""
+    leaves, treedef = jax.tree_util.tree_flatten(
+        qvariables['params'], is_leaf=is_qleaf)
+    rng = np.random.default_rng(seed)
+    out = []
+    for leaf in leaves:
+        if not is_qleaf(leaf):
+            out.append(leaf)
+            continue
+        scale = np.asarray(leaf['scale'])
+        noise = rng.standard_normal(scale.shape).astype(np.float32)
+        out.append(dict(leaf, scale=jnp.asarray(
+            scale * (1.0 + amount * noise))))
+    params = jax.tree_util.tree_unflatten(treedef, out)
+    return dict(qvariables, params=params)
+
+
+def quantized_nbytes(qparams) -> Dict[str, int]:
+    """Byte accounting over one quantized tree: {'int8': payload bytes
+    as stored (q + scales + passthrough f32 leaves), 'f32': what the
+    same tree costs unquantized, 'quantized_leaves': n, 'total_leaves':
+    m}."""
+    leaves = jax.tree_util.tree_flatten(qparams, is_leaf=is_qleaf)[0]
+    int8 = f32 = nq = 0
+    for leaf in leaves:
+        if is_qleaf(leaf):
+            q, scale = np.asarray(leaf['q']), np.asarray(leaf['scale'])
+            int8 += q.nbytes + scale.nbytes
+            f32 += q.size * 4
+            nq += 1
+        else:
+            arr = np.asarray(leaf)
+            int8 += arr.nbytes
+            f32 += arr.nbytes
+    return {'int8': int8, 'f32': f32, 'quantized_leaves': nq,
+            'total_leaves': len(leaves)}
+
+
+def scale_fingerprint(qparams) -> str:
+    """sha256 over every scale vector (tree-flatten order, raw f32
+    bytes) — the determinism pin: same weights + same quantizer ⇒ the
+    same fingerprint, byte for byte."""
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_flatten(qparams, is_leaf=is_qleaf)[0]:
+        if is_qleaf(leaf):
+            h.update(np.ascontiguousarray(
+                np.asarray(leaf['scale'], np.float32)).tobytes())
+    return h.hexdigest()

@@ -16,6 +16,10 @@ in one directory, fingerprinted so corruption or drift is detectable:
     promote gate);
   * ``quality.json`` — expected-quality metadata (golden-pair count,
     class histogram, optional held-out mIoU supplied by the baker);
+  * ``quant/QUANT.json`` — present on ``--quant int8`` bakes only
+    (segquant): the QuantRecord — weight/scale fingerprints, the
+    deterministic calibration hash, f32-vs-int8 argmax agreement and
+    mIoU delta, and the max-drop gate verdict (rtseg_tpu/quant/);
   * ``pins/SEGAUDIT.json`` + ``pins/SEGRACE.json`` — the repo's audited
     collective budgets and lock-order pins at bake time (provenance: what
     invariants the artifact was built under);
@@ -239,7 +243,12 @@ def bake_model(staging_dir: str, model: str, num_class: int,
                golden: int = 4, seed: int = 0,
                perturb: float = 0.0, perturb_seed: int = 0,
                miou: Optional[float] = None,
-               pins_root: Optional[str] = None) -> Dict[str, Any]:
+               pins_root: Optional[str] = None,
+               quant: Optional[str] = None, quant_samples: int = 8,
+               quant_seed: int = 0, quant_max_drop: float = 0.05,
+               quant_activations: bool = False,
+               quant_corrupt: float = 0.0, quant_corrupt_seed: int = 0,
+               calib_cache: Optional[str] = None) -> Dict[str, Any]:
     """Build one bundle's members under ``staging_dir`` (the store
     publishes it atomically — registry/store.py).
 
@@ -254,6 +263,20 @@ def bake_model(staging_dir: str, model: str, num_class: int,
     ``perturb`` adds seeded gaussian noise to every param leaf — a
     rollout-drill knob (CI bakes a deliberately-different "bad" version
     with it; the shadow compare must notice). Returns the manifest.
+
+    ``quant='int8'`` (segquant) quantizes the weights per-channel
+    symmetric int8 before export: the StableHLO members carry int8
+    constants + f32 scale vectors instead of f32 weights, calibration
+    runs the real eval forward over a deterministic sample slice
+    (seeded synthetic by default; a segpipe PackedCache via
+    ``calib_cache`` for ground-truth mIoU), and the resulting
+    QuantRecord becomes the ``quant/QUANT.json`` member. The bake
+    REFUSES (ValueError) when the measured mIoU drop exceeds
+    ``quant_max_drop`` (representative numbers: segquant_cpu.log). ``quant_corrupt`` is the quantized rollout
+    drill: seeded noise on the scale vectors AFTER calibration — a
+    quality regression the bake-time gate never saw, for the shadow/
+    rollout planes to catch (the gate is bypassed so the corrupt bundle
+    actually ships to the drill).
     """
     import numpy as np
     import jax
@@ -293,9 +316,70 @@ def bake_model(staging_dir: str, model: str, num_class: int,
         variables = dict(variables, params=jax.tree_util.tree_unflatten(
             treedef, leaves))
 
-    fn = build_inference_fn(net, variables, cfg.compute_dtype,
-                            argmax=True)
     buckets = sorted({(int(h), int(w)) for h, w in buckets})
+    preprocess = make_preprocess(cfg)
+    quant_record = None
+    if quant is not None:
+        if quant != 'int8':
+            raise ValueError(f'unsupported quant precision {quant!r} '
+                             f"(only 'int8')")
+        from ..quant import (build_quantized_inference_fn, calibrate,
+                             corrupt_scales, quantize_variables,
+                             record_to_json, select_calibration_indices)
+        qvariables = quantize_variables(variables)
+        indices = None
+        if calib_cache:
+            # real eval slice: seeded indices into the packed sample
+            # cache; cached images carry the deterministic prefix, the
+            # eval suffix (normalize/pack) still applies — the exact
+            # read path the evaluator runs (data/segpipe)
+            from ..data.segpipe.cache import PackedCache
+            from ..data.transforms import EvalTransform
+            cache = PackedCache(calib_cache)
+            indices = select_calibration_indices(
+                len(cache), quant_samples, seed=quant_seed)
+            tf = EvalTransform(cfg)
+            pairs = [tf.suffix(np.asarray(img), np.asarray(msk))
+                     for img, msk in (cache.read(i) for i in indices)]
+            calib_images = np.stack([p[0] for p in pairs])
+            calib_masks = np.stack([p[1] for p in pairs])
+            source = f'segpipe:{os.path.basename(os.path.normpath(calib_cache))}'
+        else:
+            # seeded synthetic slice through the real serving
+            # preprocess (PNG decode + eval transform), first bucket's
+            # shape — no ground truth, so the record's mIoU is labeled
+            # f32_forward-relative by calibrate()
+            raws = synth_images([buckets[0]], seed=quant_seed,
+                                per_shape=max(1, quant_samples))
+            calib_images = np.stack(
+                [preprocess(encode_png(im)) for im in raws])
+            calib_masks = None
+            source = 'synthetic'
+        quant_record = calibrate(
+            net, variables, qvariables, calib_images, calib_masks,
+            compute_dtype=cfg.compute_dtype, num_class=num_class,
+            max_drop=quant_max_drop, activations=quant_activations,
+            source=source, seed=quant_seed, indices=indices)
+        if not quant_record['gate']['passed'] and not quant_corrupt:
+            raise ValueError(
+                f'quantization gate failed: mIoU drop '
+                f'{quant_record["miou"]["drop"]:.4f} > max_drop '
+                f'{quant_max_drop} (reference '
+                f'{quant_record["miou"]["reference"]}, agreement '
+                f'{quant_record["agreement_frac"]:.4f}); raise '
+                f'--quant-max-drop only with evidence')
+        if quant_corrupt:
+            qvariables = corrupt_scales(qvariables, quant_corrupt,
+                                        seed=quant_corrupt_seed)
+            quant_record['corrupt'] = {'amount': float(quant_corrupt),
+                                       'seed': int(quant_corrupt_seed)}
+        fn = build_quantized_inference_fn(
+            net, qvariables, cfg.compute_dtype, argmax=True,
+            input_scale=(quant_record['activations']['input_scale']
+                         if quant_activations else None))
+    else:
+        fn = build_inference_fn(net, variables, cfg.compute_dtype,
+                                argmax=True)
     os.makedirs(os.path.join(staging_dir, 'hlo'), exist_ok=True)
     for (h, w) in buckets:
         # trace-time globals are this bake's for every lowering (same
@@ -317,7 +401,6 @@ def bake_model(staging_dir: str, model: str, num_class: int,
                                  name=f'segship:{model}')
 
     # golden pairs through the exact serving path the replica will run
-    preprocess = make_preprocess(cfg)
     images = synth_images(buckets, seed=seed,
                           per_shape=max(1, golden // len(buckets)))
     gdir = os.path.join(staging_dir, 'golden')
@@ -349,6 +432,15 @@ def bake_model(staging_dir: str, model: str, num_class: int,
     with open(os.path.join(staging_dir, 'quality.json'), 'w') as f:
         json.dump(quality, f, indent=1, sort_keys=True)
 
+    if quant_record is not None:
+        # the QuantRecord ships WITH the bundle: scales hash,
+        # calibration hash, agreement, gate verdict — fingerprinted like
+        # every member, so quant provenance is tamper-evident too
+        qdir = os.path.join(staging_dir, 'quant')
+        os.makedirs(qdir, exist_ok=True)
+        with open(os.path.join(qdir, 'QUANT.json'), 'w') as f:
+            f.write(record_to_json(quant_record))
+
     # provenance pins: the audited invariants this artifact was built
     # under (collective budgets, lock order) travel with it
     root = pins_root or os.path.dirname(os.path.dirname(
@@ -367,6 +459,8 @@ def bake_model(staging_dir: str, model: str, num_class: int,
     meta = {
         'model': model, 'num_class': num_class,
         'compute_dtype': str(cfg.compute_dtype),
+        'precision': ('int8' if quant_record is not None
+                      else str(cfg.compute_dtype)),
         'buckets': [f'{h}x{w}' for h, w in buckets],
         'batch': int(batch),
         'ckpt': os.path.abspath(ckpt_path) if ckpt_path else None,
@@ -375,6 +469,16 @@ def bake_model(staging_dir: str, model: str, num_class: int,
         'jax': jax.__version__, 'jaxlib': jaxlib.__version__,
         'platform': jax.devices()[0].platform,
     }
+    if quant_record is not None:
+        meta['quant'] = {
+            'calib_hash': quant_record['calib']['hash'],
+            'calib_source': quant_record['calib']['source'],
+            'agreement_frac': quant_record['agreement_frac'],
+            'miou_drop': quant_record['miou']['drop'],
+            'max_drop': quant_record['gate']['max_drop'],
+            'activations': bool(quant_activations),
+            'corrupt': float(quant_corrupt),
+        }
     return write_manifest(staging_dir, model, meta=meta)
 
 

@@ -64,6 +64,14 @@ class RolloutPolicy:
     #                                 roll back, but client-set deadlines
     #                                 failing equally on both arms not)
     max_disagree_frac: float = 0.02  # shadow mirrors disagreeing
+    min_agree_frac: float = 0.0     # windowed mean per-pixel agreement
+    #                                 (fleet_shadow_agree_frac) below
+    #                                 this is a breach; 0 disables. The
+    #                                 segquant quality gate: a quantized
+    #                                 canary whose masks drift (corrupted
+    #                                 scales, bad calibration) degrades
+    #                                 this fraction long before whole
+    #                                 compares flip to disagree
     min_canary_ok: int = 20         # traffic before any promote verdict
     min_stable_ok: int = 20         # baseline before p99 comparison
     breach_consecutive: int = 2     # polls a p99/drop/disagree breach
@@ -84,6 +92,8 @@ class RolloutObs:
     canary_p99_ms: Optional[float] = None
     shadow_total: int = 0
     shadow_disagree: int = 0
+    shadow_agree_frac: Optional[float] = None  # windowed mean per-pixel
+    #                                 agreement over recent compares
     golden_ok: Optional[bool] = None   # None = not (yet) replayed
     extra: Dict[str, Any] = field(default_factory=dict)
 
@@ -110,6 +120,7 @@ def obs_from_version_stats(stats: Dict[str, Dict[str, Any]],
         canary_p99_ms=ca.get('p99_ms'),
         shadow_total=int(sh.get('agree', 0)) + int(sh.get('disagree', 0)),
         shadow_disagree=int(sh.get('disagree', 0)),
+        shadow_agree_frac=sh.get('agree_frac'),
         golden_ok=golden_ok,
     )
 
@@ -155,6 +166,17 @@ def decide(obs: RolloutObs, policy: RolloutPolicy,
                 f'shadow disagreement {obs.shadow_disagree}/'
                 f'{obs.shadow_total} ({frac:.3f}) > '
                 f'{policy.max_disagree_frac}')
+        # segquant quality gate: the windowed mean PER-PIXEL agreement,
+        # orthogonal to the compare verdicts above — with a relaxed
+        # agree_tol every compare can pass while the mean fraction sinks
+        # toward the tolerance, and this catches the sink
+        if (policy.min_agree_frac > 0.0
+                and obs.shadow_agree_frac is not None
+                and obs.shadow_agree_frac < policy.min_agree_frac):
+            breaches.append(
+                f'shadow agreement {obs.shadow_agree_frac:.3f} < '
+                f'{policy.min_agree_frac} over {obs.shadow_total} '
+                f'mirrored compares')
     if breaches:
         breach_streak += 1
         if breach_streak >= policy.breach_consecutive:
@@ -320,6 +342,10 @@ class RolloutController:
     def _promote(self, reason: str, golden: Optional[Dict[str, Any]]
                  ) -> None:
         split = self.router.groups[self.group]
+        # a shadow arm pointing at the (about to be promoted) canary
+        # group must stop mirroring before the arms flip — a live mirror
+        # into a group being re-labeled would race the promotion
+        split.clear_shadow()
         prev = split.promote_canary()
         if self.registry is not None:
             self.registry.set_channel(self._model(), 'stable',
@@ -338,10 +364,14 @@ class RolloutController:
     def _rollback(self, reason: str, obs: RolloutObs) -> None:
         split = self.router.groups[self.group]
         split.clear_canary()
+        # ...and the shadow arm with it: its replicas drain below, and a
+        # mirror fired at a draining group would only mint shadow errors
+        split.clear_shadow()
         emit_rollout('rollback', self.group, self.canary_version,
                      reason=reason, canary_ok=obs.canary_ok,
                      canary_errors=obs.canary_errors,
-                     shadow_disagree=obs.shadow_disagree)
+                     shadow_disagree=obs.shadow_disagree,
+                     agree_frac=obs.shadow_agree_frac)
         # segtail: a rollback is a forensic moment — capture every
         # registered flight ring (router hops + replica requests) for
         # the window that tripped it. Best-effort: the rollback itself

@@ -199,14 +199,32 @@ class Registry:
                 versions[v] = {'error': 'unreadable manifest'}
                 continue
             meta = m.get('meta', {})
+            members = m.get('members', {})
+            # byte breakdown by member class + per-bucket StableHLO
+            # sizes: what `--quant int8` actually shrank, per artifact
+            by_class: Dict[str, int] = {}
+            bucket_bytes: Dict[str, int] = {}
+            for rel, info in members.items():
+                size = int(info.get('bytes', 0))
+                cls = rel.split('/', 1)[0] if '/' in rel else rel
+                by_class[cls] = by_class.get(cls, 0) + size
+                if rel.startswith('hlo/') and \
+                        rel.endswith('.stablehlo'):
+                    bucket_bytes[rel[len('hlo/'):-len('.stablehlo')]] \
+                        = size
             versions[v] = {
-                'members': len(m.get('members', {})),
+                'members': len(members),
                 'bytes': sum(int(x.get('bytes', 0))
-                             for x in m.get('members', {}).values()),
+                             for x in members.values()),
+                'bytes_by_class': by_class,
+                'bucket_bytes': bucket_bytes,
                 'buckets': meta.get('buckets'),
                 'batch': meta.get('batch'),
                 'perturb': meta.get('perturb'),
                 'platform': meta.get('platform'),
+                'precision': meta.get('precision',
+                                      meta.get('compute_dtype')),
+                'quant': meta.get('quant'),
             }
         return {'model': model, 'versions': versions,
                 'channels': self.channels(model)}

@@ -97,7 +97,7 @@ def test_no_raw_header_literals_outside_headers_module(real_obs):
     (registry/bundle.py: verify/replay must import on jax-less bakers and
     serve pulls jax at import time)."""
     raws = [(sf.relpath, line) for sf, line, _ in real_obs.raw_literals]
-    assert raws == [('rtseg_tpu/registry/bundle.py', 211)], raws
+    assert raws == [('rtseg_tpu/registry/bundle.py', 215)], raws
 
 
 def test_suppression_budget_only_goes_down():

@@ -1,0 +1,323 @@
+"""segquant: per-channel int8 PTQ + the quantized-canary quality plane.
+
+Pins the properties the quantized serving path ships on:
+
+  * round-trip parity — quantize -> dequantize error is bounded by half
+    a quantization step per channel (the symmetric-grid guarantee);
+  * calibration determinism — same weights + same slice + same seed
+    produce byte-identical QuantRecords and scale fingerprints (what
+    lets two bakes claim "calibrated the same" checkably);
+  * the shadow agreement plane — classify_compare tolerance polarity,
+    obs_from_version_stats plumbing, and the decide() min_agree_frac
+    breach (hold -> rollback) that auto-rolls-back a drifting quantized
+    canary;
+  * the quant-boundary audit — the traced int8 program dequantizes only
+    inside rtseg_tpu/quant/, and the SEGAUDIT.json pin matches.
+"""
+
+import json
+import sys
+from os import path
+
+import numpy as np
+import pytest
+
+ROOT = path.dirname(path.dirname(path.abspath(__file__)))
+
+
+# --------------------------------------------------------------------- ptq
+def test_quantize_roundtrip_parity():
+    import jax
+    from rtseg_tpu.quant import dequantize_params, quantize_params
+    from rtseg_tpu.quant.ptq import QMAX, is_qleaf
+
+    rng = np.random.default_rng(0)
+    params = {'conv': {'kernel': (rng.standard_normal((3, 3, 4, 8))
+                                  * rng.uniform(0.01, 10, 8)
+                                  ).astype(np.float32),
+                       'bias': rng.standard_normal(8).astype(np.float32)},
+              'dense': {'kernel':
+                        rng.standard_normal((16, 5)).astype(np.float32)}}
+    q = quantize_params(params)
+    assert is_qleaf(q['conv']['kernel'])
+    assert not is_qleaf(q['conv']['bias'])        # 1-D passes through f32
+    assert np.asarray(q['conv']['kernel']['q']).dtype == np.int8
+    deq = dequantize_params(q)
+    for key in (('conv', 'kernel'), ('dense', 'kernel')):
+        orig = params[key[0]][key[1]]
+        got = np.asarray(deq[key[0]][key[1]])
+        scale = np.asarray(q[key[0]][key[1]]['scale'])
+        # symmetric grid: |x - deq(x)| <= scale/2 per output channel
+        err = np.abs(orig - got).reshape(-1, orig.shape[-1]).max(0)
+        assert (err <= scale / 2 + 1e-7).all()
+        # and the grid really is int8-symmetric (never -128)
+        assert np.asarray(q[key[0]][key[1]]['q']).min() >= -QMAX
+    np.testing.assert_array_equal(np.asarray(deq['conv']['bias']),
+                                  params['conv']['bias'])
+    del jax
+
+
+def test_quantize_zero_channel_safe():
+    from rtseg_tpu.quant import dequantize_params, quantize_params
+
+    k = np.zeros((2, 2, 3, 4), np.float32)
+    k[..., 0] = 1.0                               # one live channel
+    q = quantize_params({'k': k})
+    scale = np.asarray(q['k']['scale'])
+    assert (scale[1:] == 1.0).all()               # dead channels: scale 1
+    np.testing.assert_allclose(np.asarray(dequantize_params(q)['k']), k,
+                               atol=1e-7)
+
+
+def test_corrupt_scales_seeded():
+    from rtseg_tpu.quant import (corrupt_scales, quantize_variables,
+                                 scale_fingerprint)
+
+    rng = np.random.default_rng(1)
+    variables = {'params': {'kernel':
+                            rng.standard_normal((3, 3, 2, 4)
+                                                ).astype(np.float32)}}
+    qv = quantize_variables(variables)
+    fp = scale_fingerprint(qv['params'])
+    a = corrupt_scales(qv, 0.5, seed=7)
+    b = corrupt_scales(qv, 0.5, seed=7)
+    assert scale_fingerprint(a['params']) == scale_fingerprint(b['params'])
+    assert scale_fingerprint(a['params']) != fp
+    assert scale_fingerprint(corrupt_scales(qv, 0.5, seed=8)['params']) \
+        != scale_fingerprint(a['params'])
+    # amount 0: numerically untouched
+    assert scale_fingerprint(corrupt_scales(qv, 0.0, seed=7)['params']) \
+        == fp
+
+
+def test_select_calibration_indices():
+    from rtseg_tpu.quant import select_calibration_indices
+
+    a = select_calibration_indices(100, 8, seed=3)
+    assert a == select_calibration_indices(100, 8, seed=3)
+    assert a == sorted(a) and len(set(a)) == 8
+    assert all(0 <= i < 100 for i in a)
+    assert a != select_calibration_indices(100, 8, seed=4)
+    # more samples than population clamps
+    assert select_calibration_indices(5, 99, seed=0) == [0, 1, 2, 3, 4]
+
+
+# ------------------------------------------------------------- calibration
+@pytest.fixture(scope='module')
+def calibrated():
+    """fastscnn @ 64x64, 2 synthetic samples, calibrated twice with
+    identical inputs — the determinism pair every test here reads."""
+    import jax
+    import jax.numpy as jnp
+    from rtseg_tpu.config import SegConfig
+    from rtseg_tpu.models import get_model
+    from rtseg_tpu.quant import calibrate, quantize_variables
+
+    cfg = SegConfig(dataset='synthetic', model='fastscnn', num_class=19,
+                    compute_dtype='float32',
+                    save_dir='/tmp/rtseg_segquant_test', use_tb=False)
+    cfg.resolve(num_devices=1)
+    net = get_model(cfg)
+    variables = net.init(jax.random.PRNGKey(0),
+                         jnp.zeros((1, 64, 64, 3), jnp.float32), False)
+    qvariables = quantize_variables(variables)
+    images = np.random.default_rng(0).uniform(
+        -1, 1, (2, 64, 64, 3)).astype(np.float32)
+    kw = dict(compute_dtype='float32', num_class=19, max_drop=0.5,
+              source='synthetic', seed=0)
+    r1 = calibrate(net, variables, qvariables, images, None, **kw)
+    r2 = calibrate(net, variables, qvariables, images, None, **kw)
+    return r1, r2
+
+
+def test_calibration_deterministic(calibrated):
+    from rtseg_tpu.quant import record_to_json
+    r1, r2 = calibrated
+    assert record_to_json(r1) == record_to_json(r2)   # byte-identical
+
+
+def test_quant_record_schema(calibrated):
+    r, _ = calibrated
+    assert r['precision'] == 'int8'
+    assert 0.0 <= r['agreement_frac'] <= 1.0
+    assert r['miou']['reference'] == 'f32_forward'    # no ground truth
+    assert r['gate']['passed'] == (r['miou']['drop'] <= r['gate']['max_drop'])
+    assert len(r['calib']['hash']) == 64
+    assert r['calib']['samples'] == 2
+    w = r['weights']
+    assert 0 < w['int8'] < w['f32']
+    assert 0 < w['quantized_leaves'] <= w['total_leaves']
+    assert len(w['scale_sha256']) == 64
+
+
+# -------------------------------------------------- shadow agreement plane
+def test_classify_compare_tolerance():
+    from rtseg_tpu.fleet.router import classify_compare
+
+    a, b = bytes([0, 1, 2, 3]), bytes([0, 1, 2, 9])
+    assert classify_compare(a, bytes(a), raw=True) == ('agree', 1.0)
+    assert classify_compare(a, b, raw=True) == ('disagree', 0.75)
+    assert classify_compare(a, b, raw=True, tol=0.7) == ('agree', 0.75)
+    # non-raw (JSON) bodies: exact equality only, frac degenerate
+    assert classify_compare(b'{"x":1}', b'{"x":1}', raw=False) \
+        == ('agree', 1.0)
+    assert classify_compare(b'{"x":1}', b'{"x":2}', raw=False, tol=0.1) \
+        == ('disagree', 0.0)
+    # raw with mismatched lengths falls back to exact equality
+    assert classify_compare(b'abc', b'ab', raw=True, tol=0.1) \
+        == ('disagree', 0.0)
+
+
+def test_shadow_agree_window():
+    from rtseg_tpu.fleet.manager import ReplicaGroup
+    from rtseg_tpu.fleet.router import make_router
+
+    def cmd(rid, port_file):
+        return ['true']
+
+    router = make_router({'g': ReplicaGroup('g', cmd)})
+    try:
+        shadow = ReplicaGroup('g-shadow', cmd)
+        with pytest.raises(ValueError):
+            router.configure_shadow('g', shadow, 'v1', 1.0, agree_tol=0.0)
+        with pytest.raises(ValueError):
+            router.configure_shadow('g', shadow, 'v1', 1.0, agree_tol=1.5)
+        router.configure_shadow('g', shadow, 'v1', 1.0, agree_tol=0.9)
+        for frac in (1.0, 0.9, 0.5):
+            router._note_agree_frac('g', frac)
+            # the compare verdict lands next to the fraction in the
+            # mirror path; version_stats exposes shadow once mirrors ran
+            router._shadow_counter(
+                'g', 'agree' if frac >= 0.9 else 'disagree').inc()
+        stats = router.version_stats('g')
+        assert stats['shadow']['agree_frac'] == pytest.approx(0.8)
+    finally:
+        router.server_close()
+
+
+def test_obs_reads_agree_frac():
+    from rtseg_tpu.registry.rollout import obs_from_version_stats
+    stats = {'v1': {'ok': 30, 'p99_ms': 10.0},
+             'v2': {'ok': 25, 'p99_ms': 11.0},
+             'shadow': {'agree': 20, 'disagree': 0, 'agree_frac': 0.93}}
+    obs = obs_from_version_stats(stats, 'v1', 'v2')
+    assert obs.shadow_agree_frac == 0.93
+    assert obs.shadow_total == 20
+    assert obs_from_version_stats({'v1': {}, 'v2': {}}, 'v1', 'v2'
+                                  ).shadow_agree_frac is None
+
+
+def test_decide_min_agree_frac_gate():
+    from rtseg_tpu.registry.rollout import (RolloutObs, RolloutPolicy,
+                                            decide)
+    policy = RolloutPolicy(min_agree_frac=0.9, min_canary_ok=10,
+                           min_stable_ok=10, breach_consecutive=2,
+                           clean_consecutive=2, max_disagree_frac=1.0)
+    low = RolloutObs(stable_ok=50, canary_ok=50, shadow_total=40,
+                     shadow_disagree=0, shadow_agree_frac=0.5)
+    action, reason, streak = decide(low, policy, (0, 0))
+    assert action == 'hold' and 'agreement' in reason
+    action, reason, _ = decide(low, policy, streak)
+    assert action == 'rollback' and 'agreement 0.500' in reason
+    # above threshold: clean path promotes
+    ok = RolloutObs(stable_ok=50, canary_ok=50, shadow_total=40,
+                    shadow_disagree=0, shadow_agree_frac=0.97)
+    action, _, streak = decide(ok, policy, (0, 0))
+    assert action == 'hold'
+    action, _, _ = decide(ok, policy, streak)
+    assert action == 'promote'
+    # min_agree_frac=0 disables the gate entirely
+    off = RolloutPolicy(min_agree_frac=0.0, min_canary_ok=10,
+                        clean_consecutive=1, max_disagree_frac=1.0)
+    action, _, _ = decide(low, off, (0, 0))
+    assert action == 'promote'
+
+
+# ----------------------------------------------------- quant-boundary audit
+def test_quant_boundary_audit_pin():
+    """The traced quantized fastscnn program matches the SEGAUDIT.json
+    quant_dequant pin with zero unsanctioned-dequant findings."""
+    from rtseg_tpu.analysis import audit_quant_boundaries
+    findings = audit_quant_boundaries(root=ROOT)
+    assert findings == [], [f.message for f in findings]
+
+
+def test_quant_boundary_detects_unsanctioned():
+    """Polarity: with the sanction list emptied, every dequant site in
+    the real quantized program becomes a finding."""
+    import jax
+    import jax.numpy as jnp
+    from rtseg_tpu.analysis.audit_quant import find_unsanctioned_dequants
+    from rtseg_tpu.config import SegConfig
+    from rtseg_tpu.models import get_model
+    from rtseg_tpu.quant import (QMAX, build_quantized_inference_fn,
+                                 quantize_variables)
+
+    cfg = SegConfig(dataset='synthetic', model='fastscnn', num_class=19,
+                    compute_dtype='float32',
+                    save_dir='/tmp/rtseg_segquant_test', use_tb=False)
+    cfg.resolve(num_devices=1)
+    net = get_model(cfg)
+    variables = net.init(jax.random.PRNGKey(0),
+                         jnp.zeros((1, 64, 64, 3), jnp.float32), False)
+    fn = build_quantized_inference_fn(net, quantize_variables(variables),
+                                      'float32', argmax=True,
+                                      input_scale=1.0 / QMAX)
+    closed = jax.make_jaxpr(fn)(np.zeros((1, 64, 64, 3), np.float32))
+    findings, total = find_unsanctioned_dequants(closed, 'polarity',
+                                                 root=ROOT, allowed=())
+    assert total > 0
+    assert findings, 'emptied sanction list must surface the dequants'
+    assert all(f.rule == 'quant-boundary' for f in findings)
+
+
+# ------------------------------------------------------------ tools wiring
+def test_roofline_int8_peak():
+    sys.path.insert(0, path.join(ROOT, 'tools'))
+    try:
+        import roofline
+    finally:
+        sys.path.pop(0)
+    assert roofline.PEAK_INT8_V5E == 2 * roofline.PEAK_V5E  # v5e spec
+
+
+def _fake_record(passed=True):
+    return {'precision': 'int8',
+            'weights': {'int8': 1 << 20, 'f32': 4 << 20,
+                        'quantized_leaves': 4, 'total_leaves': 10,
+                        'scale_sha256': '0' * 64},
+            'calib': {'source': 'synthetic', 'samples': 2, 'seed': 0,
+                      'indices': [], 'hash': '1' * 64},
+            'activations': None, 'agreement_frac': 0.97,
+            'miou': {'reference': 'f32_forward', 'f32': 1.0,
+                     'int8': 0.96, 'drop': 0.04},
+            'gate': {'max_drop': 0.05, 'passed': passed}}
+
+
+def test_segquant_cli_table_and_exit(monkeypatch, capsys, tmp_path):
+    sys.path.insert(0, path.join(ROOT, 'tools'))
+    try:
+        import segquant
+    finally:
+        sys.path.pop(0)
+    monkeypatch.setattr(segquant, 'quantize_one',
+                        lambda name, args: _fake_record())
+    out_file = tmp_path / 'QUANT.json'
+    rc = segquant.main(['--models', 'fastscnn,bisenetv2',
+                        '--out', str(out_file)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert out.count('PASS') == 2 and '0.9700' in out
+    assert json.loads(out_file.read_text())['precision'] == 'int8'
+    # any gate failure flips the exit code
+    monkeypatch.setattr(segquant, 'quantize_one',
+                        lambda name, args: _fake_record(passed=False))
+    assert segquant.main(['--models', 'fastscnn']) == 1
+    assert 'FAIL' in capsys.readouterr().out
+    # --json emits one parseable record per model
+    monkeypatch.setattr(segquant, 'quantize_one',
+                        lambda name, args: _fake_record())
+    assert segquant.main(['--models', 'fastscnn', '--json']) == 0
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    rec = json.loads(line)
+    assert rec['model'] == 'fastscnn' and rec['gate']['passed']

@@ -13,6 +13,12 @@ can never come from a block that silently paid for an XLA retrace.
     python tools/benchmark_all.py --models fastscnn,bisenetv2,ddrnet
     python tools/benchmark_all.py --train --models bisenetv2
     python tools/benchmark_all.py --eval --batch 8 --imgh 1024 --imgw 2048
+    python tools/benchmark_all.py --quant int8 --models fastscnn --batch 4
+
+--quant int8 benches the segquant serving program (per-channel int8
+weights, dequant in graph — rtseg_tpu/quant/) next to the f32 one:
+fenced imgs/sec, serialized artifact bytes, and argmax agreement side by
+side. The committed segquant_cpu.log comes from this mode.
 """
 
 import argparse
@@ -153,6 +159,64 @@ def bench_forward(name, batch, h, w, queue, trials):
                             guard_jitted=fwd,
                             guard_name=f'{name} forward bench')
     return ips, flops / batch, compile_s, compile_label
+
+
+def bench_forward_quant(name, batch, h, w, queue, trials):
+    """--quant int8: fenced throughput of the f32 serving program vs the
+    segquant int8 program (per-channel weights dequantized in-graph,
+    rtseg_tpu/quant/ptq.py), same argmax head for both, plus the
+    serialized jax.export artifact bytes and the argmax agreement
+    fraction on the bench batch — the three numbers segquant_cpu.log and
+    BENCHMARKS.md "Quantized inference methodology" quote side by side."""
+    import jax
+    import jax.numpy as jnp
+    from rtseg_tpu.config import SegConfig
+    from rtseg_tpu.export import build_inference_fn
+    from rtseg_tpu.models import get_model
+    from rtseg_tpu.quant import (build_quantized_inference_fn,
+                                 quantize_variables)
+
+    cfg = SegConfig(dataset='synthetic', model=name, num_class=19,
+                    compute_dtype=BENCH_COMPUTE_DTYPE,
+                    s2d_stem=BENCH_S2D['on'],
+                    segnet_pack=BENCH_S2D['segnet_pack'],
+                    save_dir='/tmp/rtseg_bench')
+    cfg.resolve(num_devices=1)
+    model = get_model(cfg)
+    images = jax.device_put(
+        np.random.RandomState(0).rand(batch, h, w, 3).astype(np.float32))
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, h, w, 3)), False)
+    qvariables = quantize_variables(variables)
+
+    out = {}
+    preds = {}
+    spec = jax.ShapeDtypeStruct((batch, h, w, 3), jnp.float32)
+    arms = (('f32', build_inference_fn(model, variables,
+                                       BENCH_COMPUTE_DTYPE, argmax=True)),
+            ('int8', build_quantized_inference_fn(model, qvariables,
+                                                  BENCH_COMPUTE_DTYPE,
+                                                  argmax=True)))
+    for arm, fn in arms:
+        jitted = jax.jit(fn)
+        compiled, compile_s, compile_label = timed_compile(
+            jitted.lower(images), f'{name} {arm} serve bs{batch}')
+        flops = _compiled_flops(compiled)
+        ips = fenced_throughput(lambda _c=compiled: _c(images),
+                                lambda o: int(o[0, 0, 0]), batch,
+                                queue=queue, trials=trials,
+                                guard_jitted=jitted,
+                                guard_name=f'{name} {arm} serve bench')
+        # the bytes the registry would ship: the same jax.export
+        # serialization `segship bake` writes per bucket
+        art_bytes = len(jax.export.export(jax.jit(fn))(spec).serialize())
+        preds[arm] = np.asarray(compiled(images))
+        out[arm] = {'ips': ips, 'flops_per_img': flops / batch,
+                    'compile_s': compile_s,
+                    'compile_label': compile_label,
+                    'artifact_bytes': art_bytes}
+    out['agreement_frac'] = float((preds['f32'] == preds['int8']).mean())
+    return out
 
 
 def _setup_state(name, batch, h, w, **cfg_overrides):
@@ -338,6 +402,55 @@ def bench_data(args, sink) -> int:
     return 0
 
 
+def bench_quant_sweep(args, device_kind, sink) -> int:
+    """--quant int8 sweep: one side-by-side row per model."""
+    rows = []
+    for name in [m.strip() for m in args.models.split(',') if m.strip()]:
+        try:
+            r = bench_forward_quant(name, args.batch, args.imgh,
+                                    args.imgw, args.queue, args.trials)
+        except Exception as e:          # keep the sweep going
+            print(f'| {name} | FAILED: {type(e).__name__}: {e} |',
+                  flush=True)
+            continue
+        for arm in ('f32', 'int8'):
+            print(f'# {name} {arm} first-call compile: '
+                  f'{r[arm]["compile_s"]:.2f} s '
+                  f'({r[arm]["compile_label"]})', flush=True)
+        rows.append((name, r))
+        print(json.dumps({
+            'metric': f'{name} quant-serve imgs/sec/chip '
+                      f'({args.imgw}x{args.imgh}, bs{args.batch})',
+            'f32_imgs_per_sec': round(r['f32']['ips'], 1),
+            'int8_imgs_per_sec': round(r['int8']['ips'], 1),
+            'f32_artifact_bytes': r['f32']['artifact_bytes'],
+            'int8_artifact_bytes': r['int8']['artifact_bytes'],
+            'agreement_frac': round(r['agreement_frac'], 4),
+        }), flush=True)
+        if sink is not None:
+            sink.emit({'event': 'bench_result', 'model': name,
+                       'mode': 'quant-serve', 'batch': args.batch,
+                       'imgh': args.imgh, 'imgw': args.imgw,
+                       'device_kind': device_kind,
+                       'f32_imgs_per_sec': round(r['f32']['ips'], 2),
+                       'int8_imgs_per_sec': round(r['int8']['ips'], 2),
+                       'f32_artifact_bytes': r['f32']['artifact_bytes'],
+                       'int8_artifact_bytes': r['int8']['artifact_bytes'],
+                       'agreement_frac': round(r['agreement_frac'], 4)})
+    print(f'\n| model | f32 imgs/sec ({device_kind}, bs{args.batch}) | '
+          f'int8 imgs/sec | int8/f32 | f32 artifact | int8 artifact | '
+          f'shrink | agreement |')
+    print('|---|---|---|---|---|---|---|---|')
+    for name, r in rows:
+        f32b, i8b = r['f32']['artifact_bytes'], r['int8']['artifact_bytes']
+        print(f'| {name} | {r["f32"]["ips"]:.0f} | '
+              f'{r["int8"]["ips"]:.0f} | '
+              f'{r["int8"]["ips"] / r["f32"]["ips"]:.2f}x | '
+              f'{f32b / 2**20:.2f} MiB | {i8b / 2**20:.2f} MiB | '
+              f'{f32b / i8b:.2f}x | {r["agreement_frac"]:.4f} |')
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument('--models', type=str, default=DEFAULT_MODELS)
@@ -402,6 +515,11 @@ def main() -> int:
                     action='store_false',
                     help='eval mode: force the materializing '
                          'upsample-then-argmax path (the A/B baseline)')
+    ap.add_argument('--quant', choices=('int8',), default=None,
+                    help='forward mode: bench the segquant int8 serving '
+                         'program next to f32 — fenced imgs/sec, '
+                         'serialized artifact bytes, and argmax '
+                         'agreement side by side')
     ap.add_argument('--peak-flops', type=float, default=None,
                     help='override the per-chip peak FLOP/s used for MFU '
                          '(required on device kinds not in '
@@ -451,6 +569,10 @@ def main() -> int:
     BENCH_S2D['pallas_cm'] = args.pallas_cm
     BENCH_S2D['fused_head'] = args.fused_head
     peak, device_kind = peak_flops(args.peak_flops)
+    if args.quant:
+        if args.train or args.eval:
+            ap.error('--quant benches the serving forward only')
+        return bench_quant_sweep(args, device_kind, sink)
     kind = 'train' if args.train else 'eval' if args.eval else 'forward'
     rows = []
     for name in [m.strip() for m in args.models.split(',') if m.strip()]:

@@ -43,6 +43,13 @@ from benchmark_all import compiled_costs  # noqa: E402
 # defaults: TPU v5e, 197 TFLOP/s bf16, 819 GB/s HBM
 PEAK_V5E = 197e12
 BW_V5E = 819e9
+# v5e int8 peak: 394 TOP/s (2x bf16) — the segquant ceiling row. The
+# int8 ceiling below reuses the bf16-program byte counts (conservative:
+# int8 weights move ~4x fewer bytes, so a bandwidth-bound model's real
+# int8 ceiling is HIGHER than printed), because cost analysis of the
+# quantized program would need the dequant-in-graph trace per model and
+# the pessimistic bound is the honest default
+PEAK_INT8_V5E = 394e12
 
 DEFAULT_MODELS = ('fastscnn,bisenetv2,ddrnet,stdc,ppliteseg,enet,esnet,'
                   'erfnet,mininetv2,fddwnet')
@@ -135,6 +142,10 @@ def main():
                          'live chip for TPU-post-fusion numbers)')
     ap.add_argument('--peak-flops', type=float, default=PEAK_V5E,
                     help='device peak FLOP/s for the MFU denominator')
+    ap.add_argument('--peak-flops-int8', type=float,
+                    default=PEAK_INT8_V5E,
+                    help='device peak int8 OP/s (segquant ceiling row; '
+                         'v5e: 2x the bf16 peak)')
     ap.add_argument('--bw', type=float, default=BW_V5E,
                     help='device HBM bandwidth, bytes/s')
     ap.add_argument('--json', action='store_true',
@@ -151,12 +162,13 @@ def main():
         pass
 
     peak, bw = args.peak_flops, args.bw
+    peak_i8 = args.peak_flops_int8
     ridge = peak / bw
     if not args.json:
         print(f'| model | GFLOPs/img | GB/img | intensity (FLOP/B) | '
               f'roofline-bound | est. ceiling MFU | lane occ @bs{args.batch} '
-              f'| lane-adj ceiling |')
-        print('|---|---|---|---|---|---|---|---|')
+              f'| lane-adj ceiling | int8 ceiling |')
+        print('|---|---|---|---|---|---|---|---|---|')
     for name in [s.strip() for s in args.models.split(',') if s.strip()]:
         try:
             fn, shapes, x = _model_forward(name, args.batch, args.imgh,
@@ -169,8 +181,8 @@ def main():
             if args.json:
                 print(json.dumps({'model': name, 'error': msg}), flush=True)
             else:
-                print(f'| {name} | FAILED: {msg} | — | — | — | — | — | — |',
-                      flush=True)
+                print(f'| {name} | FAILED: {msg} | — | — | — | — | — | — '
+                      f'| — |', flush=True)
             continue
         fpi, bpi = flops / args.batch, bytes_ / args.batch
         inten = fpi / bpi if bpi else float('inf')
@@ -180,6 +192,11 @@ def main():
         # pull a nominally compute-bound shape below peak too (padding
         # traffic is real even when intensity clears the ridge)
         attain_occ = min(peak, inten * bw * occ)
+        # int8 ceiling: the same intensity/bandwidth against the int8
+        # peak (see PEAK_INT8_V5E note — byte counts stay the bf16
+        # program's, so this row is a conservative lower bound)
+        attain_i8 = min(peak_i8, inten * bw)
+        attain_i8_occ = min(peak_i8, inten * bw * occ)
         if args.json:
             print(json.dumps({'model': name,
                               'gflops_per_img': round(fpi / 1e9, 3),
@@ -188,13 +205,18 @@ def main():
                               'ceiling_mfu': round(attain / peak, 4),
                               'lane_occupancy': round(occ, 4),
                               'lane_adj_ceiling_mfu':
-                                  round(attain_occ / peak, 4)}),
+                                  round(attain_occ / peak, 4),
+                              'int8_ceiling_mfu':
+                                  round(attain_i8 / peak_i8, 4),
+                              'lane_adj_int8_ceiling_mfu':
+                                  round(attain_i8_occ / peak_i8, 4)}),
                   flush=True)
         else:
             bound = 'compute' if inten >= ridge else 'bandwidth'
             print(f'| {name} | {fpi / 1e9:.2f} | {bpi / 1e9:.3f} | '
                   f'{inten:.1f} | {bound} | {100 * attain / peak:.1f}% | '
-                  f'{occ:.2f} | {100 * attain_occ / peak:.1f}% |',
+                  f'{occ:.2f} | {100 * attain_occ / peak:.1f}% | '
+                  f'{100 * attain_i8_occ / peak_i8:.1f}% |',
                   flush=True)
     if not args.json:
         print(f'\nridge point: {ridge:.0f} FLOP/B '

@@ -230,6 +230,7 @@ def main(argv=None) -> int:
     if args.deep:
         from rtseg_tpu.analysis import (audit_collective_budget,
                                         audit_dead_params, audit_donation,
+                                        audit_quant_boundaries,
                                         audit_train_precision)
         from rtseg_tpu.analysis.step_harness import build_step_artifacts
         models = [m.strip() for m in args.deep_models.split(',')
@@ -251,6 +252,11 @@ def main(argv=None) -> int:
             deep_findings += audit_collective_budget(
                 root=root, compiled_text=compiled_text,
                 update=args.update_budget, model_name=name)
+            # quant-boundary: trace the same model's int8 inference
+            # forward and gate its dequant sites (count pinned in
+            # SEGAUDIT.json quant_dequant, re-pinned by --update-budget)
+            deep_findings += audit_quant_boundaries(
+                root=root, update=args.update_budget, model_name=name)
         deep_findings += audit_dead_params(
             model_names=None if args.deep_zoo else models)
         for f in deep_findings:
@@ -260,7 +266,7 @@ def main(argv=None) -> int:
             scope = 'full zoo' if args.deep_zoo else ','.join(models)
             print(f'segcheck deep: {len(deep_findings)} finding(s) '
                   f'(donation, precision-flow, collective-budget, '
-                  f'dead-param; {scope})')
+                  f'dead-param, quant-boundary; {scope})')
 
     return 1 if failures else 0
 

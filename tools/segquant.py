@@ -1,0 +1,199 @@
+#!/usr/bin/env python
+"""segquant — post-training int8 quantization report for the model zoo.
+
+For each model: quantize the weights per-channel symmetric int8
+(rtseg_tpu/quant/ptq.py), run the deterministic calibration forward
+(rtseg_tpu/quant/calibrate.py) over a seeded sample slice, and print the
+evidence — weight bytes f32 vs int8, f32-vs-int8 argmax agreement, mIoU
+delta, and the max-drop gate verdict. The same machinery `segship bake
+--quant int8` runs; this tool answers "is this model quantizable?"
+before anything ships.
+
+    # synthetic calibration slice (seeded, through the serving preprocess)
+    python tools/segquant.py --models fastscnn,bisenetv2 --samples 8
+
+    # real eval slice from a segpipe PackedCache (ground-truth mIoU)
+    python tools/segquant.py --models fastscnn \
+        --calib-cache /data/cache/cityscapes-val-... --samples 16
+
+    # write the flagship QuantRecord for inspection / diffing
+    python tools/segquant.py --models fastscnn --out /tmp/QUANT.json
+
+`--activations` additionally calibrates per-tensor activation scales and
+quantizes the input boundary (QDQ), matching `bake --quant-activations`.
+Determinism contract: same models + samples + seed (+ cache) ⇒
+byte-identical records (tests/test_segquant.py pins this).
+
+Exit codes: 0 every model passed its gate, 1 any gate failure or model
+error, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DEFAULT_MODELS = 'fastscnn'
+
+
+def _calibration_slice(cfg, args, buckets):
+    """(images, masks, source, indices) — the same two sources bake_model
+    uses: a segpipe PackedCache (eval suffix applied, ground truth) or a
+    seeded synthetic batch through the real serving preprocess."""
+    import numpy as np
+    from rtseg_tpu.quant import select_calibration_indices
+    from rtseg_tpu.serve import encode_png, make_preprocess, synth_images
+
+    if args.calib_cache:
+        from rtseg_tpu.data.segpipe.cache import PackedCache
+        from rtseg_tpu.data.transforms import EvalTransform
+        cache = PackedCache(args.calib_cache)
+        indices = select_calibration_indices(len(cache), args.samples,
+                                             seed=args.seed)
+        tf = EvalTransform(cfg)
+        pairs = [tf.suffix(np.asarray(img), np.asarray(msk))
+                 for img, msk in (cache.read(i) for i in indices)]
+        name = os.path.basename(os.path.normpath(args.calib_cache))
+        return (np.stack([p[0] for p in pairs]),
+                np.stack([p[1] for p in pairs]),
+                f'segpipe:{name}', indices)
+    preprocess = make_preprocess(cfg)
+    raws = synth_images([buckets[0]], seed=args.seed,
+                        per_shape=max(1, args.samples))
+    return (np.stack([preprocess(encode_png(im)) for im in raws]),
+            None, 'synthetic', None)
+
+
+def quantize_one(name: str, args):
+    """Quantize + calibrate one zoo model; returns the QuantRecord."""
+    import jax
+    import jax.numpy as jnp
+    from rtseg_tpu.config import SegConfig
+    from rtseg_tpu.models import get_model
+    from rtseg_tpu.quant import calibrate, quantize_variables
+
+    cfg = SegConfig(dataset='synthetic', model=name,
+                    num_class=args.num_class,
+                    compute_dtype=args.compute_dtype,
+                    save_dir='/tmp/segquant_cli', use_tb=False)
+    cfg.resolve(num_devices=1)
+    net = get_model(cfg)
+    variables = net.init(jax.random.PRNGKey(0),
+                         jnp.zeros((1, 64, 64, 3), jnp.float32), False)
+    if args.ckpt:
+        from rtseg_tpu.train.checkpoint import restore_weights
+        p, bs = restore_weights(args.ckpt, variables['params'],
+                                variables.get('batch_stats', {}))
+        variables = dict(variables, params=p, batch_stats=bs)
+    qvariables = quantize_variables(variables)
+    buckets = [(args.imgh, args.imgw)]
+    images, masks, source, indices = _calibration_slice(cfg, args,
+                                                        buckets)
+    return calibrate(net, variables, qvariables, images, masks,
+                     compute_dtype=cfg.compute_dtype,
+                     num_class=args.num_class, max_drop=args.max_drop,
+                     activations=args.activations, source=source,
+                     seed=args.seed, indices=indices)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog='segquant', description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument('--models', default=DEFAULT_MODELS,
+                    help='comma-separated registry models')
+    ap.add_argument('--num-class', type=int, default=19)
+    ap.add_argument('--compute-dtype', default='float32')
+    ap.add_argument('--imgh', type=int, default=256)
+    ap.add_argument('--imgw', type=int, default=512)
+    ap.add_argument('--samples', type=int, default=8,
+                    help='calibration sample count (seeded selection)')
+    ap.add_argument('--seed', type=int, default=0)
+    ap.add_argument('--max-drop', type=float, default=0.05,
+                    help='mIoU-drop gate per model (vs ground truth '
+                         'with --calib-cache, vs the f32 forward '
+                         'otherwise — the record labels which)')
+    ap.add_argument('--activations', action='store_true',
+                    help='also calibrate activation scales + quantize '
+                         'the input boundary (QDQ)')
+    ap.add_argument('--calib-cache', default=None,
+                    help='segpipe PackedCache dir (real samples + '
+                         'ground-truth mIoU)')
+    ap.add_argument('--ckpt', default=None,
+                    help='checkpoint to quantize (default: seeded init '
+                         '— structural/agreement evidence only)')
+    ap.add_argument('--out', default=None, metavar='PATH',
+                    help='write the LAST model\'s QuantRecord here '
+                         '(record_to_json canonical bytes)')
+    ap.add_argument('--json', action='store_true',
+                    help='one full QuantRecord JSON line per model')
+    args = ap.parse_args(argv)
+
+    os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+    import jax
+    try:
+        jax.config.update('jax_platforms', os.environ['JAX_PLATFORMS'])
+    except Exception:   # noqa: BLE001 — backend already initialized
+        pass
+    from rtseg_tpu.quant import record_to_json
+
+    models = [m.strip() for m in args.models.split(',') if m.strip()]
+    if not models:
+        print('segquant: no models', file=sys.stderr)
+        return 2
+    if not args.json:
+        print(f'| model | f32 MiB | int8 MiB | quantized leaves | '
+              f'agreement | mIoU drop ({("gt" if args.calib_cache else "vs f32")}) '
+              f'| gate (<= {args.max_drop}) |')
+        print('|---|---|---|---|---|---|---|')
+    failures = 0
+    record = None
+    for name in models:
+        try:
+            record = quantize_one(name, args)
+        except Exception as e:   # noqa: BLE001 — one broken model must
+            # not hide the rest of the table; it still fails the run
+            failures += 1
+            msg = ' '.join(f'{type(e).__name__}: {e}'.split())[:120]
+            if args.json:
+                print(json.dumps({'model': name, 'error': msg}),
+                      flush=True)
+            else:
+                print(f'| {name} | FAILED: {msg} | — | — | — | — | — |',
+                      flush=True)
+            continue
+        if not record['gate']['passed']:
+            failures += 1
+        if args.json:
+            print(json.dumps({'model': name, **record},
+                             sort_keys=True), flush=True)
+        else:
+            w = record['weights']
+            f32_mib = w['f32'] / 2**20
+            int8_mib = w['int8'] / 2**20
+            print(f'| {name} | {f32_mib:.2f} | {int8_mib:.2f} | '
+                  f'{w["quantized_leaves"]}/{w["total_leaves"]} | '
+                  f'{record["agreement_frac"]:.4f} | '
+                  f'{record["miou"]["drop"]:.4f} | '
+                  f'{"PASS" if record["gate"]["passed"] else "FAIL"} |',
+                  flush=True)
+    if not args.json:
+        print(f'\ncalibration: {args.samples} samples, seed {args.seed}, '
+              f'{args.imgh}x{args.imgw}, '
+              + (f'cache {args.calib_cache}' if args.calib_cache
+                 else 'synthetic (mIoU drop is f32-forward-relative)'))
+    if args.out and record is not None:
+        os.makedirs(os.path.dirname(os.path.abspath(args.out)),
+                    exist_ok=True)
+        with open(args.out, 'w') as f:
+            f.write(record_to_json(record))
+        print(f'segquant: record -> {args.out}', flush=True)
+    return 1 if failures else 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

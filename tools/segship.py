@@ -7,6 +7,14 @@ Usage:
         --model fastscnn --num_class 19 --buckets 512x1024,256x512 \
         --batch 8 --ckpt save/best.ckpt --channel stable
 
+    # segquant: the same bake, post-training-quantized to int8 — smaller
+    # StableHLO/exe members, a quant/QUANT.json calibration record, and
+    # a bake-time mIoU-drop gate (the bake refuses past --quant-max-drop)
+    python tools/segship.py bake --registry /var/segship \
+        --model fastscnn --buckets 512x1024 --batch 8 \
+        --quant int8 --quant-samples 8 --quant-max-drop 0.05 \
+        --channel canary
+
     # registry contents: versions, sizes, channel pointers
     python tools/segship.py list --registry /var/segship [--model M]
 
@@ -28,6 +36,15 @@ Usage:
         --model fastscnn --canary @canary --weight 0.2 \
         --shadow-sample 0.3 --requests 200 --rps 40 \
         --expect promote --check
+
+    # quantized rollout: an int8 candidate legitimately flips boundary
+    # pixels, so the compare gate is an explicit argmax-agreement
+    # tolerance instead of byte-equality, and --keep-shadow keeps live
+    # mirrors running through the canary phase so the controller can
+    # roll back on a sinking mean agreement (--min-agree-frac)
+    python tools/segship.py rollout --registry /var/segship \
+        --model fastscnn --canary @canary --agree-tol 0.9 \
+        --min-agree-frac 0.9 --keep-shadow --expect promote --check
 
 Replicas are real `tools/segserve.py serve --bundle` subprocesses: the
 bundle manifest fixes buckets/batch/dtype, the baked executables
@@ -74,7 +91,13 @@ def cmd_bake(args) -> int:
         compute_dtype=args.compute_dtype, ckpt_path=args.ckpt,
         golden=args.golden, seed=args.seed,
         perturb=args.perturb, perturb_seed=args.perturb_seed,
-        miou=args.miou)
+        miou=args.miou,
+        quant=args.quant, quant_samples=args.quant_samples,
+        quant_seed=args.quant_seed, quant_max_drop=args.quant_max_drop,
+        quant_activations=args.quant_activations,
+        quant_corrupt=args.quant_corrupt,
+        quant_corrupt_seed=args.quant_corrupt_seed,
+        calib_cache=args.calib_cache)
     version = reg.publish(args.model, staging)
     dur = time.perf_counter() - t0
     members = manifest['members']
@@ -82,9 +105,17 @@ def cmd_bake(args) -> int:
     line = (f'segship bake — {args.model} -> version {version} | '
             f'{len(members)} members, {total / 2**20:.1f} MiB, '
             f'{manifest["meta"]["buckets"]} x batch '
-            f'{manifest["meta"]["batch"]} | {dur:.1f} s')
+            f'{manifest["meta"]["batch"]} | '
+            f'{manifest["meta"]["precision"]} | {dur:.1f} s')
     if args.perturb:
         line += f' | perturb {args.perturb}@{args.perturb_seed}'
+    q = manifest['meta'].get('quant')
+    if q:
+        line += (f' | agreement {q["agreement_frac"]:.4f}, mIoU drop '
+                 f'{q["miou_drop"]:.4f} <= {q["max_drop"]} '
+                 f'({q["calib_source"]})')
+        if q.get('corrupt'):
+            line += f' | CORRUPTED scales {q["corrupt"]}'
     print(line, flush=True)
     if args.channel:
         reg.set_channel(args.model, args.channel, version)
@@ -110,9 +141,23 @@ def cmd_list(args) -> int:
             tags = ''.join(f' @{c}' for c, pv in chans.items() if pv == v)
             print(f'  {v}{tags}: {info.get("members")} members '
                   f'{info.get("bytes", 0) / 2**20:.1f} MiB | buckets '
-                  f'{info.get("buckets")} batch {info.get("batch")}'
+                  f'{info.get("buckets")} batch {info.get("batch")} | '
+                  f'{info.get("precision")}'
                   + (f' | perturb {info["perturb"]}'
                      if info.get('perturb') else ''))
+            bb = info.get('bucket_bytes') or {}
+            if bb:
+                print('      hlo: ' + ' '.join(
+                    f'{b}={n / 2**10:.0f}KiB'
+                    for b, n in sorted(bb.items())))
+            q = info.get('quant')
+            if q:
+                print(f'      quant: calib {q.get("calib_hash", "")[:12]}'
+                      f' ({q.get("calib_source")}) | agreement '
+                      f'{q.get("agreement_frac"):.4f} | mIoU drop '
+                      f'{q.get("miou_drop"):.4f} <= {q.get("max_drop")}'
+                      + (f' | CORRUPTED {q["corrupt"]}'
+                         if q.get('corrupt') else ''))
     return 0
 
 
@@ -130,8 +175,23 @@ def cmd_verify(args) -> int:
               f'{args.ref or "@stable"} ({version}): '
               + '; '.join(problems), file=sys.stderr, flush=True)
         return 1
+    provenance = ''
+    if version is not None:
+        try:
+            from rtseg_tpu.registry.bundle import load_manifest
+            meta = load_manifest(
+                reg.version_dir(args.model, version)).get('meta', {})
+            provenance = f' | {meta.get("precision")}'
+            q = meta.get('quant')
+            if q:
+                provenance += (f', calib {q.get("calib_hash", "")[:12]}, '
+                               f'agreement {q.get("agreement_frac"):.4f} '
+                               f'(gate drop <= {q.get("max_drop")})')
+        except Exception:   # noqa: BLE001 — provenance is decoration;
+            pass            # the verify verdict above is the contract
     print(f'segship verify OK — {args.model} {args.ref or "@stable"} '
-          f'({version}): every member re-hashed clean', flush=True)
+          f'({version}): every member re-hashed clean{provenance}',
+          flush=True)
     return 0
 
 
@@ -247,7 +307,8 @@ def cmd_rollout(args) -> int:
         # the candidate; users only ever get stable answers
         if args.shadow_sample > 0:
             router.configure_shadow(group, canary_rg, canary_v,
-                                    args.shadow_sample)
+                                    args.shadow_sample,
+                                    agree_tol=args.agree_tol)
             before_can = _scrape_ok(canaries)
             shadow_bench = bench_http(url, payloads,
                                       args.shadow_requests, args.rps,
@@ -269,7 +330,8 @@ def cmd_rollout(args) -> int:
                     break
                 last = (n, delta)
                 time.sleep(0.25)
-            router.groups[group].clear_shadow()
+            if not args.keep_shadow:
+                router.groups[group].clear_shadow()
             mirrors = sum(int(counts.get(k, 0))
                           for k in ('agree', 'disagree', 'error'))
             report['shadow'] = {
@@ -284,9 +346,9 @@ def cmd_rollout(args) -> int:
             }
             print(f'  shadow         : {mirrors} mirrored of '
                   f'{shadow_bench["ok"]} ok | agree '
-                  f'{counts.get("agree", 0)} | disagree '
-                  f'{counts.get("disagree", 0)} | last raw agreement '
-                  f'{counts.get("agree_frac")}', flush=True)
+                  f'{counts.get("agree", 0)} (tol {args.agree_tol}) | '
+                  f'disagree {counts.get("disagree", 0)} | mean raw '
+                  f'agreement {counts.get("agree_frac")}', flush=True)
             if shadow_bench['errors']:
                 problems.append(f'shadow phase: '
                                 f'{shadow_bench["errors"]} client '
@@ -313,6 +375,7 @@ def cmd_rollout(args) -> int:
             p99_regress_frac=args.p99_regress_frac,
             p99_floor_ms=args.p99_floor_ms,
             max_disagree_frac=args.max_disagree,
+            min_agree_frac=args.min_agree_frac,
             min_canary_ok=args.min_canary_ok,
             min_stable_ok=args.min_stable_ok,
             breach_consecutive=args.breach_consecutive,
@@ -335,8 +398,12 @@ def cmd_rollout(args) -> int:
             # client-visible errors (the canary hash slice falls back
             # to stable the moment the arm clears)
             controller.start()
+        # --keep-shadow drives raw traffic so the live mirrors keep
+        # comparing int8 masks per-pixel (the agree_frac the controller
+        # gates on); version attribution rides in headers either way
         bench = bench_http(url, payloads, args.requests, args.rps,
-                           seed=args.seed + 1)
+                           seed=args.seed + 1,
+                           query='raw=1' if args.keep_shadow else '')
         report['canary_bench'] = bench
         print(format_report(bench), flush=True)
         after_rtr = _ok_by_version(router, group)
@@ -370,7 +437,14 @@ def cmd_rollout(args) -> int:
                     f'stable replicas served '
                     f'{recon["stable_serve_delta"]}, router says '
                     f'{rtr_delta.get(stable_v, 0)}')
-            if recon['canary_serve_delta'] != rtr_delta.get(canary_v, 0):
+            # under --keep-shadow the canary replicas also serve the
+            # live mirrors (which the router books as shadow results,
+            # not fleet_requests), so the exact-equality leg holds only
+            # without a live shadow arm; the mirror side reconciles in
+            # phase S instead
+            if not args.keep_shadow and \
+                    recon['canary_serve_delta'] != rtr_delta.get(
+                        canary_v, 0):
                 problems.append(
                     f'canary replicas served '
                     f'{recon["canary_serve_delta"]}, router says '
@@ -497,6 +571,31 @@ def main(argv=None) -> int:
     bp.add_argument('--miou', type=float, default=None,
                     help='held-out mIoU measured by the baker (recorded '
                          'in quality.json)')
+    bp.add_argument('--quant', default=None, choices=('int8',),
+                    help='segquant: post-training quantize the weights '
+                         '(per-channel symmetric int8) before export; '
+                         'the bundle ships int8 StableHLO + the '
+                         'quant/QUANT.json calibration record')
+    bp.add_argument('--quant-samples', type=int, default=8,
+                    help='calibration sample count (seeded selection)')
+    bp.add_argument('--quant-seed', type=int, default=0)
+    bp.add_argument('--quant-max-drop', type=float, default=0.05,
+                    help='the bake REFUSES when the calibrated mIoU '
+                         'drop exceeds this (vs ground truth with '
+                         '--calib-cache, vs the f32 forward otherwise)')
+    bp.add_argument('--quant-activations', action='store_true',
+                    help='also calibrate per-tensor activation scales '
+                         'and quantize the input boundary (QDQ)')
+    bp.add_argument('--quant-corrupt', type=float, default=0.0,
+                    help='seeded noise on the scale vectors AFTER '
+                         'calibration — the quantized rollout drill '
+                         '(bypasses the max-drop gate so the bad '
+                         'bundle ships to the shadow/rollout planes)')
+    bp.add_argument('--quant-corrupt-seed', type=int, default=0)
+    bp.add_argument('--calib-cache', default=None,
+                    help='segpipe PackedCache dir to calibrate on (real '
+                         'samples + ground-truth mIoU; default: seeded '
+                         'synthetic through the serving preprocess)')
     bp.add_argument('--channel', default=None,
                     help='also point this channel at the new version')
     bp.add_argument('--json', action='store_true')
@@ -550,6 +649,21 @@ def main(argv=None) -> int:
     rp.add_argument('--p99-regress-frac', type=float, default=0.5)
     rp.add_argument('--p99-floor-ms', type=float, default=50.0)
     rp.add_argument('--max-disagree', type=float, default=0.02)
+    rp.add_argument('--agree-tol', type=float, default=1.0,
+                    help='per-compare agreement fraction below which a '
+                         'mirrored raw mask counts as disagree (1.0 = '
+                         'byte-exact; an int8 canary states its argmax-'
+                         'agreement tolerance here)')
+    rp.add_argument('--min-agree-frac', type=float, default=0.0,
+                    help='rollback when the windowed mean per-pixel '
+                         'agreement sinks below this (0 disables; '
+                         'needs --keep-shadow for live mirrors during '
+                         'the canary phase)')
+    rp.add_argument('--keep-shadow', action='store_true',
+                    help='keep mirroring through the canary phase so '
+                         'the controller sees a live agree_frac (drives '
+                         'raw traffic; relaxes the canary replica-side '
+                         'reconciliation leg)')
     rp.add_argument('--min-canary-ok', type=int, default=10)
     rp.add_argument('--min-stable-ok', type=int, default=10)
     rp.add_argument('--breach-consecutive', type=int, default=2)

@@ -109,21 +109,100 @@ def test_model_speed(config, ratio=0.5, imgw=2048, imgh=1024,
     return fps
 
 
+def test_quant_speed(config, ratio=0.5, imgw=2048, imgh=1024,
+                     iterations=None, batch_size=1, warm_cache=None):
+    """--quant int8: the serving program (argmax head, what a bundle
+    ships) timed f32 vs segquant int8 under the same warmup +
+    auto-calibration + fenced protocol, plus serialized artifact bytes
+    and argmax agreement on the bench batch — side by side."""
+    from rtseg_tpu.export import build_inference_fn
+    from rtseg_tpu.quant import (build_quantized_inference_fn,
+                                 quantize_variables)
+    from rtseg_tpu.warm import timed_compile
+
+    if ratio != 1.0:
+        assert ratio > 0, 'Ratio should be larger than 0.'
+        imgw = int(imgw * ratio)
+        imgh = int(imgh * ratio)
+
+    model = get_model(config)
+    print('\n=========Quantized Speed Testing (segquant int8)=========')
+    print(f'Model: {config.model}\nSize (W, H): {imgw}, {imgh} | '
+          f'batch: {batch_size}')
+
+    x = jnp.asarray(np.random.randn(batch_size, imgh, imgw, 3)
+                    .astype(np.float32))
+    variables = model.init(jax.random.PRNGKey(0),
+                           jnp.zeros((1, imgh, imgw, 3)), False)
+    qvariables = quantize_variables(variables)
+    spec = jax.ShapeDtypeStruct((batch_size, imgh, imgw, 3), jnp.float32)
+
+    def measure(fwd, iters):
+        for _ in range(10):                  # warmup
+            jax.block_until_ready(fwd(x))
+        if iters is None:                    # auto-calibrate ~6s worth
+            elapsed, iters = 0.0, 100
+            while elapsed < 1:
+                t0 = time.time()
+                for _ in range(iters):
+                    out = fwd(x)
+                jax.block_until_ready(out)
+                elapsed = time.time() - t0
+                iters *= 2
+            iters = int(iters / elapsed * 6)
+        t0 = time.time()
+        for _ in range(iters):
+            out = fwd(x)
+        jax.block_until_ready(out)
+        return 1000 / ((time.time() - t0) / iters * 1000), iters
+
+    rows = {}
+    preds = {}
+    for arm, fn in (('f32', build_inference_fn(
+                        model, variables, config.compute_dtype,
+                        argmax=True)),
+                    ('int8', build_quantized_inference_fn(
+                        model, qvariables, config.compute_dtype,
+                        argmax=True))):
+        compiled, compile_s, label = timed_compile(
+            jax.jit(fn).lower(x),
+            f'{config.model} {arm} serve {imgw}x{imgh} bs{batch_size}',
+            cache=warm_cache)
+        print(f'{arm} first-call compile: {compile_s:.3f} s ({label})')
+        fps, iterations = measure(compiled, iterations)
+        art = len(jax.export.export(jax.jit(fn))(spec).serialize())
+        preds[arm] = np.asarray(compiled(x))
+        rows[arm] = (fps, art)
+    agree = float((preds['f32'] == preds['int8']).mean())
+    print(f'\n| arm | FPS | imgs/sec | artifact (MiB) |')
+    print('|---|---|---|---|')
+    for arm in ('f32', 'int8'):
+        fps, art = rows[arm]
+        print(f'| {arm} | {fps:.1f} | {fps * batch_size:.1f} | '
+              f'{art / 2**20:.2f} |')
+    print(f'\nint8/f32 throughput: '
+          f'{rows["int8"][0] / rows["f32"][0]:.2f}x | artifact shrink: '
+          f'{rows["f32"][1] / rows["int8"][1]:.2f}x | argmax agreement: '
+          f'{agree:.4f} (random-init weights, bench batch)\n')
+    return rows['int8'][0]
+
+
 def _pop_warm_args(argv):
-    """Split the --cold/--warm toggle (and --warm-cache DIR) out of argv
-    before the SegConfig parser sees the rest."""
+    """Split the --cold/--warm toggle (--warm-cache DIR, --quant int8)
+    out of argv before the SegConfig parser sees the rest."""
     import argparse
     pre = argparse.ArgumentParser(add_help=False, allow_abbrev=False)
     grp = pre.add_mutually_exclusive_group()
     grp.add_argument('--warm', action='store_true')
     grp.add_argument('--cold', action='store_true')
     pre.add_argument('--warm-cache', default='/tmp/rtseg_bench/segwarm')
+    pre.add_argument('--quant', choices=('int8',), default=None)
     ns, rest = pre.parse_known_args(argv)
-    return ns.warm, ns.warm_cache, rest
+    return ns.warm, ns.warm_cache, ns.quant, rest
 
 
 if __name__ == '__main__':
-    warm, cache_dir, rest = _pop_warm_args(sys.argv[1:])
+    warm, cache_dir, quant, rest = _pop_warm_args(sys.argv[1:])
     config = SegConfig(dataset='synthetic', model='bisenetv2', num_class=19)
     if rest:
         config = load_parser(config, rest)
@@ -133,4 +212,7 @@ if __name__ == '__main__':
         from rtseg_tpu.warm import ExeCache, enable_compile_cache
         enable_compile_cache(cache_dir=cache_dir)
         warm_cache = ExeCache.at(cache_dir)
-    test_model_speed(config, warm_cache=warm_cache)
+    if quant:
+        test_quant_speed(config, warm_cache=warm_cache)
+    else:
+        test_model_speed(config, warm_cache=warm_cache)
